@@ -1,0 +1,219 @@
+"""Public Horovod-compatible API.
+
+Parity surface with the reference's Python entry points
+(common/__init__.py:52-139, torch/__init__.py:226-466, torch/ops.py:38-236).
+
+Semantics on TPU (single-controller JAX):
+
+- *Local* (intra-slice) reduction is device-side: use the traceable
+  collectives (:mod:`byteps_tpu.comm.collectives`) or
+  :class:`byteps_tpu.optim.DistributedOptimizer`, which compile to ICI
+  collectives.  This replaces the reference's per-process NCCL ranks.
+- *Cross-worker* (inter-host) reduction is what this module's host-level
+  ``push_pull`` does: partition → stage to host → PS push/pull over DCN →
+  back to device.  With one worker it is the identity, matching the
+  reference's 1-worker semantics (tests/test_mxnet.py:30-126).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.registry import get_registry
+from byteps_tpu.core.state import get_state, init_state, require_state, shutdown_state
+
+
+def init(lazy: bool = True) -> None:
+    """Initialize the runtime (byteps_init / byteps_lazy_init,
+    operations.cc:41-94)."""
+    init_state()
+
+
+def shutdown() -> None:
+    """Tear down threads and connections (byteps_shutdown,
+    operations.cc:89-94)."""
+    shutdown_state()
+
+
+def suspend() -> None:
+    """Elastic suspend: stop engine/PS but keep tensor declarations so a
+    later resume() re-assigns identical keys (operations.cc:114-119)."""
+    shutdown_state()
+
+
+def resume(
+    num_workers: Optional[int] = None,
+    num_servers: Optional[int] = None,
+    global_rank: Optional[int] = None,
+) -> None:
+    """Elastic resume: rewrite topology env then re-init and replay tensor
+    declarations in original order (common/__init__.py:75-82,
+    operations.cc:96-112, ReDeclareTensor global.cc:431-436)."""
+    if num_workers is not None:
+        os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+    if num_servers is not None:
+        os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+    if global_rank is not None:
+        os.environ["BYTEPS_GLOBAL_RANK"] = str(global_rank)
+    st = get_state()
+    st.resuming = True
+    try:
+        get_registry().redeclare_all()
+        init_state(fresh_env=True)
+    finally:
+        st.resuming = False
+
+
+def rank() -> int:
+    """Global worker rank (common/__init__.py:96-103)."""
+    cfg = get_config()
+    return cfg.global_rank if cfg.global_rank is not None else cfg.worker_id
+
+
+def size() -> int:
+    """Number of workers (common/__init__.py:105-112)."""
+    return get_config().num_worker
+
+
+def local_rank() -> int:
+    return get_config().local_rank
+
+
+def local_size() -> int:
+    return get_config().local_size
+
+
+def declare_tensor(name: str, **kwargs: str) -> int:
+    """Declare a named tensor ahead of communication, optionally carrying
+    compression kwargs (byteps_declare_tensor, mxnet/ops.py:82-120);
+    returns the stable declared key."""
+    ctx = get_registry().declare(name, **{k: str(v) for k, v in kwargs.items()})
+    return ctx.declared_key
+
+
+def _to_numpy(tensor: Any) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def push_pull_async(
+    tensor: Any,
+    name: str,
+    average: bool = True,
+    priority: int = 0,
+    version: int = 0,
+) -> int:
+    """Start a cross-worker push_pull; returns a pollable handle
+    (byteps_push_pull / DoPushPull, torch/ops.cc:99-113).
+
+    The result (same shape/dtype as input) is retrieved by
+    :func:`synchronize`.
+    """
+    st = require_state()
+    cfg = st.config
+    get_registry().declare(name)
+    handle = st.handles.allocate()
+    if not cfg.is_distributed:
+        # Non-distributed role set skips push/pull loops entirely
+        # (operations.cc:46-53): identity.
+        st.handles.mark_done(handle, tensor)
+        return handle
+    st.engine.submit(
+        name=name,
+        tensor=_to_numpy(tensor),
+        average=average,
+        priority=priority,
+        version=version,
+        handle=handle,
+        original=tensor,
+    )
+    return handle
+
+
+def poll(handle: int) -> bool:
+    """True when the async op has completed (ops.py poll, handle_manager)."""
+    return require_state().handles.poll(handle)
+
+
+def synchronize(handle: int) -> Any:
+    """Block until completion and return the reduced tensor
+    (ops.py:214-236)."""
+    return require_state().handles.wait_and_clear(handle)
+
+
+def push_pull(
+    tensor: Any,
+    name: str,
+    average: bool = True,
+    priority: int = 0,
+) -> Any:
+    """Synchronous cross-worker push_pull (sum over workers, then average
+    when ``average=True``).
+
+    ``name`` is required: it is the cross-process aggregation key, so it
+    must be identical on every worker (an auto-generated per-process name
+    could never match up).  The reference likewise keys on names
+    (torch/__init__.py:139: ``Gradient.<param name>``).
+    """
+    return synchronize(push_pull_async(tensor, name, average=average, priority=priority))
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Sync a pytree of parameters from ``root_rank`` to all workers.
+
+    Reference trick (torch/__init__.py:268-299): non-root zeroes its copy,
+    then an unaveraged push_pull sum leaves root's values everywhere.
+    """
+    import jax
+
+    st = require_state()
+    if not st.config.is_distributed:
+        return params
+
+    # Launch every leaf async, then synchronize — overlaps all round-trips
+    # the way the reference broadcasts with async handles
+    # (torch/__init__.py:268-299).
+    def start_leaf(path, leaf):
+        name = "Parameter." + "/".join(str(p) for p in path)
+        arr = np.asarray(leaf)
+        if rank() != root_rank:
+            arr = np.zeros_like(arr)
+        return push_pull_async(arr, name=name, average=False)
+
+    handles = jax.tree_util.tree_map_with_path(start_leaf, params)
+
+    def finish_leaf(handle, leaf):
+        out = synchronize(handle)
+        return jax.numpy.asarray(out, dtype=leaf.dtype) if hasattr(leaf, "dtype") else out
+
+    return jax.tree_util.tree_map(finish_leaf, handles, params)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = "obj") -> Any:
+    """Broadcast an arbitrary picklable object (broadcast_object,
+    torch/__init__.py:302-466: cloudpickle → byte tensor → push_pull).
+    Two-phase: length first, then payload, both as unaveraged sums with
+    non-root contributing zeros."""
+    st = require_state()
+    if not st.config.is_distributed:
+        return obj
+    payload = pickle.dumps(obj) if rank() == root_rank else b""
+    ln = np.array([len(payload)], dtype=np.int64)
+    if rank() != root_rank:
+        ln = np.zeros_like(ln)
+    total = int(push_pull(ln, name=f"{name}.len", average=False)[0])
+    buf = np.zeros(total, dtype=np.uint8)
+    if rank() == root_rank:
+        buf[:] = np.frombuffer(payload, dtype=np.uint8)
+    out = push_pull(buf, name=f"{name}.data", average=False)
+    return pickle.loads(np.asarray(out, dtype=np.uint8).tobytes())
+
+
+def get_pushpull_speed() -> float:
+    """Windowed push/pull MB/s (common/__init__.py:131-139)."""
+    st = require_state()
+    return st.telemetry.mbps() if st.telemetry else 0.0

@@ -1,0 +1,6 @@
+"""Communication plane.
+
+Intra-slice: XLA collectives over ICI (replaces the reference's NCCL layer,
+SURVEY §2.1 nccl_manager).  Inter-host: PS-style push/pull over DCN
+(replaces ps-lite, SURVEY §2.4).
+"""

@@ -1,0 +1,127 @@
+"""Intra-slice collectives — the NCCL-layer replacement.
+
+The reference's intra-host data plane is ncclReduceScatter + ncclAllGather
+on PCIe-switch-scoped communicators with hand-rolled CUDA-event sync
+(core_loops.cc:190-317, nccl_manager.cc).  On TPU the whole layer is three
+lines of lax: ``psum_scatter`` + ``all_gather`` over a mesh axis, compiled
+by XLA onto ICI with automatic overlap — no events, no signal sockets, no
+ready tables on the device path.
+
+Two call styles:
+
+- :func:`push_pull` — traceable; call inside ``shard_map``/``pjit`` with a
+  bound mesh axis.  Mirrors the semantic of the reference's per-gradient
+  push_pull (sum-then-average across the reduction axis).
+- :func:`jit_push_pull_tree` — host-callable; builds (and caches) a jitted
+  shard_map that reduces a whole pytree of per-device gradients.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byteps_tpu.comm.mesh import DP_AXIS
+
+
+def push_pull(
+    x: jax.Array,
+    axis_name: str = DP_AXIS,
+    average: bool = True,
+    mode: str = "psum",
+    axis_size: Optional[int] = None,
+) -> jax.Array:
+    """Traceable all-reduce over a mesh axis.
+
+    ``mode="psum"`` emits one fused all-reduce; ``mode="scatter_gather"``
+    (requires static ``axis_size``) emits reduce-scatter + all-gather
+    explicitly, mirroring the reference's two-phase NCCL strategy
+    (core_loops.cc:232-268) — useful when the scattered form feeds a
+    sharded optimizer (ZeRO-style) so the gather can be deferred.
+    """
+    if mode == "scatter_gather":
+        if not axis_size:
+            raise ValueError("scatter_gather mode needs static axis_size")
+        flat = x.reshape(-1)
+        pad = (-flat.size) % axis_size
+        padded = jnp.pad(flat, (0, pad)) if pad else flat
+        scat = lax.psum_scatter(padded, axis_name, scatter_dimension=0, tiled=True)
+        red = lax.all_gather(scat, axis_name, axis=0, tiled=True)
+        red = red[: flat.size].reshape(x.shape)
+    else:
+        red = lax.psum(x, axis_name)
+    if average:
+        red = red / lax.psum(1, axis_name)
+    return red
+
+
+def reduce_scatter(x: jax.Array, axis_name: str = DP_AXIS, average: bool = True) -> jax.Array:
+    """Traceable reduce-scatter: each member keeps 1/N of the summed tensor
+    (the reference's REDUCE stage output before PUSH, core_loops.cc:232-253).
+    Requires leading dim divisible by the axis size."""
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    if average:
+        out = out / lax.psum(1, axis_name)
+    return out
+
+
+def all_gather(x: jax.Array, axis_name: str = DP_AXIS) -> jax.Array:
+    """Traceable all-gather along dim 0 (BROADCAST stage,
+    core_loops.cc:254-268)."""
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def broadcast(x: jax.Array, axis_name: str = DP_AXIS, root: int = 0) -> jax.Array:
+    """Traceable broadcast from ``root`` along a mesh axis — the primitive
+    under broadcast_parameters (torch/__init__.py:268-299): every member
+    ends with root's value."""
+    idx = lax.axis_index(axis_name)
+    zeroed = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(zeroed, axis_name)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_tree_reducer(mesh: Mesh, average: bool):
+    axes = tuple(ax for ax in (DP_AXIS, "fsdp") if ax in mesh.shape)
+    if not axes:
+        raise ValueError(f"mesh {mesh} has no data-parallel axis")
+
+    def reduce_leaf(g):
+        red = g[0]  # drop the size-1 per-member leading axis
+        for ax in axes:
+            red = lax.psum(red, ax)
+        if average:
+            denom = 1
+            for ax in axes:
+                denom *= mesh.shape[ax]
+            red = red / denom
+        return red
+
+    def reduce_tree(grads):
+        return jax.tree_util.tree_map(reduce_leaf, grads)
+
+    spec_in = P(axes)  # leaves stacked along leading device axis
+    fn = jax.shard_map(
+        reduce_tree,
+        mesh=mesh,
+        in_specs=spec_in,
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def jit_push_pull_tree(grads: Any, mesh: Mesh, average: bool = True) -> Any:
+    """Reduce a pytree of *stacked per-member* gradients: each leaf has a
+    leading axis of size dp; returns the tree with that axis reduced away.
+
+    This is the host-callable analogue of looping push_pull over every
+    gradient (torch/__init__.py:139-158) — except one jitted program reduces
+    the whole tree so XLA can schedule all transfers together.
+    """
+    return _build_tree_reducer(mesh, average)(grads)

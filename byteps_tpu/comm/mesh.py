@@ -1,0 +1,107 @@
+"""Device-mesh construction and axis conventions.
+
+The reference hand-builds a GPU topology (PCIe-switch-scoped NCCL comms,
+nccl_manager.cc:129-165).  On TPU the topology is a logical
+``jax.sharding.Mesh`` and XLA routes collectives over ICI; our job is only
+to pick good logical axes:
+
+    dp  — data parallel (gradient reduction axis; maps to the reference's
+          whole raison d'être)
+    fsdp— optional parameter-sharded DP (zero-style; new scope beyond
+          reference parity, SURVEY §2.7)
+    pp  — pipeline stages
+    tp  — tensor parallel (megatron-style)
+    sp  — sequence/context parallel (ring attention)
+    ep  — expert parallel
+
+``BYTEPS_TPU_MESH`` (e.g. ``"dp:2,tp:4"``) overrides the auto layout, which
+is a single ``dp`` axis over all addressable devices — the reference's pure
+data-parallel topology.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+PP_AXIS = "pp"
+TP_AXIS = "tp"
+SP_AXIS = "sp"
+EP_AXIS = "ep"
+
+_lock = threading.Lock()
+_global_mesh: Optional[Mesh] = None
+
+
+def parse_mesh_spec(spec: str) -> List[Tuple[str, int]]:
+    """Parse ``"dp:2,tp:4"`` into [("dp", 2), ("tp", 4)]."""
+    out: List[Tuple[str, int]] = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, num = item.partition(":")
+        out.append((name.strip(), int(num)))
+    return out
+
+
+def build_mesh(
+    spec: str = "", devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a Mesh from a spec string, defaulting to 1-D data parallel."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not spec:
+        return Mesh(np.array(devices), (DP_AXIS,))
+    axes = parse_mesh_spec(spec)
+    shape = [n for _, n in axes]
+    total = int(np.prod(shape))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh spec {spec!r} wants {total} devices, have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(name for name, _ in axes))
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _global_mesh
+    with _lock:
+        _global_mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    with _lock:
+        return _global_mesh
+
+
+def require_mesh() -> Mesh:
+    m = get_global_mesh()
+    if m is None:
+        raise RuntimeError("byteps_tpu not initialized: call byteps_tpu.init() first")
+    return m
+
+
+def dp_size(mesh: Optional[Mesh] = None) -> int:
+    m = mesh or require_mesh()
+    size = 1
+    for ax in (DP_AXIS, FSDP_AXIS):
+        if ax in m.shape:
+            size *= m.shape[ax]
+    return size
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over every data-ish axis present."""
+    axes = tuple(ax for ax in (DP_AXIS, FSDP_AXIS) if ax in mesh.shape)
+    return NamedSharding(mesh, P(axes if axes else None))

@@ -1,0 +1,246 @@
+"""Worker-side PS client — the KVWorker replacement.
+
+ps-lite surface the core consumes (SURVEY §2.4): zero-copy ``ZPush``/
+``ZPull`` with completion callbacks (core_loops.cc:571,609), key→server
+routing (EncodeDefaultKey, global.cc:628-677), scheduler rendezvous +
+global barrier (global.cc:289-294).
+
+One TCP connection per server; a receiver thread per connection demuxes
+responses by ``seq`` and fires callbacks — the callback thread then drives
+the next pipeline stage, exactly like ps-lite's callback threads drive
+FinishOrProceed.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.hashing import assign_server
+from byteps_tpu.common.types import RequestType, get_command_type
+from byteps_tpu.comm.rendezvous import GROUP_ALL, GROUP_WORKERS
+from byteps_tpu.comm.transport import (
+    Message,
+    Op,
+    connect,
+    recv_message,
+    send_message,
+)
+
+
+class _ServerConn:
+    def __init__(self, host: str, port: int) -> None:
+        self.sock = connect(host, port)
+        self.send_lock = threading.Lock()
+        self.cb_lock = threading.Lock()
+        self.callbacks: Dict[int, Callable[[Message], None]] = {}
+        self.next_seq = 0
+        self.recv_thread: Optional[threading.Thread] = None
+
+    def alloc_seq(self, cb: Callable[[Message], None]) -> int:
+        with self.cb_lock:
+            seq = self.next_seq
+            self.next_seq += 1
+            self.callbacks[seq] = cb
+            return seq
+
+    def pop_cb(self, seq: int) -> Optional[Callable[[Message], None]]:
+        with self.cb_lock:
+            return self.callbacks.pop(seq, None)
+
+
+class PSClient:
+    def __init__(self, cfg: Config) -> None:
+        self.cfg = cfg
+        self.rank: Optional[int] = None
+        self.num_workers = cfg.num_worker
+        self.num_servers = cfg.num_server
+        self._sched: Optional[socket.socket] = None
+        self._sched_lock = threading.Lock()
+        self._sched_cbs: Dict[int, threading.Event] = {}
+        self._sched_cb_lock = threading.Lock()
+        self._sched_seq = 0
+        self._servers: List[_ServerConn] = []
+        self._stop = threading.Event()
+        self.is_recovery = False
+
+    # --- rendezvous ------------------------------------------------------
+
+    def connect(self) -> None:
+        """Register with the scheduler and connect to every server
+        (GetOrInitPS, global.cc:283-297)."""
+        self._sched = connect(self.cfg.ps_root_uri, self.cfg.ps_root_port)
+        send_message(
+            self._sched,
+            Message(
+                Op.REGISTER,
+                payload=pickle.dumps({"role": "worker", "host": "", "port": 0}),
+            ),
+        )
+        book = pickle.loads(recv_message(self._sched).payload)
+        self.rank = book["rank"]
+        self.num_workers = book["num_workers"]
+        self.num_servers = book["num_servers"]
+        self.is_recovery = book.get("is_recovery", False)
+        for host, port in book["servers"]:
+            sc = _ServerConn(host, port)
+            sc.recv_thread = threading.Thread(
+                target=self._recv_loop, args=(sc,), daemon=True
+            )
+            sc.recv_thread.start()
+            self._servers.append(sc)
+        # scheduler receiver for barrier responses
+        t = threading.Thread(target=self._sched_recv_loop, daemon=True)
+        t.start()
+        # global barrier mirrors Postoffice::Barrier at init
+        # (global.cc:289-294; done even on recovery)
+        self.barrier(GROUP_ALL)
+
+    def close(self) -> None:
+        self._stop.set()
+        for sc in self._servers:
+            try:
+                sc.sock.close()
+            except OSError:
+                pass
+        if self._sched is not None:
+            try:
+                self._sched.close()
+            except OSError:
+                pass
+        self._servers = []
+
+    def barrier(self, group: int = GROUP_WORKERS) -> None:
+        with self._sched_cb_lock:
+            seq = self._sched_seq
+            self._sched_seq += 1
+            ev = threading.Event()
+            self._sched_cbs[seq] = ev
+        send_message(
+            self._sched, Message(Op.BARRIER, flags=group, seq=seq), self._sched_lock
+        )
+        ev.wait()
+
+    def _sched_recv_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = recv_message(self._sched)
+            except (ConnectionError, OSError):
+                return
+            with self._sched_cb_lock:
+                ev = self._sched_cbs.pop(msg.seq, None)
+            if ev is not None:
+                ev.set()
+
+    def _recv_loop(self, sc: _ServerConn) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = recv_message(sc.sock)
+            except (ConnectionError, OSError):
+                return
+            cb = sc.pop_cb(msg.seq)
+            if cb is not None:
+                cb(msg)
+
+    # --- key routing -----------------------------------------------------
+
+    def server_for(self, key: int) -> int:
+        return assign_server(
+            key,
+            self.num_servers,
+            fn=self.cfg.key_hash_fn,
+            coef=self.cfg.built_in_hash_coef,
+            mixed_mode=self.cfg.enable_mixed_mode,
+            mixed_bound=self.cfg.mixed_mode_bound,
+            num_workers=self.num_workers,
+        )
+
+    # --- data plane ------------------------------------------------------
+
+    def init_tensor(self, key: int, num_elements: int, dtype_id: int) -> None:
+        """Blocking init-push; doubles as the cross-worker barrier for this
+        key (InitTensor blocking ZPush, operations.cc:283-414)."""
+        sc = self._servers[self.server_for(key)]
+        done = threading.Event()
+        seq = sc.alloc_seq(lambda msg: done.set())
+        send_message(
+            sc.sock,
+            Message(
+                Op.INIT,
+                key=key,
+                seq=seq,
+                payload=pickle.dumps(
+                    {"num_elements": num_elements, "dtype": dtype_id}
+                ),
+            ),
+            sc.send_lock,
+        )
+        done.wait()
+
+    def push(
+        self,
+        key: int,
+        payload: bytes,
+        dtype_id: int,
+        version: int,
+        cb: Callable[[], None],
+        request_type: RequestType = RequestType.DEFAULT_PUSH_PULL,
+    ) -> None:
+        """Async push; ``cb`` fires on server ack (ZPush,
+        core_loops.cc:538-582)."""
+        sc = self._servers[self.server_for(key)]
+        seq = sc.alloc_seq(lambda msg: cb())
+        send_message(
+            sc.sock,
+            Message(
+                Op.PUSH,
+                key=key,
+                seq=seq,
+                payload=payload,
+                cmd=get_command_type(request_type, dtype_id),
+                version=version,
+            ),
+            sc.send_lock,
+        )
+
+    def pull(
+        self,
+        key: int,
+        version: int,
+        cb: Callable[[bytes], None],
+        dtype_id: int = 0,
+        request_type: RequestType = RequestType.DEFAULT_PUSH_PULL,
+    ) -> None:
+        """Async pull; ``cb`` receives the aggregated payload (ZPull,
+        core_loops.cc:584-618)."""
+        sc = self._servers[self.server_for(key)]
+        seq = sc.alloc_seq(lambda msg: cb(msg.payload))
+        send_message(
+            sc.sock,
+            Message(
+                Op.PULL,
+                key=key,
+                seq=seq,
+                cmd=get_command_type(request_type, dtype_id),
+                version=version,
+            ),
+            sc.send_lock,
+        )
+
+    def register_compressor(self, key: int, kwargs: Dict[str, str]) -> None:
+        """Ship compressor config to the owning server
+        (kCompressedPushPull init push, operations.cc:396-408)."""
+        sc = self._servers[self.server_for(key)]
+        done = threading.Event()
+        seq = sc.alloc_seq(lambda msg: done.set())
+        send_message(
+            sc.sock,
+            Message(
+                Op.REGISTER_COMPRESSOR, key=key, seq=seq, payload=pickle.dumps(kwargs)
+            ),
+            sc.send_lock,
+        )
+        done.wait()

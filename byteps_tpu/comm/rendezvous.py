@@ -1,0 +1,164 @@
+"""Scheduler node: registration rendezvous + global barrier service.
+
+Replaces ps-lite's scheduler/Postoffice role (SURVEY §2.4): every worker
+and server REGISTERs at ``DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT``; once the
+expected population (DMLC_NUM_WORKER + DMLC_NUM_SERVER) is present the
+scheduler pushes an ADDRBOOK (per-role rank + server address list) to every
+node, the equivalent of ps::StartPS's rendezvous (global.cc:289-294,
+server.cc:500-509).  Persistent connections then serve BARRIER requests
+(ps::Postoffice::Barrier).
+
+Elastic rejoin: a REGISTER arriving after the population is full replaces
+the node's previous registration and immediately receives the current
+ADDRBOOK, flagged as recovery (is_recovery(), global.cc:291).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from byteps_tpu.comm.transport import (
+    Message,
+    Op,
+    listen,
+    recv_message,
+    send_message,
+)
+
+GROUP_WORKERS = 1
+GROUP_SERVERS = 2
+GROUP_ALL = 3
+
+
+class Scheduler:
+    """Run with role=scheduler (the reference starts it via
+    ``import byteps.server`` with DMLC_ROLE=scheduler,
+    server/__init__.py:21-27)."""
+
+    def __init__(self, num_workers: int, num_servers: int, host: str = "0.0.0.0", port: int = 0):
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        self._sock, self.port = listen(host, port)
+        self._lock = threading.Lock()
+        # role → list of (rank, host, port, conn, send_lock)
+        self._nodes: Dict[str, List] = {"worker": [], "server": []}
+        self._addrbook_sent = False
+        # (group, barrier_round) → list of (conn, send_lock, seq)
+        self._barriers: Dict[Tuple[int, int], List] = {}
+        self._barrier_round: Dict[int, int] = {GROUP_WORKERS: 0, GROUP_SERVERS: 0, GROUP_ALL: 0}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._accept_loop, name="sched-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                msg = recv_message(conn)
+                if msg.op == Op.REGISTER:
+                    self._handle_register(conn, send_lock, msg)
+                elif msg.op == Op.BARRIER:
+                    self._handle_barrier(conn, send_lock, msg)
+                elif msg.op == Op.PING:
+                    send_message(conn, Message(Op.PING, seq=msg.seq), send_lock)
+                elif msg.op == Op.SHUTDOWN:
+                    send_message(conn, Message(Op.SHUTDOWN, seq=msg.seq), send_lock)
+                    return
+        except (ConnectionError, OSError):
+            return
+
+    def _handle_register(self, conn, send_lock, msg: Message) -> None:
+        info = pickle.loads(msg.payload)
+        role = info["role"]
+        recovery = False
+        with self._lock:
+            nodes = self._nodes[role]
+            # elastic rejoin: same role+host+port replaces old entry
+            existing = [
+                n for n in nodes if n[1] == info["host"] and n[2] == info["port"]
+            ]
+            if existing and self._addrbook_sent:
+                rank = existing[0][0]
+                nodes[nodes.index(existing[0])] = (
+                    rank, info["host"], info["port"], conn, send_lock,
+                )
+                recovery = True
+            else:
+                rank = len(nodes)
+                nodes.append((rank, info["host"], info["port"], conn, send_lock))
+            full = (
+                len(self._nodes["worker"]) >= self.num_workers
+                and len(self._nodes["server"]) >= self.num_servers
+            )
+            if recovery:
+                self._send_addrbook_to(conn, send_lock, role, rank, msg.seq, recovery=True)
+                return
+            if full and not self._addrbook_sent:
+                self._addrbook_sent = True
+                for r in ("worker", "server"):
+                    for nrank, _, _, nconn, nlock in self._nodes[r]:
+                        self._send_addrbook_to(nconn, nlock, r, nrank, 0)
+
+    def _send_addrbook_to(self, conn, send_lock, role, rank, seq, recovery=False) -> None:
+        servers = sorted(self._nodes["server"], key=lambda n: n[0])
+        book = {
+            "role": role,
+            "rank": rank,
+            "num_workers": self.num_workers,
+            "num_servers": self.num_servers,
+            "servers": [(h, p) for _, h, p, _, _ in servers],
+            "is_recovery": recovery,
+        }
+        try:
+            send_message(conn, Message(Op.ADDRBOOK, payload=pickle.dumps(book), seq=seq), send_lock)
+        except (ConnectionError, OSError):
+            pass
+
+    def _group_size(self, group: int) -> int:
+        return {
+            GROUP_WORKERS: self.num_workers,
+            GROUP_SERVERS: self.num_servers,
+            GROUP_ALL: self.num_workers + self.num_servers,
+        }[group]
+
+    def _handle_barrier(self, conn, send_lock, msg: Message) -> None:
+        group = msg.flags or GROUP_ALL
+        with self._lock:
+            rnd = self._barrier_round[group]
+            waiters = self._barriers.setdefault((group, rnd), [])
+            waiters.append((conn, send_lock, msg.seq))
+            if len(waiters) >= self._group_size(group):
+                self._barrier_round[group] = rnd + 1
+                del self._barriers[(group, rnd)]
+                for wconn, wlock, wseq in waiters:
+                    try:
+                        send_message(wconn, Message(Op.BARRIER, seq=wseq, flags=group), wlock)
+                    except (ConnectionError, OSError):
+                        pass

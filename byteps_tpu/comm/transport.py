@@ -1,0 +1,137 @@
+"""Framed TCP transport for the PS plane — the ZeroMQ-van replacement.
+
+The reference's inter-host layer is ps-lite's "van" over ZMQ TCP / RDMA /
+UCX (SURVEY §2.4).  The TPU build's DCN transport starts as plain TCP with
+a fixed 32-byte binary header + raw payload (zero-copy into numpy on
+receive); the framing is transport-agnostic so an RDMA-class backend can
+slot in behind the same interface.
+
+Header layout (network byte order):
+
+    u8  magic      0xB5
+    u8  op         Op enum
+    u8  status     0 = OK
+    u8  flags
+    u32 seq        request/response matching id
+    u64 key        partition key
+    u32 cmd        Cantor-encoded (RequestType, DataType) (common.cc:98)
+    u32 version    round / generation
+    u64 length     payload byte count
+"""
+
+from __future__ import annotations
+
+import enum
+import socket
+import struct
+import threading
+from typing import Optional, Tuple
+
+MAGIC = 0xB5
+HEADER_FMT = "!BBBBIQIIQ"
+HEADER_SIZE = struct.calcsize(HEADER_FMT)
+assert HEADER_SIZE == 32
+
+
+class Op(enum.IntEnum):
+    # scheduler plane (ps-lite Postoffice equivalents)
+    REGISTER = 1      # node → scheduler: {role, host, port}
+    ADDRBOOK = 2      # scheduler → nodes: {rank, servers: [(host, port)]}
+    BARRIER = 3       # node → scheduler; response released when group full
+    # data plane (KVWorker/KVServer equivalents)
+    INIT = 10         # declare key storage; response is the init barrier
+    PUSH = 11         # gradient payload; response = ack
+    PULL = 12         # request payload; response = aggregated bytes
+    REGISTER_COMPRESSOR = 13  # serialized compressor kwargs (operations.cc:396-408)
+    # control
+    PING = 20
+    SHUTDOWN = 21
+
+
+class Message:
+    __slots__ = ("op", "status", "flags", "seq", "key", "cmd", "version", "payload")
+
+    def __init__(
+        self,
+        op: Op,
+        key: int = 0,
+        payload: bytes = b"",
+        seq: int = 0,
+        cmd: int = 0,
+        version: int = 0,
+        status: int = 0,
+        flags: int = 0,
+    ) -> None:
+        self.op = op
+        self.status = status
+        self.flags = flags
+        self.seq = seq
+        self.key = key
+        self.cmd = cmd
+        self.version = version
+        self.payload = payload
+
+    def encode(self) -> bytes:
+        hdr = struct.pack(
+            HEADER_FMT,
+            MAGIC,
+            int(self.op),
+            self.status,
+            self.flags,
+            self.seq,
+            self.key,
+            self.cmd,
+            self.version,
+            len(self.payload),
+        )
+        return hdr + self.payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+    return bytes(buf)
+
+
+def recv_message(sock: socket.socket) -> Message:
+    hdr = _recv_exact(sock, HEADER_SIZE)
+    magic, op, status, flags, seq, key, cmd, version, length = struct.unpack(
+        HEADER_FMT, hdr
+    )
+    if magic != MAGIC:
+        raise ConnectionError(f"bad magic {magic:#x}")
+    payload = _recv_exact(sock, length) if length else b""
+    return Message(
+        Op(op), key=key, payload=payload, seq=seq, cmd=cmd, version=version,
+        status=status, flags=flags,
+    )
+
+
+def send_message(sock: socket.socket, msg: Message, lock: Optional[threading.Lock] = None) -> None:
+    data = msg.encode()
+    if lock is not None:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def listen(host: str = "0.0.0.0", port: int = 0) -> Tuple[socket.socket, int]:
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(128)
+    return srv, srv.getsockname()[1]

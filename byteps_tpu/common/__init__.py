@@ -1,0 +1,6 @@
+"""Core types, configuration, and tensor bookkeeping.
+
+TPU-native equivalent of the reference's byteps/common/{common.h,global.cc}
+layer: dtype table, pipeline stage enum, named-tensor registry with stable
+key assignment, the partitioner, and the env-var config system.
+"""

@@ -1,0 +1,175 @@
+"""Environment-variable configuration system.
+
+The reference is configured purely through env vars (docs/env.md; SURVEY §5.6)
+— no config files, no argparse in the core.  We keep the same knob names where
+they still make sense on TPU, add TPU-specific ones under the same prefix,
+and expose everything as one typed, reloadable ``Config`` object.
+
+Reference consumption points cited per field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return v.lower() not in ("0", "false", "no", "off")
+
+
+def _env_str(name: str, default: str) -> str:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+@dataclasses.dataclass
+class Config:
+    """Process-wide configuration snapshot.
+
+    Call :func:`get_config` for the cached instance; :func:`reset_config`
+    re-reads the environment (used by elastic ``resume()`` which rewrites
+    DMLC_* env before re-init, common/__init__.py:75-82 in the reference).
+    """
+
+    # --- topology (DMLC_*, docs/env.md:1-37) ---
+    role: str = "worker"  # worker | server | scheduler | joint
+    num_worker: int = 1
+    num_server: int = 0
+    worker_id: int = 0
+    ps_root_uri: str = "127.0.0.1"
+    ps_root_port: int = 9000
+    node_host: str = ""
+
+    # --- local identity (communicator.cc:67-83) ---
+    local_rank: int = 0
+    local_size: int = 1
+    global_rank: Optional[int] = None
+
+    # --- pipeline tuning ---
+    partition_bytes: int = 4096000  # BYTEPS_PARTITION_BYTES (global.cc:42,134)
+    scheduling_credit: int = 0  # BYTEPS_SCHEDULING_CREDIT (scheduled_queue.cc:35); 0 = unlimited
+    min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES (global.cc:43,137)
+    threadpool_size: int = 4  # BYTEPS_THREADPOOL_SIZE (global.cc:216)
+
+    # --- key→server sharding (global.cc:158-180, 566-677) ---
+    key_hash_fn: str = "djb2"  # naive | built_in | djb2 | sdbm | mixed
+    enable_mixed_mode: bool = False
+    mixed_mode_bound: int = 101  # global.cc:576-578 default
+    built_in_hash_coef: int = 1
+
+    # --- server (server.cc:412-456) ---
+    server_engine_threads: int = 4  # BYTEPS_SERVER_ENGINE_THREAD
+    server_enable_schedule: bool = False  # BYTEPS_SERVER_ENABLE_SCHEDULE
+    enable_async: bool = False  # BYTEPS_ENABLE_ASYNC
+
+    # --- debug / trace (global.cc:113-124) ---
+    log_level: str = "WARNING"
+    trace_on: bool = False
+    trace_start_step: int = 10
+    trace_end_step: int = 20
+    trace_dir: str = "."
+    telemetry_on: bool = False
+    force_distributed: bool = False  # BYTEPS_FORCE_DISTRIBUTED (global.cc:149-152)
+    debug_sample_tensor: str = ""
+
+    # --- TPU-native additions (no reference analogue) ---
+    mesh_shape: str = ""  # e.g. "dp:8" or "dp:4,tp:2" — override auto mesh
+    ici_reduce: str = "scatter_gather"  # scatter_gather | psum
+    compression_device: str = "auto"  # auto | device | host
+
+    @property
+    def size(self) -> int:
+        return self.num_worker
+
+    @property
+    def is_distributed(self) -> bool:
+        """Distributed mode engages the PS path (global.cc:149-152): more
+        than one worker, or BYTEPS_FORCE_DISTRIBUTED for the single-worker
+        fake-cluster test topology."""
+        return self.num_worker > 1 or self.force_distributed
+
+    @property
+    def is_root(self) -> bool:
+        """Local root does the PS networking (global.cc:286-287).  The
+        reference picks the *highest* local rank as root
+        (communicator.cc:94)."""
+        return self.local_rank == self.local_size - 1
+
+    @staticmethod
+    def from_env() -> "Config":
+        return Config(
+            role=_env_str("DMLC_ROLE", "worker"),
+            num_worker=_env_int("DMLC_NUM_WORKER", 1),
+            num_server=_env_int("DMLC_NUM_SERVER", 0),
+            worker_id=_env_int("DMLC_WORKER_ID", 0),
+            ps_root_uri=_env_str("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            ps_root_port=_env_int("DMLC_PS_ROOT_PORT", 9000),
+            node_host=_env_str("DMLC_NODE_HOST", ""),
+            local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
+            local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
+            global_rank=(
+                int(os.environ["BYTEPS_GLOBAL_RANK"])
+                if os.environ.get("BYTEPS_GLOBAL_RANK")
+                else None
+            ),
+            partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4096000),
+            scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
+            min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            threadpool_size=_env_int("BYTEPS_THREADPOOL_SIZE", 4),
+            key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
+            enable_mixed_mode=_env_bool("BYTEPS_ENABLE_MIXED_MODE"),
+            mixed_mode_bound=_env_int("BYTEPS_MIXED_MODE_BOUND", 101),
+            built_in_hash_coef=_env_int("BYTEPS_BUILT_IN_HASH_COEF", 1),
+            server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
+            server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
+            enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+            log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
+            trace_on=_env_bool("BYTEPS_TRACE_ON"),
+            trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
+            trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
+            trace_dir=_env_str("BYTEPS_TRACE_DIR", "."),
+            telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON"),
+            force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
+            debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            mesh_shape=_env_str("BYTEPS_TPU_MESH", ""),
+            ici_reduce=_env_str("BYTEPS_TPU_ICI_REDUCE", "scatter_gather"),
+            compression_device=_env_str("BYTEPS_TPU_COMPRESSION_DEVICE", "auto"),
+        )
+
+
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config.from_env()
+    return _config
+
+
+def reset_config() -> Config:
+    """Re-read the environment (elastic resume path)."""
+    global _config
+    _config = Config.from_env()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    global _config
+    _config = cfg
+
+
+def clear_config() -> None:
+    """Drop the cached snapshot; the next get_config() re-reads env."""
+    global _config
+    _config = None

@@ -1,0 +1,137 @@
+"""Key→server assignment.
+
+Behavioral parity with the reference's server-sharding hash functions
+(global.cc:566-677): ``naive``, ``built_in``, ``djb2``, ``sdbm``, and
+``mixed`` mode (BYTEPS_ENABLE_MIXED_MODE) which splits keys between
+non-colocated (dedicated) servers and servers colocated with workers using
+a load-ratio threshold.
+
+The string-hash variants hash the *decimal string* of the key
+(global.cc:606-627) so distribution properties match the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+_HASH_FNS: Dict[str, Callable[[int, int], int]] = {}
+
+
+def _register(name: str):
+    def deco(fn):
+        _HASH_FNS[name] = fn
+        return fn
+
+    return deco
+
+
+@_register("naive")
+def hash_naive(key: int, coef: int = 1) -> int:
+    # Hash_Naive (global.cc:598-600): fold the partition index into the
+    # declared-key half before scaling, so key ranges (declared_key<<16)
+    # don't all collapse to the same residue.
+    return (((key >> 16) + (key % 65536)) * 9973) & _MASK64
+
+
+@_register("built_in")
+def hash_built_in(key: int, coef: int = 1) -> int:
+    # Hash_BuiltIn (global.cc:601-604): std::hash<std::string> over str(key)
+    # scaled by BYTEPS_BUILT_IN_HASH_COEF.  Python's hash() is salted; we use
+    # a stable FNV-1a over the decimal string (the common libstdc++
+    # implementation family) so results are reproducible across processes.
+    h = 0xCBF29CE484222325
+    for ch in str(key).encode():
+        h ^= ch
+        h = (h * 0x100000001B3) & _MASK64
+    return (h * coef) & _MASK64
+
+
+@_register("djb2")
+def hash_djb2(key: int, coef: int = 1) -> int:
+    # Hash_DJB2 (global.cc:606-616)
+    h = 5381
+    for ch in str(key).encode():
+        h = ((h << 5) + h + ch) & _MASK64
+    return h
+
+
+@_register("sdbm")
+def hash_sdbm(key: int, coef: int = 1) -> int:
+    # Hash_SDBM (global.cc:618-627)
+    h = 0
+    for ch in str(key).encode():
+        h = (ch + (h << 6) + (h << 16) - h) & _MASK64
+    return h
+
+
+def hash_mixed_mode(
+    key: int, num_servers: int, num_workers: int, bound: int = 101
+) -> int:
+    """Hash_Mixed_Mode (global.cc:566-596).
+
+    The first ``num_servers - num_workers`` server ranks are dedicated
+    (non-colocated) servers; the rest are colocated with workers.  A
+    load-balance ratio decides what fraction of the key space the dedicated
+    servers absorb:
+
+        ratio = 2·s·(w−1) / (w·(w+s) − 2·s)   with s = dedicated, w = workers
+
+    Keys whose ``djb2(key) % bound`` falls below ``ratio·bound`` go to a
+    dedicated server, the rest to colocated ones.
+    """
+    noncolo = num_servers - num_workers
+    colo = num_workers
+    if noncolo <= 0:
+        raise ValueError("mixed mode needs more servers than workers")
+    if bound < num_servers:
+        raise ValueError(
+            f"BYTEPS_MIXED_MODE_BOUND ({bound}) must be >= num_servers "
+            f"({num_servers}) to cover each server"
+        )
+    ratio = (2.0 * noncolo * (num_workers - 1)) / (
+        num_workers * (num_workers + noncolo) - 2 * noncolo
+    )
+    if not (0.0 <= ratio <= 1.0):
+        raise ValueError(
+            "more non-colocated servers than workers is not permitted in "
+            "mixed mode (ratio out of [0,1])"
+        )
+    threshold = ratio * bound
+    hash_res = hash_djb2(key) % bound
+    if hash_res < threshold:
+        return hash_djb2(hash_res) % noncolo
+    return noncolo + (hash_djb2(hash_res) % colo)
+
+
+def assign_server(
+    key: int,
+    num_servers: int,
+    fn: str = "djb2",
+    coef: int = 1,
+    mixed_mode: bool = False,
+    mixed_bound: int = 101,
+    num_workers: int = 1,
+) -> int:
+    """Map a partition key to a server rank (EncodeDefaultKey,
+    global.cc:628-677)."""
+    if num_servers <= 0:
+        raise ValueError("num_servers must be positive")
+    if mixed_mode or fn == "mixed":
+        return hash_mixed_mode(key, num_servers, num_workers, mixed_bound)
+    if fn not in _HASH_FNS:
+        raise ValueError(
+            f"unsupported BYTEPS_KEY_HASH_FN {fn!r}; "
+            "must be one of [naive, built_in, djb2, sdbm, mixed]"
+        )
+    return _HASH_FNS[fn](key, coef) % num_servers
+
+
+def server_load(keys: List[int], num_servers: int, **kw) -> List[int]:
+    """Per-server key counts, for the load-balance logging the reference
+    emits at init (global.cc:660-667)."""
+    load = [0] * num_servers
+    for k in keys:
+        load[assign_server(k, num_servers, **kw)] += 1
+    return load

@@ -1,0 +1,73 @@
+"""Tensor partitioner.
+
+Splits a flat tensor into contiguous element-range partitions of at most
+``BYTEPS_PARTITION_BYTES`` bytes each, assigning each partition its own
+communication key (PartitionTensor, operations.cc:140-180, 306-317).
+
+Partitioning serves two purposes in the reference and both carry to TPU:
+1. load-balancing keys across PS servers (key→server hashing, SURVEY §2.1);
+2. pipelining — a large gradient's partitions flow through
+   copy/compress/push/pull stages independently, overlapping transport with
+   reduction.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from byteps_tpu.common.types import Partition, align
+from byteps_tpu.common.registry import MAX_PARTS_PER_TENSOR, TensorContext
+
+
+def partition_elements(
+    num_elements: int, itemsize: int, partition_bytes: int, alignment: int = 64
+) -> List[tuple]:
+    """Return [(offset, length), ...] element ranges.
+
+    Partition length is rounded so each partition's byte size (except the
+    last) is ``partition_bytes`` rounded *down* to an ``alignment``-byte
+    multiple — keeps every partition start aligned for vectorized host
+    reducers (the reference page-aligns its shm slices, common.h:281-285).
+    """
+    if num_elements == 0:
+        return []
+    per_part = max(1, partition_bytes // itemsize)
+    # keep partition boundaries aligned in bytes
+    elems_per_align = max(1, alignment // itemsize)
+    if per_part > elems_per_align:
+        per_part = (per_part // elems_per_align) * elems_per_align
+    parts = []
+    off = 0
+    while off < num_elements:
+        ln = min(per_part, num_elements - off)
+        parts.append((off, ln))
+        off += ln
+    if len(parts) > MAX_PARTS_PER_TENSOR:
+        raise ValueError(
+            f"{len(parts)} partitions exceeds the 2^16 key range per tensor "
+            f"(operations.cc:306); raise BYTEPS_PARTITION_BYTES"
+        )
+    return parts
+
+
+def partition_tensor(
+    ctx: TensorContext, num_elements: int, itemsize: int, partition_bytes: int
+) -> List[Partition]:
+    """Build keyed partitions for a declared tensor and record them on the
+    context (operations.cc:140-180)."""
+    ranges = partition_elements(num_elements, itemsize, partition_bytes)
+    parts = [
+        Partition(key=ctx.key_for_part(i), offset=off, length=ln)
+        for i, (off, ln) in enumerate(ranges)
+    ]
+    ctx.num_elements = num_elements
+    ctx.partitions = parts
+    return parts
+
+
+def flatten_for_comm(arr: np.ndarray) -> np.ndarray:
+    """Flatten to 1-D without copy when possible; the comm plane works on
+    flat element ranges (the reference communicates raw byte buffers)."""
+    return np.ascontiguousarray(arr).reshape(-1)

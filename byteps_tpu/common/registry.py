@@ -1,0 +1,136 @@
+"""Named-tensor registry with stable key assignment.
+
+TPU-native equivalent of the reference's tensor declaration machinery
+(global.cc:412-436, operations.cc:283-317):
+
+- every communicated tensor is *declared* by name, receiving a monotonically
+  increasing ``declared_key``;
+- the key range ``declared_key << 16`` leaves room for up to 2^16 partitions
+  per tensor (operations.cc:306);
+- ``redeclare_all()`` replays declarations in original order so key
+  assignment is stable across elastic suspend/resume generations
+  (ReDeclareTensor, global.cc:431-436).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+from byteps_tpu.common.types import DataType, Partition
+
+MAX_PARTS_PER_TENSOR = 1 << 16
+
+
+@dataclasses.dataclass
+class TensorContext:
+    """Per-declared-tensor state (``BPSContext``, common.h:177-205)."""
+
+    name: str
+    declared_key: int
+    dtype: Optional[DataType] = None
+    num_elements: int = 0
+    partitions: List[Partition] = dataclasses.field(default_factory=list)
+    initialized: bool = False
+    # compression kwargs attached at declare time
+    # (ops.py:82-120 in the mxnet plugin; RegisterCompressor global.cc:438-445)
+    kwargs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # profiling attachment points (SURVEY §5.1)
+    version: int = 0
+
+    @property
+    def base_key(self) -> int:
+        return self.declared_key << 16
+
+    def key_for_part(self, i: int) -> int:
+        if i >= MAX_PARTS_PER_TENSOR:
+            raise ValueError(
+                f"tensor {self.name!r} would need partition index {i} "
+                f">= {MAX_PARTS_PER_TENSOR}"
+            )
+        return self.base_key + i
+
+
+class TensorRegistry:
+    """Thread-safe name→context table with stable key replay."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._contexts: Dict[str, TensorContext] = {}
+        self._order: List[str] = []  # declaration order for redeclare
+        self._next_key = 0
+
+    def is_declared(self, name: str) -> bool:
+        with self._lock:
+            return name in self._contexts
+
+    def declare(self, name: str, **kwargs: str) -> TensorContext:
+        """Declare (or fetch) a named tensor (IsTensorDeclared +
+        DeclareTensor, global.cc:412-429)."""
+        with self._lock:
+            ctx = self._contexts.get(name)
+            if ctx is not None:
+                if kwargs:
+                    ctx.kwargs.update(kwargs)
+                return ctx
+            ctx = TensorContext(name=name, declared_key=self._next_key, kwargs=dict(kwargs))
+            self._next_key += 1
+            self._contexts[name] = ctx
+            self._order.append(name)
+            return ctx
+
+    def get(self, name: str) -> TensorContext:
+        with self._lock:
+            return self._contexts[name]
+
+    def contexts_in_order(self) -> List[TensorContext]:
+        with self._lock:
+            return [self._contexts[n] for n in self._order]
+
+    def redeclare_all(self) -> None:
+        """Replay declarations in original order after an elastic resume so
+        every generation assigns identical keys (global.cc:431-436).  Clears
+        runtime state (partitions, init flags) but preserves name→key."""
+        with self._lock:
+            order = list(self._order)
+            old = self._contexts
+            self._contexts = {}
+            self._next_key = 0
+            for name in order:
+                prev = old[name]
+                ctx = TensorContext(
+                    name=name, declared_key=self._next_key, kwargs=dict(prev.kwargs)
+                )
+                self._next_key += 1
+                self._contexts[name] = ctx
+            self._order = order
+
+    def clear(self) -> None:
+        with self._lock:
+            self._contexts.clear()
+            self._order.clear()
+            self._next_key = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._contexts)
+
+
+_registry: Optional[TensorRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> TensorRegistry:
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = TensorRegistry()
+        return _registry
+
+
+def reset_registry() -> TensorRegistry:
+    global _registry
+    with _registry_lock:
+        _registry = TensorRegistry()
+        return _registry

@@ -1,0 +1,226 @@
+"""Core enums and small value types.
+
+TPU-native re-design of the reference's byteps/common/common.h:
+- ``DataType``       (common.h:59-72, mshadow-ordered dtype enum)
+- ``QueueType``      (common.h:88-102, the 12 pipeline stages)
+- ``RequestType``    (common.h:267-271)
+- ``Status``         (common.h:108-160 equivalent)
+- ``TensorTableEntry`` task struct (common.h:221-264)
+- Cantor-pairing command encoding (common.cc:98)
+- ``align()``        (common.h:281-285)
+
+On TPU the device-side stages (NCCL reduce/broadcast, CUDA copies) collapse
+into XLA-compiled collectives, but the *host* pipeline for the PS path keeps
+the same staged structure so priority scheduling, tracing, and compression
+have well-defined attachment points.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    """Wire dtype ids, mshadow-ordered for parity (common.h:59-72)."""
+
+    FLOAT32 = 0
+    FLOAT64 = 1
+    FLOAT16 = 2
+    UINT8 = 3
+    INT32 = 4
+    INT8 = 5
+    INT64 = 6
+    # TPU-native addition: bfloat16 is the native accumulation-friendly
+    # 16-bit type on the MXU; the reference has no bf16 (CUDA-era fp16 only).
+    BFLOAT16 = 7
+
+
+_NP_TO_DT = {
+    np.dtype(np.float32): DataType.FLOAT32,
+    np.dtype(np.float64): DataType.FLOAT64,
+    np.dtype(np.float16): DataType.FLOAT16,
+    np.dtype(np.uint8): DataType.UINT8,
+    np.dtype(np.int32): DataType.INT32,
+    np.dtype(np.int8): DataType.INT8,
+    np.dtype(np.int64): DataType.INT64,
+}
+
+_DT_TO_NP = {v: k for k, v in _NP_TO_DT.items()}
+
+_DT_SIZE = {
+    DataType.FLOAT32: 4,
+    DataType.FLOAT64: 8,
+    DataType.FLOAT16: 2,
+    DataType.UINT8: 1,
+    DataType.INT32: 4,
+    DataType.INT8: 1,
+    DataType.INT64: 8,
+    DataType.BFLOAT16: 2,
+}
+
+
+def to_datatype(dtype: Any) -> DataType:
+    """Map a numpy/jax dtype to the wire ``DataType``."""
+    name = np.dtype(dtype).name if not str(dtype) == "bfloat16" else "bfloat16"
+    if name == "bfloat16":
+        return DataType.BFLOAT16
+    try:
+        return _NP_TO_DT[np.dtype(dtype)]
+    except KeyError as e:
+        raise TypeError(f"unsupported dtype: {dtype!r}") from e
+
+
+def dtype_size(dt: DataType) -> int:
+    """Bytes per element (common.cc:23-47 equivalent)."""
+    return _DT_SIZE[dt]
+
+
+def to_numpy_dtype(dt: DataType) -> np.dtype:
+    if dt == DataType.BFLOAT16:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return _DT_TO_NP[dt]
+
+
+class QueueType(enum.IntEnum):
+    """Host pipeline stages, mirroring the reference's 12-stage enum
+    (common.h:88-102).  On TPU:
+
+    - REDUCE / BROADCAST are XLA reduce-scatter / all-gather over ICI
+      (compiled, not host-threaded) in the pure-collective path, but remain
+      explicit host stages in the PS path where only a shard per host goes
+      over DCN.
+    - PCIE_REDUCE has no TPU analogue (no PCIe switch hierarchy); it is kept
+      in the enum for wire/trace parity but never scheduled.
+    - COPYD2H / COPYH2D are jax device_get/device_put of the host shard.
+    """
+
+    COORDINATE_REDUCE = 0
+    REDUCE = 1
+    COPYD2H = 2
+    PCIE_REDUCE = 3
+    COMPRESS = 4
+    PUSH = 5
+    PULL = 6
+    DECOMPRESS = 7
+    COPYH2D = 8
+    COORDINATE_PUSH = 9
+    COORDINATE_BROADCAST = 10
+    BROADCAST = 11
+
+
+QUEUE_NUM = len(QueueType)
+
+
+class RequestType(enum.IntEnum):
+    """PS request flavors (common.h:267-271)."""
+
+    DEFAULT_PUSH_PULL = 0
+    ROW_SPARSE_PUSH_PULL = 1
+    COMPRESSED_PUSH_PULL = 2
+
+
+def get_command_type(requestType: RequestType, dtype: int) -> int:
+    """Cantor pairing of (request, dtype) → command id (common.cc:98)."""
+    a = int(requestType)
+    b = int(dtype)
+    return (a + b) * (a + b + 1) // 2 + b
+
+
+def decode_command_type(cmd: int) -> tuple[RequestType, int]:
+    """Inverse Cantor pairing (server-side decode, server.cc:205-230)."""
+    w = int(((8 * cmd + 1) ** 0.5 - 1) / 2)
+    t = w * (w + 1) // 2
+    b = cmd - t
+    a = w - b
+    return RequestType(a), b
+
+
+class StatusType(enum.IntEnum):
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclasses.dataclass
+class Status:
+    """Operation status (common.h:108-160)."""
+
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def InProgress() -> "Status":
+        return Status(StatusType.IN_PROGRESS)
+
+    @staticmethod
+    def Aborted(msg: str) -> "Status":
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def PreconditionError(msg: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    def ok(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+
+ALIGN_BYTES = 64
+
+
+def align(size: int, alignment: int = ALIGN_BYTES) -> int:
+    """Round ``size`` up to a multiple of ``alignment`` (common.h:281-285).
+
+    The reference aligns shm buffers for AVX loads; we keep 64B alignment so
+    host-side C++ reducers can use full-width vector loads.
+    """
+    return ((size + alignment - 1) // alignment) * alignment
+
+
+@dataclasses.dataclass
+class Partition:
+    """One partition of a declared tensor: a contiguous [offset, offset+length)
+    element range assigned its own communication key (operations.cc:306-317)."""
+
+    key: int
+    offset: int  # element offset into the flat tensor
+    length: int  # element count
+
+
+@dataclasses.dataclass
+class TensorTableEntry:
+    """One in-flight communication task for one partition
+    (common.h:221-264).  Host-engine unit of scheduling."""
+
+    tensor_name: str
+    key: int
+    priority: int = 0
+    version: int = 0
+    offset: int = 0
+    length: int = 0
+    total_partnum: int = 1
+    queue_list: list = dataclasses.field(default_factory=list)
+    # host staging buffer (numpy view of the partition)
+    cpubuff: Optional[np.ndarray] = None
+    # compressed payload, set by the COMPRESS stage
+    compressed: Optional[bytes] = None
+    callback: Optional[Callable[[Status], None]] = None
+    context: Any = None
+
+    def current_stage(self) -> Optional[QueueType]:
+        return self.queue_list[0] if self.queue_list else None

@@ -1,0 +1,8 @@
+"""Host-side runtime: global state, priority scheduler, pipeline engine,
+ready-table rendezvous, telemetry, and tracing.
+
+TPU re-design of the reference's C++ core (byteps/common/{global,core_loops,
+scheduled_queue,ready_table}.cc).  The device data plane is XLA-compiled;
+what remains host-side is exactly what XLA cannot see: the DCN PS hop, its
+staging copies, compression, and priority ordering.
+"""

@@ -1,0 +1,262 @@
+"""Worker-side host pipeline engine.
+
+Re-design of the reference's stage loops (core_loops.cc) for the TPU PS
+path.  On GPU the pipeline is COORDINATE→REDUCE→COPYD2H→PUSH→PULL→COPYH2D→
+BROADCAST with NCCL + CUDA events; on TPU the intra-slice REDUCE/BROADCAST
+are XLA collectives inside the jitted step, so the *host* pipeline is:
+
+    COPYD2H  (device→host staging of the host-shard)
+    COMPRESS (optional, spliced when a compressor is registered —
+              operations.cc:199-204)
+    PUSH     (DCN → PS server, priority-scheduled)
+    PULL     (DCN ← PS server)
+    DECOMPRESS
+    COPYH2D  (host→device, then the caller's next step consumes it)
+
+Each stage is a ScheduledQueue + worker thread; PUSH/PULL completion is
+driven by PS-client callbacks, mirroring how ps-lite callbacks drive
+``FinishOrProceed`` (core_loops.cc:31-137).  Priority order means small,
+front-of-model gradients overtake bulky back-of-model ones — BytePS's
+scheduling core idea.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.partition import partition_tensor
+from byteps_tpu.common.registry import get_registry
+from byteps_tpu.common.types import (
+    QueueType,
+    Status,
+    TensorTableEntry,
+    to_datatype,
+)
+from byteps_tpu.core.scheduler import ScheduledQueue
+
+
+class _Job:
+    """One push_pull invocation: shared state across its partitions."""
+
+    __slots__ = (
+        "name", "ctx", "flat", "result", "dtype_id", "average", "handle",
+        "pending", "lock", "shape", "np_dtype", "is_jax", "version", "t0",
+    )
+
+    def __init__(self, name, ctx, flat, result, dtype_id, average, handle,
+                 pending, shape, np_dtype, is_jax, version):
+        self.name = name
+        self.ctx = ctx
+        self.flat = flat
+        self.result = result
+        self.dtype_id = dtype_id
+        self.average = average
+        self.handle = handle
+        self.pending = pending
+        self.lock = threading.Lock()
+        self.shape = shape
+        self.np_dtype = np_dtype
+        self.is_jax = is_jax
+        self.version = version
+        self.t0 = time.time()
+
+
+class PipelineEngine:
+    #: host pipeline stage order (PS path); COMPRESS/DECOMPRESS spliced in
+    #: when the tensor has a registered compressor (operations.cc:199-204)
+    STAGES = [QueueType.COPYD2H, QueueType.PUSH, QueueType.PULL, QueueType.COPYH2D]
+
+    def __init__(self, cfg: Config, ps_client, telemetry=None, tracer=None) -> None:
+        self.cfg = cfg
+        self.client = ps_client
+        self.telemetry = telemetry
+        self.tracer = tracer
+        self._stop = threading.Event()
+        credit = cfg.scheduling_credit
+        self.queues: Dict[QueueType, ScheduledQueue] = {
+            QueueType.COPYD2H: ScheduledQueue(QueueType.COPYD2H),
+            QueueType.PUSH: ScheduledQueue(QueueType.PUSH, credit_bytes=credit),
+            QueueType.PULL: ScheduledQueue(QueueType.PULL),
+            QueueType.COPYH2D: ScheduledQueue(QueueType.COPYH2D),
+        }
+        self._threads: List[threading.Thread] = []
+        self._init_lock = threading.Lock()
+
+    # --- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn one loop thread per host stage (BytePSGlobal::Start,
+        global.cc:299-317)."""
+        for qt, fn in (
+            (QueueType.COPYD2H, self._copy_d2h_once),
+            (QueueType.PUSH, self._push_once),
+            (QueueType.PULL, self._pull_once),
+            (QueueType.COPYH2D, self._copy_h2d_once),
+        ):
+            t = threading.Thread(
+                target=self._loop, args=(qt, fn), name=f"bps-{qt.name}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+
+    def _loop(self, qt: QueueType, fn) -> None:
+        q = self.queues[qt]
+        while not self._stop.is_set():
+            task = q.get_task(timeout=0.2)
+            if task is None:
+                continue
+            try:
+                fn(task)
+            except Exception as e:  # surface errors on the handle
+                job: _Job = task.context
+                job_status = Status.Aborted(f"{qt.name}: {e!r}")
+                self._fail_job(job, job_status)
+
+    # --- submission ------------------------------------------------------
+
+    def submit(
+        self,
+        name: str,
+        tensor: np.ndarray,
+        average: bool,
+        priority: int,
+        version: int,
+        handle: int,
+        original: Any = None,
+    ) -> None:
+        """EnqueueTensor equivalent (operations.cc:182-281): lazily init the
+        tensor (key range + server-side allocation barrier), partition, and
+        drop every partition into the first stage queue."""
+        from byteps_tpu.core.state import get_state
+
+        registry = get_registry()
+        ctx = registry.declare(name)
+        flat = np.ascontiguousarray(tensor).reshape(-1)
+        dtype_id = int(to_datatype(flat.dtype))
+
+        with self._init_lock:
+            if not ctx.initialized:
+                partition_tensor(
+                    ctx, flat.size, flat.itemsize, self.cfg.partition_bytes
+                )
+                for part in ctx.partitions:
+                    # blocking init-push doubles as the cross-worker barrier
+                    # for the key (operations.cc:283-414)
+                    self.client.init_tensor(part.key, part.length, dtype_id)
+                if ctx.kwargs.get("compressor"):
+                    for part in ctx.partitions:
+                        self.client.register_compressor(part.key, ctx.kwargs)
+                ctx.initialized = True
+
+        ctx.version += 1
+        result = np.empty_like(flat)
+        is_jax = original is not None and not isinstance(original, np.ndarray)
+        job = _Job(
+            name, ctx, flat, result, dtype_id, average, handle,
+            pending=len(ctx.partitions), shape=np.shape(tensor),
+            np_dtype=flat.dtype, is_jax=is_jax, version=ctx.version,
+        )
+        for part in ctx.partitions:
+            task = TensorTableEntry(
+                tensor_name=name,
+                key=part.key,
+                priority=priority,
+                version=ctx.version,
+                offset=part.offset,
+                length=part.length,
+                total_partnum=len(ctx.partitions),
+                queue_list=list(self.STAGES),
+                context=job,
+            )
+            self.queues[QueueType.COPYD2H].add_task(task)
+
+    # --- stage bodies ----------------------------------------------------
+
+    def _proceed(self, task: TensorTableEntry) -> None:
+        """FinishOrProceed (core_loops.cc:31-137): stamp the finished stage,
+        advance to the next queue or finish the partition."""
+        finished = task.queue_list.pop(0)
+        job: _Job = task.context
+        if self.tracer is not None:
+            self.tracer.record(
+                job.name, finished.name, job.t0, time.time() - job.t0, job.version
+            )
+        self.queues[finished].report_finish(task)
+        if task.queue_list:
+            self.queues[task.queue_list[0]].add_task(task)
+            return
+        with job.lock:
+            job.pending -= 1
+            done = job.pending == 0
+        if done:
+            self._finalize(job)
+
+    def _fail_job(self, job: _Job, status: Status) -> None:
+        from byteps_tpu.core.state import get_state
+
+        get_state().handles.mark_done(job.handle, None, status)
+
+    def _finalize(self, job: _Job) -> None:
+        """All partitions done: average (the plugin-side div by size,
+        torch/ops.cc:78-91), reshape, hand back."""
+        from byteps_tpu.core.state import get_state
+
+        out = job.result
+        if job.average and np.issubdtype(job.np_dtype, np.floating):
+            out = out / self.client.num_workers
+        out = out.reshape(job.shape)
+        if job.is_jax:
+            import jax.numpy as jnp
+
+            out = jnp.asarray(out)
+        get_state().handles.mark_done(job.handle, out)
+
+    def _copy_d2h_once(self, task: TensorTableEntry) -> None:
+        """Stage the partition's bytes for the wire (COPYD2H,
+        core_loops.cc:378-443).  Input tensors are already host numpy (the
+        API materializes device arrays); this slices the partition view."""
+        job: _Job = task.context
+        task.cpubuff = job.flat[task.offset : task.offset + task.length]
+        self._proceed(task)
+
+    def _push_once(self, task: TensorTableEntry) -> None:
+        """Priority-ordered ZPush (RunPushLoopOnce, core_loops.cc:538-582)."""
+        job: _Job = task.context
+        payload = task.cpubuff.tobytes()
+        if self.telemetry is not None:
+            self.telemetry.record(len(payload))
+        self.client.push(
+            task.key, payload, job.dtype_id, task.version,
+            cb=lambda: self._proceed(task),
+        )
+
+    def _pull_once(self, task: TensorTableEntry) -> None:
+        """ZPull into the result buffer (RunPullLoopOnce,
+        core_loops.cc:584-618)."""
+        job: _Job = task.context
+
+        def on_pull(payload: bytes) -> None:
+            arr = np.frombuffer(payload, dtype=job.np_dtype)
+            job.result[task.offset : task.offset + task.length] = arr[: task.length]
+            if self.telemetry is not None:
+                self.telemetry.record(len(payload))
+            self._proceed(task)
+
+        self.client.pull(task.key, task.version, on_pull, dtype_id=job.dtype_id)
+
+    def _copy_h2d_once(self, task: TensorTableEntry) -> None:
+        """Host→device hand-back (COPYH2D, core_loops.cc:650-753).  The
+        device transfer itself happens lazily in _finalize via jnp.asarray;
+        this stage exists so tracing shows the full reference pipeline."""
+        self._proceed(task)

@@ -1,0 +1,40 @@
+"""Key-count rendezvous table (ready_table.cc:24-44).
+
+Used by the host engine as a precondition gate: a task for ``key`` may only
+leave its queue when the expected number of ready signals has arrived (in
+the reference: all local peers signalled REDUCE/PUSH/BCAST readiness over
+UDS).  On TPU the intra-host peers are gone (one process drives all local
+chips), but the table remains the rendezvous for cross-stage preconditions
+(e.g. PULL must not start before PUSH acked) and for multi-controller
+deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class ReadyTable:
+    def __init__(self, ready_count: int, name: str = "") -> None:
+        self.ready_count = ready_count
+        self.name = name
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {}
+
+    def is_ready(self, key: int) -> bool:
+        with self._lock:
+            return self._counts.get(key, 0) >= self.ready_count
+
+    def add_ready_count(self, key: int, n: int = 1) -> int:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+            return self._counts[key]
+
+    def set_ready_count(self, key: int, n: int) -> None:
+        with self._lock:
+            self._counts[key] = n
+
+    def clear_ready_count(self, key: int) -> None:
+        with self._lock:
+            self._counts.pop(key, None)

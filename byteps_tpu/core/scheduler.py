@@ -1,0 +1,104 @@
+"""Priority-scheduled stage queue.
+
+Re-design of ``BytePSScheduledQueue`` (scheduled_queue.cc):
+
+- tasks sorted by (priority desc, key asc)  (scheduled_queue.cc:82-102)
+- optional credit scheduling: a byte budget of in-flight work
+  (BYTEPS_SCHEDULING_CREDIT, scheduled_queue.cc:26-46); finished tasks
+  return their credits (reportFinish, scheduled_queue.cc:197-203)
+- optional ReadyTable gate: tasks whose key is not ready are skipped
+  (getTask, scheduled_queue.cc:125-163)
+
+Priority semantics: the plugins assign priority = -declared_index so
+gradients produced *last* in backprop (front layers) are communicated
+*first*, hiding them behind the next step's early forward — the core BytePS
+scheduling insight (OSDI'20 §4; mxnet/__init__.py:52-74).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from byteps_tpu.common.types import QueueType, TensorTableEntry
+from byteps_tpu.core.ready_table import ReadyTable
+
+
+class ScheduledQueue:
+    def __init__(
+        self,
+        queue_type: QueueType,
+        credit_bytes: int = 0,
+        ready_table: Optional[ReadyTable] = None,
+        itemsize: int = 4,
+    ) -> None:
+        self.queue_type = queue_type
+        self.credit_enabled = credit_bytes > 0
+        self._credits = credit_bytes
+        self._ready_table = ready_table
+        self._itemsize = itemsize
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._tasks: List[TensorTableEntry] = []
+
+    def bind_ready_table(self, table: ReadyTable) -> None:
+        self._ready_table = table
+
+    def add_task(self, task: TensorTableEntry) -> None:
+        with self._cv:
+            self._tasks.append(task)
+            # (priority desc, key asc) — scheduled_queue.cc:82-102
+            self._tasks.sort(key=lambda t: (-t.priority, t.key))
+            self._cv.notify_all()
+
+    def _eligible(self, task: TensorTableEntry) -> bool:
+        if self.credit_enabled and task.length * self._itemsize > self._credits:
+            return False
+        if self._ready_table is not None and not self._ready_table.is_ready(task.key):
+            return False
+        return True
+
+    def get_task(self, timeout: Optional[float] = None) -> Optional[TensorTableEntry]:
+        """Pop the highest-priority eligible task; None on timeout."""
+        with self._cv:
+            task = self._pop_eligible()
+            if task is not None:
+                return task
+            self._cv.wait(timeout)
+            return self._pop_eligible()
+
+    def _pop_eligible(self) -> Optional[TensorTableEntry]:
+        for i, t in enumerate(self._tasks):
+            if self._eligible(t):
+                self._tasks.pop(i)
+                if self.credit_enabled:
+                    self._credits -= t.length * self._itemsize
+                if self._ready_table is not None:
+                    self._ready_table.clear_ready_count(t.key)
+                return t
+        return None
+
+    def get_task_by_key(self, key: int) -> Optional[TensorTableEntry]:
+        """Signal-directed dequeue (getTask(key),
+        scheduled_queue.cc:165-190)."""
+        with self._cv:
+            for i, t in enumerate(self._tasks):
+                if t.key == key:
+                    return self._tasks.pop(i)
+        return None
+
+    def report_finish(self, task: TensorTableEntry) -> None:
+        """Return credits (scheduled_queue.cc:197-203)."""
+        if self.credit_enabled:
+            with self._cv:
+                self._credits += task.length * self._itemsize
+                self._cv.notify_all()
+
+    def notify(self) -> None:
+        """Wake waiters (ready-table state changed externally)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._tasks)

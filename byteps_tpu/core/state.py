@@ -1,0 +1,104 @@
+"""Process-wide runtime state — the ``BytePSGlobal`` equivalent
+(global.h:52-225, global.cc:105-403).
+
+Owns: config snapshot, device mesh, tensor registry, handle table, the host
+pipeline engine (distributed mode only), PS client, telemetry and tracer.
+``init_state()`` is the body of ``byteps_lazy_init`` (operations.cc:41-88):
+it selects which host loops exist based on role and distributed-ness.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from byteps_tpu.common.config import Config, get_config, reset_config
+from byteps_tpu.common.registry import TensorRegistry, get_registry, reset_registry
+from byteps_tpu.core.handle_manager import HandleManager
+
+
+class RuntimeState:
+    def __init__(self) -> None:
+        self.config: Optional[Config] = None
+        self.mesh = None
+        self.registry: Optional[TensorRegistry] = None
+        self.handles = HandleManager()
+        self.engine = None  # core.engine.PipelineEngine (distributed mode)
+        self.ps_client = None  # comm.ps_client.PSClient
+        self.telemetry = None  # core.telemetry.PushPullSpeed
+        self.tracer = None  # core.tracing.Tracer
+        self.initialized = False
+        self.resuming = False
+        self._lock = threading.Lock()
+
+
+_state = RuntimeState()
+
+
+def get_state() -> RuntimeState:
+    return _state
+
+
+def init_state(fresh_env: bool = False) -> RuntimeState:
+    """Bring the process up (global.cc:105-297 + operations.cc:41-88)."""
+    import jax
+
+    from byteps_tpu.comm.mesh import build_mesh, set_global_mesh
+    from byteps_tpu.core.telemetry import PushPullSpeed
+    from byteps_tpu.core.tracing import Tracer
+
+    st = _state
+    with st._lock:
+        if st.initialized:
+            return st
+        cfg = reset_config() if fresh_env else get_config()
+        st.config = cfg
+        st.registry = get_registry()
+        st.mesh = build_mesh(cfg.mesh_shape)
+        set_global_mesh(st.mesh)
+        st.telemetry = PushPullSpeed(enabled=cfg.telemetry_on)
+        st.tracer = Tracer(
+            enabled=cfg.trace_on,
+            start_step=cfg.trace_start_step,
+            end_step=cfg.trace_end_step,
+            trace_dir=cfg.trace_dir,
+            local_rank=cfg.local_rank,
+        )
+        if cfg.is_distributed:
+            # Distributed mode: bring up the PS client (rendezvous with the
+            # scheduler, learn server addresses) and the staged host engine
+            # (the loops the reference starts in BytePSGlobal::Start,
+            # global.cc:299-403).
+            from byteps_tpu.comm.ps_client import PSClient
+            from byteps_tpu.core.engine import PipelineEngine
+
+            st.ps_client = PSClient(cfg)
+            st.ps_client.connect()
+            st.engine = PipelineEngine(cfg, st.ps_client, st.telemetry, st.tracer)
+            st.engine.start()
+        st.initialized = True
+        return st
+
+
+def shutdown_state() -> None:
+    """Tear down (byteps_shutdown → global.cc:319-403)."""
+    st = _state
+    with st._lock:
+        if not st.initialized:
+            return
+        if st.engine is not None:
+            st.engine.stop()
+            st.engine = None
+        if st.ps_client is not None:
+            st.ps_client.close()
+            st.ps_client = None
+        if st.tracer is not None:
+            st.tracer.flush()
+        st.handles.clear()
+        st.initialized = False
+
+
+def require_state() -> RuntimeState:
+    if not _state.initialized:
+        raise RuntimeError("byteps_tpu not initialized; call byteps_tpu.init()")
+    return _state

@@ -1,0 +1,48 @@
+"""Push/pull speed telemetry.
+
+Re-design of ``BytePSGlobal::PushPullSpeed`` (global.cc:697-752): a windowed
+MB/s counter over recent push_pull byte volume, exposed to Python as
+``bps.get_pushpull_speed()`` (common/__init__.py:131-139).  Gate:
+``BYTEPS_TELEMETRY_ON``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Tuple
+
+WINDOW_SEC = 10.0  # reference uses a 10-second window (global.cc:703)
+
+
+class PushPullSpeed:
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._events: Deque[Tuple[float, int]] = deque()
+        self._total_bytes = 0
+
+    def record(self, nbytes: int) -> None:
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._events.append((now, nbytes))
+            self._total_bytes += nbytes
+            self._evict(now)
+
+    def _evict(self, now: float) -> None:
+        while self._events and now - self._events[0][0] > WINDOW_SEC:
+            _, nb = self._events.popleft()
+            self._total_bytes -= nb
+
+    def mbps(self) -> float:
+        """Windowed MB/s (returns 0 when disabled or idle)."""
+        now = time.monotonic()
+        with self._lock:
+            self._evict(now)
+            if not self._events:
+                return 0.0
+            span = max(now - self._events[0][0], 1e-6)
+            return self._total_bytes / span / 1e6

@@ -1,0 +1,102 @@
+"""Chrome-trace timeline of communication stages.
+
+Re-design of the reference's tracing subsystem (global.cc:448-564,
+docs/timeline.md): per named tensor, per pipeline stage, record
+``{start, duration}`` intervals between trace_start_step and trace_end_step
+and emit ``<dir>/<local_rank>/comm.json`` in Chrome trace-event format
+(load via chrome://tracing or Perfetto).
+
+Host stages are stamped by the pipeline engine; device-side collective
+timing is XLA's domain (use jax.profiler for that) — the tracer records the
+host-visible envelope, which is what the reference records too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List
+
+
+class Tracer:
+    def __init__(
+        self,
+        enabled: bool = False,
+        start_step: int = 10,
+        end_step: int = 20,
+        trace_dir: str = ".",
+        local_rank: int = 0,
+    ) -> None:
+        self.enabled = enabled
+        self.start_step = start_step
+        self.end_step = end_step
+        self.trace_dir = trace_dir
+        self.local_rank = local_rank
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._steps: Dict[str, int] = {}  # per-tensor version counter
+        self._flushed = False
+
+    def _active(self, step: int) -> bool:
+        return self.enabled and self.start_step <= step <= self.end_step
+
+    def step_of(self, name: str) -> int:
+        with self._lock:
+            return self._steps.get(name, 0)
+
+    def bump_step(self, name: str) -> int:
+        with self._lock:
+            s = self._steps.get(name, 0) + 1
+            self._steps[name] = s
+            return s
+
+    def record(self, name: str, stage: str, start: float, dur: float, step: int) -> None:
+        """One complete-event per (tensor, stage) interval
+        (global.cc:478-530 emits type 'X' events keyed the same way)."""
+        if not self._active(step):
+            return
+        with self._lock:
+            self._events.append(
+                {
+                    "name": stage,
+                    "cat": "comm",
+                    "ph": "X",
+                    "ts": start * 1e6,
+                    "dur": dur * 1e6,
+                    "pid": name,  # one trace row per tensor, like the reference
+                    "tid": stage,
+                }
+            )
+
+    def flush(self) -> str:
+        if not self.enabled or self._flushed:
+            return ""
+        out_dir = os.path.join(self.trace_dir, str(self.local_rank))
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "comm.json")
+        with self._lock:
+            payload = {"traceEvents": self._events, "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        self._flushed = True
+        return path
+
+
+class StageTimer:
+    """Context manager stamping one stage interval onto a tracer."""
+
+    def __init__(self, tracer: Tracer, name: str, stage: str, step: int) -> None:
+        self.tracer = tracer
+        self.name = name
+        self.stage = stage
+        self.step = step
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer.record(self.name, self.stage, self.t0, time.time() - self.t0, self.step)
+        return False

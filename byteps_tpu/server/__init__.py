@@ -1,0 +1,8 @@
+"""PS server package.
+
+``python -m byteps_tpu.server`` starts a server or scheduler process
+according to ``DMLC_ROLE`` — the equivalent of ``import byteps.server``
+(server/__init__.py:21-27 in the reference).
+"""
+
+from byteps_tpu.server.server import PSServer, run_server  # noqa: F401

@@ -1,0 +1,8 @@
+"""``python -m byteps_tpu.server`` — start a server/scheduler process per
+DMLC_ROLE (reference: ``python3 -c 'import byteps.server'``,
+launch.py:269-277)."""
+
+from byteps_tpu.server.server import run_server
+
+if __name__ == "__main__":
+    run_server()

@@ -1,0 +1,350 @@
+"""CPU parameter-server engine.
+
+Re-design of byteps/server/server.cc (SURVEY §2.3) for the TPU build's DCN
+PS hop:
+
+- one KV handler per connection thread feeding N engine threads
+  (``BYTEPS_SERVER_ENGINE_THREAD``, server.cc:485-497), each owning a
+  priority queue; key→thread via least-loaded assignment cached per key
+  (server.h:154-178);
+- push: first arrival of a round copies (COPY_FIRST), later arrivals sum
+  (SUM_RECV); when all workers arrived (ALL_RECV) the merged result is
+  published and buffered pulls are answered (server.cc:296-375);
+- pull: answered immediately if the requested round is complete, else
+  queued (server.cc:376-409);
+- init push doubles as a cross-worker barrier (server.cc:266-295);
+- sync vs async mode (``BYTEPS_ENABLE_ASYNC``): async sums straight into
+  the store and answers pulls immediately — parameter-store semantics
+  (server.cc:315-319);
+- anti-starvation scheduling (``BYTEPS_SERVER_ENABLE_SCHEDULE``): pop the
+  key with the fewest accumulated pushes first (queue.h:49-97).
+
+The reduction itself calls the native C++ reducer when built (SURVEY build
+plan §3), with a numpy fallback.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import pickle
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.types import DataType, decode_command_type, to_numpy_dtype
+from byteps_tpu.comm.transport import (
+    Message,
+    Op,
+    connect,
+    listen,
+    recv_message,
+    send_message,
+)
+from byteps_tpu.comm.rendezvous import GROUP_ALL
+
+
+class _KeyState:
+    __slots__ = (
+        "store",
+        "accum",
+        "recv_count",
+        "store_version",
+        "pushed_total",
+        "pending_pulls",
+        "init_waiters",
+        "dtype",
+        "compressor_kwargs",
+        "lock",
+    )
+
+    def __init__(self) -> None:
+        self.store: Optional[np.ndarray] = None
+        self.accum: Optional[np.ndarray] = None
+        self.recv_count = 0
+        self.store_version = 0
+        self.pushed_total = 0
+        self.pending_pulls: List[Tuple[int, socket.socket, threading.Lock, int]] = []
+        self.init_waiters: List[Tuple[socket.socket, threading.Lock, int]] = []
+        self.dtype: Optional[np.dtype] = None
+        self.compressor_kwargs: Dict[str, str] = {}
+        self.lock = threading.Lock()
+
+
+class _EngineQueue:
+    """Priority queue per engine thread (server/queue.h).
+
+    With scheduling enabled, pops the task whose key has the fewest
+    accumulated pushes (anti-starvation, queue.h:49-97); otherwise FIFO.
+    """
+
+    def __init__(self, enable_schedule: bool) -> None:
+        self.enable_schedule = enable_schedule
+        self._cv = threading.Condition()
+        self._heap: List = []
+        self._counter = itertools.count()
+
+    def put(self, prio: int, item) -> None:
+        with self._cv:
+            heapq.heappush(self._heap, (prio if self.enable_schedule else 0, next(self._counter), item))
+            self._cv.notify()
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cv:
+            if not self._heap:
+                self._cv.wait(timeout)
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+
+class PSServer:
+    def __init__(self, cfg: Config, host: str = "127.0.0.1") -> None:
+        self.cfg = cfg
+        self.host = host
+        self._sock, self.port = listen(host, 0)
+        self._keys: Dict[int, _KeyState] = {}
+        self._keys_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # key→engine-thread least-loaded assignment (server.h:154-178)
+        self._tid_cache: Dict[int, int] = {}
+        self._tid_load: List[int] = [0] * max(1, cfg.server_engine_threads)
+        self._tid_lock = threading.Lock()
+        self._queues = [
+            _EngineQueue(cfg.server_enable_schedule)
+            for _ in range(max(1, cfg.server_engine_threads))
+        ]
+        self.rank: Optional[int] = None
+        self.num_workers = cfg.num_worker
+        self._sched_conn: Optional[socket.socket] = None
+        self._reducer = _make_reducer()
+
+    # --- lifecycle -------------------------------------------------------
+
+    def start(self, register: bool = True) -> None:
+        for i, q in enumerate(self._queues):
+            t = threading.Thread(
+                target=self._engine_loop, args=(q,), name=f"ps-engine-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._accept_loop, name="ps-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if register:
+            self._register_with_scheduler()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for sock in (self._sock, self._sched_conn):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _register_with_scheduler(self) -> None:
+        """ps::StartPS + barrier equivalent (server.cc:500-509)."""
+        conn = connect(self.cfg.ps_root_uri, self.cfg.ps_root_port)
+        self._sched_conn = conn
+        send_message(
+            conn,
+            Message(
+                Op.REGISTER,
+                payload=pickle.dumps(
+                    {"role": "server", "host": self.host, "port": self.port}
+                ),
+            ),
+        )
+        book = pickle.loads(recv_message(conn).payload)
+        self.rank = book["rank"]
+        self.num_workers = book["num_workers"]
+        # global barrier before serving (server.cc:506)
+        send_message(conn, Message(Op.BARRIER, flags=GROUP_ALL))
+        recv_message(conn)
+
+    # --- connection plane ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                msg = recv_message(conn)
+                if msg.op in (Op.PUSH, Op.PULL, Op.INIT):
+                    self._enqueue(msg, conn, send_lock)
+                elif msg.op == Op.REGISTER_COMPRESSOR:
+                    ks = self._key_state(msg.key)
+                    ks.compressor_kwargs = pickle.loads(msg.payload)
+                    send_message(conn, Message(Op.REGISTER_COMPRESSOR, seq=msg.seq), send_lock)
+                elif msg.op == Op.PING:
+                    send_message(conn, Message(Op.PING, seq=msg.seq), send_lock)
+                elif msg.op == Op.SHUTDOWN:
+                    send_message(conn, Message(Op.SHUTDOWN, seq=msg.seq), send_lock)
+                    return
+        except (ConnectionError, OSError):
+            return
+
+    def _key_state(self, key: int) -> _KeyState:
+        with self._keys_lock:
+            ks = self._keys.get(key)
+            if ks is None:
+                ks = self._keys[key] = _KeyState()
+            return ks
+
+    def _thread_for(self, key: int, length: int) -> int:
+        with self._tid_lock:
+            tid = self._tid_cache.get(key)
+            if tid is None:
+                tid = int(np.argmin(self._tid_load))
+                self._tid_cache[key] = tid
+            self._tid_load[tid] += length
+            return tid
+
+    def _enqueue(self, msg: Message, conn, send_lock) -> None:
+        tid = self._thread_for(msg.key, len(msg.payload))
+        ks = self._key_state(msg.key)
+        # anti-starvation: fewest accumulated pushes first (queue.h:49-97)
+        self._queues[tid].put(ks.pushed_total, (msg, conn, send_lock))
+
+    # --- engine plane ----------------------------------------------------
+
+    def _engine_loop(self, q: _EngineQueue) -> None:
+        while not self._stop.is_set():
+            item = q.get(timeout=0.2)
+            if item is None:
+                continue
+            msg, conn, send_lock = item
+            try:
+                if msg.op == Op.INIT:
+                    self._handle_init(msg, conn, send_lock)
+                elif msg.op == Op.PUSH:
+                    self._handle_push(msg, conn, send_lock)
+                elif msg.op == Op.PULL:
+                    self._handle_pull(msg, conn, send_lock)
+            except (ConnectionError, OSError):
+                continue
+
+    def _handle_init(self, msg: Message, conn, send_lock) -> None:
+        """Init push = allocate + cross-worker barrier (server.cc:266-295)."""
+        meta = pickle.loads(msg.payload)
+        ks = self._key_state(msg.key)
+        with ks.lock:
+            if ks.store is None:
+                dtype = to_numpy_dtype(DataType(meta["dtype"]))
+                n = meta["num_elements"]
+                ks.dtype = dtype
+                ks.store = np.zeros(n, dtype=dtype)
+                ks.accum = np.zeros(n, dtype=dtype)
+            ks.init_waiters.append((conn, send_lock, msg.seq))
+            if len(ks.init_waiters) >= self.num_workers:
+                waiters, ks.init_waiters = ks.init_waiters, []
+            else:
+                return
+        for wconn, wlock, wseq in waiters:
+            send_message(wconn, Message(Op.INIT, key=msg.key, seq=wseq), wlock)
+
+    def _handle_push(self, msg: Message, conn, send_lock) -> None:
+        ks = self._key_state(msg.key)
+        _, dtype_id = decode_command_type(msg.cmd)
+        arr = np.frombuffer(msg.payload, dtype=to_numpy_dtype(DataType(dtype_id)))
+        flush: List = []
+        with ks.lock:
+            if ks.store is None:
+                raise ConnectionError(f"push for uninitialized key {msg.key}")
+            if self.cfg.enable_async:
+                # async mode: parameter store, sum deltas in place
+                # (server.cc:315-319)
+                self._reducer(ks.store, arr)
+                ks.store_version += 1
+                ks.pushed_total += 1
+            else:
+                if ks.recv_count == 0:
+                    ks.accum[: len(arr)] = arr  # COPY_FIRST (server.cc:296)
+                else:
+                    self._reducer(ks.accum, arr)  # SUM_RECV
+                ks.recv_count += 1
+                ks.pushed_total += 1
+                if ks.recv_count >= self.num_workers:
+                    # ALL_RECV: publish round, flush buffered pulls
+                    # (server.cc:348-375)
+                    ks.store, ks.accum = ks.accum, ks.store
+                    ks.store_version += 1
+                    ks.recv_count = 0
+                    still_pending = []
+                    for version, pconn, plock, pseq in ks.pending_pulls:
+                        if version <= ks.store_version:
+                            flush.append((pconn, plock, pseq, ks.store.tobytes(), ks.store_version))
+                        else:
+                            still_pending.append((version, pconn, plock, pseq))
+                    ks.pending_pulls = still_pending
+        send_message(conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version), send_lock)
+        for pconn, plock, pseq, payload, ver in flush:
+            send_message(
+                pconn,
+                Message(Op.PULL, key=msg.key, payload=payload, seq=pseq, version=ver),
+                plock,
+            )
+
+    def _handle_pull(self, msg: Message, conn, send_lock) -> None:
+        ks = self._key_state(msg.key)
+        with ks.lock:
+            if ks.store is None:
+                raise ConnectionError(f"pull for uninitialized key {msg.key}")
+            ready = self.cfg.enable_async or msg.version <= ks.store_version
+            if ready:
+                payload = ks.store.tobytes()
+                ver = ks.store_version
+            else:
+                ks.pending_pulls.append((msg.version, conn, send_lock, msg.seq))
+                return
+        send_message(
+            conn, Message(Op.PULL, key=msg.key, payload=payload, seq=msg.seq, version=ver), send_lock
+        )
+
+
+def _make_reducer():
+    """Native C++ summation when available (cpu_reducer.cc equivalent),
+    numpy otherwise."""
+    try:
+        from byteps_tpu.native import cpu_reducer
+
+        return cpu_reducer.sum_into
+    except Exception:
+        def _numpy_sum(dst: np.ndarray, src: np.ndarray) -> None:
+            np.add(dst[: len(src)], src, out=dst[: len(src)])
+
+        return _numpy_sum
+
+
+def run_server() -> None:
+    """Process entry: become scheduler or server per DMLC_ROLE
+    (server/__init__.py:21-27)."""
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+
+    cfg = Config.from_env()
+    if cfg.role == "scheduler":
+        sched = Scheduler(cfg.num_worker, cfg.num_server, port=cfg.ps_root_port)
+        sched.start()
+        threading.Event().wait()  # serve forever
+    elif cfg.role == "server":
+        srv = PSServer(cfg, host=cfg.node_host or "127.0.0.1")
+        srv.start()
+        threading.Event().wait()
+    else:
+        raise SystemExit(f"run_server: unsupported role {cfg.role!r}")

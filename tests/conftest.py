@@ -1,0 +1,47 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's single-host fake-cluster strategy (SURVEY §4,
+tests/meta_test.py:26-86) translated to JAX: multi-device behavior is
+exercised on one machine via ``--xla_force_host_platform_device_count``;
+the PS path is exercised with an in-process scheduler + server
+(BYTEPS_FORCE_DISTRIBUTED=1 equivalent, global.cc:149-152).
+
+This file must set env before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the image presets JAX_PLATFORMS=axon
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_runtime():
+    """Reset global runtime state between tests."""
+    yield
+    from byteps_tpu.common import config as _config
+    from byteps_tpu.common import registry as _registry
+    from byteps_tpu.core import state as _state
+
+    _state.shutdown_state()
+    _registry.reset_registry()
+    _config.clear_config()
+
+
+@pytest.fixture
+def mesh8():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), ("dp",))

@@ -1,0 +1,75 @@
+"""Public API tests, non-distributed mode.
+
+Mirrors the reference's 1-worker semantics: push_pull == identity
+(tests/test_mxnet.py:30-126 asserts allclose(input, output) with 1 worker).
+"""
+
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+
+
+class TestLifecycle:
+    def test_init_shutdown(self):
+        bps.init()
+        assert bps.size() == 1
+        assert bps.rank() == 0
+        bps.shutdown()
+
+    def test_declare_stable(self):
+        bps.init()
+        k1 = bps.declare_tensor("grad.w")
+        k2 = bps.declare_tensor("grad.b")
+        assert (k1, k2) == (0, 1)
+        assert bps.declare_tensor("grad.w") == 0
+
+
+class TestPushPullIdentity:
+    def test_identity_1worker(self):
+        bps.init()
+        for shape in [(7,), (3, 5), (2, 3, 4)]:
+            for dtype in [np.float32, np.float64, np.int32]:
+                x = np.random.default_rng(0).normal(size=shape).astype(dtype)
+                out = bps.push_pull(x, name=f"t_{shape}_{np.dtype(dtype).name}")
+                np.testing.assert_allclose(np.asarray(out), x)
+
+    def test_async_poll_synchronize(self):
+        bps.init()
+        x = np.ones(10, dtype=np.float32)
+        h = bps.push_pull_async(x, "async_t")
+        assert bps.poll(h)
+        out = bps.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), x)
+
+    def test_jax_array_passthrough(self):
+        import jax.numpy as jnp
+
+        bps.init()
+        x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        out = bps.push_pull(x, name="jax_t")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+class TestBroadcast:
+    def test_broadcast_noop_1worker(self):
+        bps.init()
+        params = {"w": np.ones((2, 2)), "b": np.zeros(2)}
+        out = bps.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(out["w"], params["w"])
+
+    def test_broadcast_object_noop(self):
+        bps.init()
+        obj = {"lr": 0.1, "steps": [1, 2, 3]}
+        assert bps.broadcast_object(obj) == obj
+
+
+class TestElasticity:
+    def test_suspend_resume_keys_stable(self):
+        bps.init()
+        names = [f"g{i}" for i in range(5)]
+        keys = {n: bps.declare_tensor(n) for n in names}
+        bps.suspend()
+        bps.resume(num_workers=1)
+        for n in names:
+            assert bps.declare_tensor(n) == keys[n]

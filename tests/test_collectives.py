@@ -1,0 +1,92 @@
+"""Intra-slice collective tests on the 8-device virtual CPU mesh
+(SURVEY §4: multi-device tests via xla_force_host_platform_device_count)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from byteps_tpu.comm import collectives as coll
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    )
+
+
+class TestPushPull:
+    def test_psum_average(self, mesh8):
+        n = mesh8.shape["dp"]
+        x = jnp.arange(n * 4, dtype=jnp.float32).reshape(n, 4)
+
+        fn = _smap(
+            lambda v: coll.push_pull(v[0], "dp", average=True),
+            mesh8, (P("dp"),), P(),
+        )
+        out = fn(x)
+        np.testing.assert_allclose(out, np.asarray(x).mean(0), rtol=1e-6)
+
+    def test_sum_no_average(self, mesh8):
+        n = mesh8.shape["dp"]
+        x = jnp.ones((n, 8), dtype=jnp.float32)
+        fn = _smap(
+            lambda v: coll.push_pull(v[0], "dp", average=False),
+            mesh8, (P("dp"),), P(),
+        )
+        np.testing.assert_allclose(fn(x), np.full((8,), n), rtol=1e-6)
+
+    def test_scatter_gather_matches_psum(self, mesh8):
+        n = mesh8.shape["dp"]
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(n, n * 3)).astype(np.float32))
+        f1 = _smap(
+            lambda v: coll.push_pull(v[0], "dp", average=True, mode="psum"),
+            mesh8, (P("dp"),), P(),
+        )
+        f2 = _smap(
+            lambda v: coll.push_pull(
+                v[0], "dp", average=True, mode="scatter_gather", axis_size=n
+            ),
+            mesh8, (P("dp"),), P(),
+        )
+        np.testing.assert_allclose(f1(x), f2(x), rtol=1e-5)
+
+
+class TestReduceScatterGather:
+    def test_reduce_scatter_then_gather(self, mesh8):
+        n = mesh8.shape["dp"]
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(n, n * 2)).astype(np.float32))
+
+        def body(v):
+            shard = coll.reduce_scatter(v[0], "dp", average=False)
+            return coll.all_gather(shard, "dp")
+
+        fn = _smap(body, mesh8, (P("dp"),), P())
+        np.testing.assert_allclose(fn(x), np.asarray(x).sum(0), rtol=1e-5)
+
+
+class TestBroadcast:
+    def test_broadcast_from_root(self, mesh8):
+        n = mesh8.shape["dp"]
+        x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1) * jnp.ones((n, 5))
+
+        fn = _smap(
+            lambda v: coll.broadcast(v[0], "dp", root=3), mesh8, (P("dp"),), P()
+        )
+        np.testing.assert_allclose(fn(x), np.full((5,), 3.0))
+
+
+class TestTreeReducer:
+    def test_jit_push_pull_tree(self, mesh8):
+        n = mesh8.shape["dp"]
+        rng = np.random.default_rng(2)
+        tree = {
+            "w": jnp.asarray(rng.normal(size=(n, 4, 4)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(n, 4)).astype(np.float32)),
+        }
+        out = coll.jit_push_pull_tree(tree, mesh8, average=True)
+        np.testing.assert_allclose(out["w"], np.asarray(tree["w"]).mean(0), rtol=1e-5)
+        np.testing.assert_allclose(out["b"], np.asarray(tree["b"]).mean(0), rtol=1e-5)

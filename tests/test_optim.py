@@ -1,0 +1,95 @@
+"""DistributedOptimizer / DDP-step end-to-end training tests on the
+8-device CPU mesh — the analogue of the reference's integration-by-default
+strategy (SURVEY §4: train a real model, assert convergence/equivalence)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import byteps_tpu as bps
+from byteps_tpu.optim import (
+    allreduce_gradients,
+    build_data_parallel_step,
+    distributed_optimizer,
+)
+
+
+def _toy_data(n=256, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(d, 1)).astype(np.float32)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.normal(size=(n, 1)).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y), w_true
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+class TestDistributedOptimizer:
+    def test_matches_single_device(self, mesh8):
+        """DP training over 8 devices must match single-device training on
+        the full batch exactly (the distributed gradient is the mean of
+        shard gradients = full-batch gradient)."""
+        x, y, _ = _toy_data()
+        params0 = {"w": jnp.zeros((8, 1)), "b": jnp.zeros(())}
+
+        # single-device reference
+        tx_ref = optax.sgd(0.1)
+        p_ref, s_ref = params0, tx_ref.init(params0)
+        for _ in range(10):
+            g = jax.grad(_loss_fn)(p_ref, (x, y))
+            u, s_ref = tx_ref.update(g, s_ref)
+            p_ref = optax.apply_updates(p_ref, u)
+
+        # distributed via shard_map + distributed_optimizer
+        tx_dp = distributed_optimizer(optax.sgd(0.1), axis_names=("dp",))
+
+        def local_step(params, opt_state, batch):
+            g = jax.grad(_loss_fn)(params, batch)
+            u, opt_state = tx_dp.update(g, opt_state, params)
+            return optax.apply_updates(params, u), opt_state
+
+        step = jax.jit(
+            jax.shard_map(
+                local_step,
+                mesh=mesh8,
+                in_specs=(P(), P(), P("dp")),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+        p_dp, s_dp = params0, tx_dp.init(params0)
+        for _ in range(10):
+            p_dp, s_dp = step(p_dp, s_dp, (x, y))
+
+        np.testing.assert_allclose(p_dp["w"], p_ref["w"], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(p_dp["b"], p_ref["b"], rtol=1e-5, atol=1e-6)
+
+    def test_class_api_priorities(self):
+        names = ["layer1.w", "layer1.b", "layer2.w"]
+        opt = bps.DistributedOptimizer(optax.adam(1e-3), named_parameters=names)
+        # priority = -param_index (mxnet/__init__.py:52-74)
+        assert opt.priorities == {"layer1.w": 0, "layer1.b": -1, "layer2.w": -2}
+
+
+class TestDDPStep:
+    def test_converges(self, mesh8):
+        from byteps_tpu.comm.mesh import set_global_mesh
+
+        set_global_mesh(mesh8)
+        x, y, w_true = _toy_data()
+        params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros(())}
+        tx = optax.sgd(0.2)
+        opt_state = tx.init(params)
+        step = build_data_parallel_step(_loss_fn, tx, mesh=mesh8, donate=False)
+        loss = None
+        for _ in range(60):
+            params, opt_state, loss = step(params, opt_state, (x, y))
+        assert float(loss) < 1e-2
+        np.testing.assert_allclose(np.asarray(params["w"]), w_true, atol=0.1)

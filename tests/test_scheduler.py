@@ -1,0 +1,93 @@
+"""Unit tests for the priority scheduler and ready table (the reference has
+none for these — SURVEY §4 calls for real unit tests here)."""
+
+import threading
+import time
+
+import pytest
+
+from byteps_tpu.common.types import QueueType, TensorTableEntry
+from byteps_tpu.core.ready_table import ReadyTable
+from byteps_tpu.core.scheduler import ScheduledQueue
+
+
+def make_task(key, priority=0, length=10):
+    return TensorTableEntry(
+        tensor_name=f"t{key}", key=key, priority=priority, length=length,
+        queue_list=[QueueType.PUSH],
+    )
+
+
+class TestScheduledQueue:
+    def test_priority_order(self):
+        # (priority desc, key asc) — scheduled_queue.cc:82-102
+        q = ScheduledQueue(QueueType.PUSH)
+        q.add_task(make_task(3, priority=-3))
+        q.add_task(make_task(1, priority=0))
+        q.add_task(make_task(2, priority=-1))
+        assert q.get_task().key == 1
+        assert q.get_task().key == 2
+        assert q.get_task().key == 3
+
+    def test_key_tiebreak(self):
+        q = ScheduledQueue(QueueType.PUSH)
+        q.add_task(make_task(9, priority=0))
+        q.add_task(make_task(4, priority=0))
+        assert q.get_task().key == 4
+
+    def test_credit_blocks_oversized(self):
+        # BYTEPS_SCHEDULING_CREDIT (scheduled_queue.cc:26-46)
+        q = ScheduledQueue(QueueType.PUSH, credit_bytes=100, itemsize=4)
+        big = make_task(1, length=100)   # 400B > 100B credit
+        q.add_task(big)
+        assert q.get_task(timeout=0.05) is None
+        small = make_task(2, length=10)  # 40B fits
+        q.add_task(small)
+        got = q.get_task(timeout=0.5)
+        assert got is not None and got.key == 2
+
+    def test_credit_returned_on_finish(self):
+        q = ScheduledQueue(QueueType.PUSH, credit_bytes=100, itemsize=4)
+        t1 = make_task(1, length=20)  # 80B
+        t2 = make_task(2, length=20)  # 80B — doesn't fit while t1 in flight
+        q.add_task(t1)
+        q.add_task(t2)
+        got1 = q.get_task(timeout=0.5)
+        assert got1.key == 1
+        assert q.get_task(timeout=0.05) is None  # out of credit
+        q.report_finish(got1)  # credits returned (scheduled_queue.cc:197-203)
+        got2 = q.get_task(timeout=0.5)
+        assert got2 is not None and got2.key == 2
+
+    def test_ready_table_gate(self):
+        # tasks whose key isn't ready are skipped (scheduled_queue.cc:125-163)
+        table = ReadyTable(ready_count=2)
+        q = ScheduledQueue(QueueType.PUSH, ready_table=table)
+        q.add_task(make_task(7))
+        assert q.get_task(timeout=0.05) is None
+        table.add_ready_count(7)
+        assert q.get_task(timeout=0.05) is None
+        table.add_ready_count(7)
+        q.notify()
+        got = q.get_task(timeout=0.5)
+        assert got is not None and got.key == 7
+        # dequeue clears the count for the next round
+        assert not table.is_ready(7)
+
+    def test_get_by_key(self):
+        q = ScheduledQueue(QueueType.PUSH)
+        q.add_task(make_task(1))
+        q.add_task(make_task(2))
+        assert q.get_task_by_key(2).key == 2
+        assert q.get_task_by_key(99) is None
+
+
+class TestReadyTable:
+    def test_counts(self):
+        t = ReadyTable(ready_count=3)
+        assert not t.is_ready(5)
+        t.add_ready_count(5)
+        t.add_ready_count(5, 2)
+        assert t.is_ready(5)
+        t.clear_ready_count(5)
+        assert not t.is_ready(5)
