@@ -1,0 +1,96 @@
+"""Benchmark: BERT-large training throughput on one TPU chip.
+
+The reference's headline benchmark is BERT-large pretraining throughput
+(README.md:38-46, BASELINE.md); with one real chip available the honest
+single-chip metric is train samples/sec (fwd+bwd+adam, bf16 compute,
+seq 128 — GluonNLP phase-1 geometry, batch 64/device like the reference's
+per-GPU batch).
+
+``vs_baseline`` normalizes against a 40%-MFU target on the chip's peak
+bf16 throughput — i.e. vs_baseline >= 1.0 means the compiled step reaches
+the efficiency class the reference claims for its GPU stack (~90% scaling
+of a well-fed device).  Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from byteps_tpu.models.transformer import (
+        bert_large,
+        build_train_step,
+        init_params,
+        shard_params,
+    )
+    from byteps_tpu.parallel.mesh_utils import make_training_mesh
+
+    # 32/chip fits v5e 16GB HBM without remat (64 like the reference's
+    # per-GPU batch needs rematerialization — TODO: jax.checkpoint path)
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    cfg = bert_large(max_seq=seq, compute_dtype=jnp.bfloat16)
+    mesh = make_training_mesh(1, {"dp": 1, "pp": 1, "sp": 1, "tp": 1})
+    params = shard_params(init_params(cfg, seed=0, pp_size=1), cfg, mesh)
+    tx = optax.adamw(1e-4)
+    opt_state = jax.jit(tx.init)(params)
+    step = build_train_step(cfg, mesh, tx, donate=True)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    )
+    targets = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1))
+
+    # warmup / compile
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / dt
+
+    # model FLOPs per sample (fwd+bwd = 3x fwd): matmul params + attention
+    D, L, V, S = cfg.d_model, cfg.n_layers, cfg.vocab_size, seq
+    flops_per_sample = 6 * S * (12 * L * D * D + D * V) + 12 * L * S * S * D
+    peak_bf16 = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e chip
+    mfu = samples_per_sec * flops_per_sample / peak_bf16
+    baseline_samples_per_sec = 0.40 * peak_bf16 / flops_per_sample
+
+    print(
+        json.dumps(
+            {
+                "metric": "bert_large_train_samples_per_sec_per_chip",
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/s",
+                "vs_baseline": round(samples_per_sec / baseline_samples_per_sec, 4),
+                "extra": {
+                    "mfu": round(mfu, 4),
+                    "batch": batch,
+                    "seq": seq,
+                    "steps": steps,
+                    "loss": float(loss),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

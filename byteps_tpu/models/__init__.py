@@ -1,0 +1,16 @@
+"""Model zoo.
+
+The reference keeps models in examples (example/{pytorch,tensorflow,mxnet},
+SURVEY §2.7); here the flagship transformer family (BERT-large, GPT-2) is a
+first-class, fully-shardable implementation, plus conv nets (ResNet-50,
+VGG-16) matching the reference's benchmark configs (BASELINE.md).
+"""
+
+from byteps_tpu.models.transformer import (
+    TransformerConfig,
+    bert_large,
+    gpt2_medium,
+    init_params,
+    build_train_step,
+    build_forward,
+)

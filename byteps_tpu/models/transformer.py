@@ -1,0 +1,470 @@
+"""Flagship transformer family — fully shardable over (dp, pp, sp, tp).
+
+TPU-first design, not a port: the whole train step is ONE compiled SPMD
+program under ``shard_map`` over a 4-D mesh:
+
+    dp — batch sharding; gradients psum over ICI (the reference's entire
+         data-parallel capability, SURVEY §2.7)
+    pp — pipeline stages: layer stack sharded on the leading stage dim,
+         GPipe-style microbatch schedule driven by lax.scan with
+         lax.ppermute hops between stages
+    sp — sequence/context parallelism: ring attention
+         (byteps_tpu.parallel.ring_attention) rotating KV blocks on ICI;
+         doubles as the expert-parallel axis for MoE (DeepSpeed-MoE
+         grouping)
+    tp — megatron-style tensor parallelism: attention heads and MLP hidden
+         column-sharded, row-parallel matmuls psum'd
+
+Parameters are stored as a flat dict of stacked global arrays with leading
+dims (pp, layers_per_stage, ...); sharding specs and gradient-sync axes are
+derived per entry (a parameter's grads are psum'd over exactly the axes it
+is replicated on).
+
+Flagship configs: BERT-large (the reference's headline benchmark,
+BASELINE.md) and GPT-2 medium (BASELINE.json config 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byteps_tpu.parallel.moe import moe_aux_loss, moe_mlp
+from byteps_tpu.parallel.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 1024
+    n_heads: int = 16
+    d_head: int = 64
+    d_ff: int = 4096
+    n_layers: int = 24
+    max_seq: int = 512
+    causal: bool = False  # BERT-style bidirectional by default
+    moe: bool = False
+    n_experts: int = 8
+    capacity_factor: float = 2.0
+    moe_aux_coef: float = 0.01
+    compute_dtype: Any = jnp.float32
+    microbatches: int = 0  # 0 → pipeline stages count
+
+
+def bert_large(**kw) -> TransformerConfig:
+    """BERT-large: 24L, d1024, 16 heads, ff 4096 — the reference's headline
+    scaling benchmark (README.md:38-46, BASELINE.md)."""
+    return TransformerConfig(
+        vocab_size=30528, d_model=1024, n_heads=16, d_head=64, d_ff=4096,
+        n_layers=24, causal=False, **kw,
+    )
+
+
+def gpt2_medium(**kw) -> TransformerConfig:
+    """GPT-2 medium: 24L, d1024, causal (BASELINE.json config 5)."""
+    return TransformerConfig(
+        vocab_size=50257, d_model=1024, n_heads=16, d_head=64, d_ff=4096,
+        n_layers=24, causal=True, **kw,
+    )
+
+
+def tiny_test(**kw) -> TransformerConfig:
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("d_model", 16)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("d_head", 4)
+    kw.setdefault("d_ff", 32)
+    kw.setdefault("n_layers", 4)
+    kw.setdefault("max_seq", 16)
+    return TransformerConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Parameters: flat dict of stacked global arrays + per-entry layout table
+# ---------------------------------------------------------------------------
+
+
+def _layouts(cfg: TransformerConfig) -> Dict[str, Tuple]:
+    """name → (global_shape_fn(pp, tp, sp) irrelevant — shapes are GLOBAL),
+    (partition spec), (grad sync axes).  Spec axes reference the 4-D mesh
+    (dp, pp, sp, tp)."""
+    D, H, dh, F = cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff
+    L, V, S, E = cfg.n_layers, cfg.vocab_size, cfg.max_seq, cfg.n_experts
+    # leading dims of layer params: (pp, layers_per_stage) — pp filled in
+    # at init time when the mesh is known
+    table = {
+        "embed": ((V, D), P(), ("dp", "pp", "sp", "tp")),
+        "pos": ((S, D), P(), ("dp", "pp", "sp", "tp")),
+        "ln_f_s": ((D,), P(), ("dp", "pp", "sp", "tp")),
+        "ln_f_b": ((D,), P(), ("dp", "pp", "sp", "tp")),
+        "head": ((D, V), P(), ("dp", "pp", "sp", "tp")),
+        # layer-stacked (leading (pp, Lps) added at init)
+        "ln1_s": ((D,), P("pp"), ("dp", "sp", "tp")),
+        "ln1_b": ((D,), P("pp"), ("dp", "sp", "tp")),
+        "ln2_s": ((D,), P("pp"), ("dp", "sp", "tp")),
+        "ln2_b": ((D,), P("pp"), ("dp", "sp", "tp")),
+        "wq": ((D, H, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
+        "wk": ((D, H, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
+        "wv": ((D, H, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
+        "wo": ((H, dh, D), P("pp", None, "tp", None, None), ("dp", "sp")),
+    }
+    if cfg.moe:
+        table.update(
+            {
+                "router": ((D, E), P("pp"), ("dp", "sp", "tp")),
+                "ew1": ((E, D, F), P("pp", None, "sp", None, None), ("dp", "tp")),
+                "eb1": ((E, F), P("pp", None, "sp", None), ("dp", "tp")),
+                "ew2": ((E, F, D), P("pp", None, "sp", None, None), ("dp", "tp")),
+                "eb2": ((E, D), P("pp", None, "sp", None), ("dp", "tp")),
+            }
+        )
+    else:
+        table.update(
+            {
+                "w1": ((D, F), P("pp", None, None, "tp"), ("dp", "sp")),
+                "b1": ((F,), P("pp", None, "tp"), ("dp", "sp")),
+                "w2": ((F, D), P("pp", None, "tp", None), ("dp", "sp")),
+                "b2": ((D,), P("pp"), ("dp", "sp", "tp")),
+            }
+        )
+    return table
+
+
+_LAYER_PARAMS_PREFIXES = (
+    "ln1_", "ln2_", "wq", "wk", "wv", "wo", "w1", "b1", "w2", "b2",
+    "router", "ew1", "eb1", "ew2", "eb2",
+)
+
+
+def _is_layer_param(name: str) -> bool:
+    return any(name.startswith(p) for p in _LAYER_PARAMS_PREFIXES)
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    return {k: spec for k, (_, spec, _) in _layouts(cfg).items()}
+
+
+def grad_sync_axes(cfg: TransformerConfig) -> Dict[str, Tuple[str, ...]]:
+    return {k: axes for k, (_, _, axes) in _layouts(cfg).items()}
+
+
+def init_params(
+    cfg: TransformerConfig, seed: int = 0, pp_size: int = 1
+) -> Dict[str, np.ndarray]:
+    """Host-side init (numpy, float32).  Layer params get leading dims
+    (pp, layers_per_stage)."""
+    if cfg.n_layers % pp_size:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp {pp_size}")
+    lps = cfg.n_layers // pp_size
+    rng = np.random.default_rng(seed)
+    params: Dict[str, np.ndarray] = {}
+    for name, (shape, _, _) in _layouts(cfg).items():
+        if _is_layer_param(name):
+            full = (pp_size, lps) + shape
+        else:
+            full = shape
+        fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+        std = 0.02 if name in ("embed", "pos") else 1.0 / math.sqrt(fan_in)
+        if name.endswith("_s"):  # layernorm scales → ones
+            arr = np.ones(full, dtype=np.float32)
+        elif name.endswith("_b") or name.startswith("b") or name.startswith("eb"):
+            arr = np.zeros(full, dtype=np.float32)
+        else:
+            arr = rng.normal(0.0, std, size=full).astype(np.float32)
+        params[name] = arr
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces (run per-device inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _vary_all(x, mesh: Mesh):
+    """Mark a value as device-varying over the activation axes (VMA mode).
+
+    Activations vary over dp/sp (data) and pp (stage weights) but stay
+    *invariant* over tp: every row-parallel matmul ends in a psum over tp,
+    so the residual stream is numerically replicated across tp ranks and
+    must be typed accordingly (a psum of a replicated-but-varying-typed
+    value would silently multiply by the axis size).
+
+    Scan carries must keep a stable varying-axes type; starting them at the
+    full activation type avoids carry mismatches once sharded weights mix in.
+    """
+    all_axes = tuple(ax for ax in mesh.shape.keys() if ax != "tp")
+    if not all_axes:
+        return x
+
+    def cast(a):
+        try:
+            have = set(jax.typeof(a).vma)
+        except AttributeError:
+            have = set()
+        need = tuple(ax for ax in all_axes if ax not in have)
+        return lax.pcast(a, need, to="varying") if need else a
+
+    return jax.tree_util.tree_map(cast, x)
+
+
+def _ln(x, s, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * s + b
+
+
+def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
+    sp = mesh.shape.get("sp", 1)
+    tp = mesh.shape.get("tp", 1)
+    cdt = cfg.compute_dtype
+
+    def layer_fn(x, lp):
+        # x: (B, S_local, D)
+        h = _ln(x, lp["ln1_s"], lp["ln1_b"]).astype(cdt)
+        # tp-local heads: wq (D, H_local, dh)
+        q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(cdt))
+        k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(cdt))
+        v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(cdt))
+        attn = ring_attention(
+            q, k, v, axis_name="sp" if sp > 1 else None, axis_size=sp,
+            causal=cfg.causal,
+        )
+        o = jnp.einsum("bhsk,hkd->bsd", attn, lp["wo"].astype(cdt))
+        o = lax.psum(o, "tp")  # row-parallel combine (free at tp=1)
+        x = x + o.astype(x.dtype)
+
+        g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)
+        if cfg.moe:
+            b_, s_, d_ = g.shape
+            flat = g.reshape(b_ * s_, d_)
+            y = moe_mlp(
+                flat,
+                lp["router"].astype(cdt),
+                lp["ew1"].astype(cdt), lp["eb1"].astype(cdt),
+                lp["ew2"].astype(cdt), lp["eb2"].astype(cdt),
+                axis_name="sp" if sp > 1 else None,
+                axis_size=sp,
+                capacity_factor=cfg.capacity_factor,
+            ).reshape(b_, s_, d_)
+            aux = moe_aux_loss(
+                flat, lp["router"].astype(cdt), sp, lp["ew1"].shape[0]
+            )
+        else:
+            hmid = jax.nn.gelu(jnp.einsum("bsd,df->bsf", g, lp["w1"].astype(cdt)) + lp["b1"].astype(cdt))
+            y = jnp.einsum("bsf,fd->bsd", hmid, lp["w2"].astype(cdt))
+            y = lax.psum(y, "tp")  # row-parallel combine
+            y = y + lp["b2"].astype(cdt)
+            aux = jnp.zeros((), cdt)
+        x = x + y.astype(x.dtype)
+        return x, aux
+
+    def stage_fn(stage_params: Dict[str, jax.Array], x: jax.Array):
+        """Run this pp rank's layer stack via scan; stage_params leaves have
+        leading dim layers_per_stage."""
+
+        def body(carry, lp):
+            y, aux = layer_fn(carry, lp)
+            return y, aux
+
+        x, auxs = lax.scan(body, x, stage_params)
+        return x, jnp.sum(auxs)
+
+    return stage_fn
+
+
+def _pipeline(cfg: TransformerConfig, mesh: Mesh, stage_fn, stage_params, x_mb):
+    """GPipe-style pipelined forward under shard_map.
+
+    x_mb: (M, Bmb, S_local, D) embedded microbatches (meaningful on every
+    rank; only stage 0 consumes them).  Returns (M, Bmb, S_local, D) final
+    activations (meaningful on the last stage) and the masked MoE aux sum.
+
+    The schedule runs M + pp - 1 ticks; each tick every stage processes its
+    current microbatch and ppermutes the activation downstream.  Bubble
+    ticks compute garbage that is masked out of outputs and aux.
+    """
+    pp = mesh.shape.get("pp", 1)
+    if pp == 1:
+        def body(carry, x):
+            y, aux = stage_fn(stage_params, x)
+            return carry + aux, y
+        aux0 = _vary_all(jnp.zeros((), cfg.compute_dtype), mesh)
+        aux, ys = lax.scan(body, aux0, x_mb)
+        return ys, aux
+
+    idx = lax.axis_index("pp")
+    m = x_mb.shape[0]
+    ticks = m + pp - 1
+    perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def tick(carry, t):
+        buf, outputs, aux_acc = carry
+        mb = jnp.clip(t - idx, 0, m - 1)
+        x_in = jnp.where(idx == 0, lax.dynamic_index_in_dim(x_mb, mb, 0, keepdims=False), buf)
+        y, aux = stage_fn(stage_params, x_in)
+        valid = jnp.logical_and(t - idx >= 0, t - idx < m)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        is_last = idx == pp - 1
+        write = jnp.logical_and(valid, is_last)
+        prev = lax.dynamic_index_in_dim(outputs, mb, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, y, prev), mb, 0
+        )
+        buf_next = lax.ppermute(y, "pp", perm)
+        return (buf_next, outputs, aux_acc), None
+
+    buf0 = _vary_all(jnp.zeros_like(x_mb[0]), mesh)
+    out0 = _vary_all(jnp.zeros_like(x_mb), mesh)
+    aux0 = _vary_all(jnp.zeros((), cfg.compute_dtype), mesh)
+    (_, outputs, aux), _ = lax.scan(tick, (buf0, out0, aux0), jnp.arange(ticks))
+    return outputs, aux
+
+
+def _local_forward(cfg: TransformerConfig, mesh: Mesh, params, tokens):
+    """Per-device forward body: embed → pipeline → final-LN → logits.
+
+    tokens: (B_local, S_local) int32.  Returns ((M, Bmb, S_local, V) logits,
+    aux) — logits meaningful on the last pp stage.
+    """
+    pp = mesh.shape.get("pp", 1)
+    sp = mesh.shape.get("sp", 1)
+    stage_fn = _make_stage_fn(cfg, mesh)
+
+    # squeeze the pp-shard dim off layer params: (1, Lps, ...) → (Lps, ...)
+    stage_params = {
+        k: v[0] for k, v in params.items() if _is_layer_param(k)
+    }
+
+    b_local, s_local = tokens.shape
+    sp_idx = lax.axis_index("sp")
+    positions = sp_idx * s_local + jnp.arange(s_local)
+    x = params["embed"][tokens] + params["pos"][positions]
+    x = _vary_all(x.astype(cfg.compute_dtype), mesh)
+
+    m = cfg.microbatches or pp
+    if b_local % m:
+        raise ValueError(f"local batch {b_local} not divisible by {m} microbatches")
+    x_mb = x.reshape(m, b_local // m, s_local, cfg.d_model)
+
+    outputs, aux = _pipeline(cfg, mesh, stage_fn, stage_params, x_mb)
+    h = _ln(outputs, params["ln_f_s"], params["ln_f_b"]).astype(cfg.compute_dtype)
+    logits = jnp.einsum("mbsd,dv->mbsv", h, params["head"].astype(cfg.compute_dtype))
+    return logits, aux
+
+
+def _local_loss(cfg: TransformerConfig, mesh: Mesh, params, tokens, targets):
+    """Global mean token cross-entropy, identical on every rank after psums."""
+    pp = mesh.shape.get("pp", 1)
+    logits, aux = _local_forward(cfg, mesh, params, tokens)
+    m = logits.shape[0]
+    tgt = targets.reshape(m, -1, targets.shape[-1])
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(
+        logits.astype(jnp.float32), tgt[..., None], axis=-1
+    )[..., 0]
+    token_loss = logz - gold  # (M, Bmb, S_local)
+    local_sum = jnp.sum(token_loss)
+    local_cnt = jnp.sum(jnp.ones_like(token_loss))
+    # only the last stage holds real logits; the pp-psum picks its value
+    # (free no-ops at axis size 1, and they make the loss VMA-invariant
+    # over every mesh axis so it is truly replicated)
+    is_last = lax.axis_index("pp") == pp - 1
+    local_sum = jnp.where(is_last, local_sum, 0.0)
+    local_cnt = jnp.where(is_last, local_cnt, 0.0)
+    for ax in ("pp", "dp", "sp"):
+        local_sum = lax.psum(local_sum, ax)
+        local_cnt = lax.psum(local_cnt, ax)
+        aux = lax.psum(aux, ax)
+    loss = local_sum / local_cnt
+    if cfg.moe:
+        loss = loss + cfg.moe_aux_coef * aux.astype(jnp.float32)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# Public builders
+# ---------------------------------------------------------------------------
+
+
+def shard_params(params: Dict[str, np.ndarray], cfg: TransformerConfig, mesh: Mesh):
+    """device_put the host params with their NamedShardings."""
+    specs = param_specs(cfg)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }
+
+
+def build_forward(cfg: TransformerConfig, mesh: Mesh) -> Callable:
+    """Jitted SPMD forward: (params, tokens) → logits (M, Bmb, S_local, V).
+
+    Single-chip friendly: with a 1-device mesh all collectives degenerate.
+    """
+    specs = param_specs(cfg)
+    pp = mesh.shape.get("pp", 1)
+
+    def fwd(params, tokens):
+        logits, _ = _local_forward(cfg, mesh, params, tokens)
+        # select the last pipeline stage's logits (garbage elsewhere)
+        is_last = lax.axis_index("pp") == pp - 1
+        logits = lax.psum(jnp.where(is_last, logits, 0.0), "pp")
+        return logits
+
+    shmapped = jax.shard_map(
+        fwd,
+        mesh=mesh,
+        in_specs=(specs, P("dp", "sp")),
+        out_specs=P(None, "dp", "sp", None),
+        check_vma=True,
+    )
+    return jax.jit(shmapped)
+
+
+def build_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    donate: bool = True,
+) -> Callable:
+    """One compiled SPMD train step:
+    (params, opt_state, tokens, targets) → (params, opt_state, loss).
+
+    Gradient sync: per-parameter psum over exactly the mesh axes the
+    parameter is replicated on (the DistributedOptimizer semantics of the
+    reference, generalized to a 4-D mesh).  The optimizer update runs on
+    the sharded views under GSPMD propagation outside the shard_map.
+    """
+    specs = param_specs(cfg)
+
+    def loss_and_grad(params, tokens, targets):
+        # With VMA checking on, shard_map AD handles gradient sync itself:
+        # cotangents of replicated (invariant-typed) params are psum'd over
+        # exactly the axes they're replicated on — the DistributedOptimizer
+        # allreduce falls out of the type system, no manual collectives.
+        return jax.value_and_grad(
+            lambda p: _local_loss(cfg, mesh, p, tokens, targets)
+        )(params)
+
+    shmapped = jax.shard_map(
+        loss_and_grad,
+        mesh=mesh,
+        in_specs=(specs, P("dp", "sp"), P("dp", "sp")),
+        out_specs=(P(), specs),
+        check_vma=True,
+    )
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = shmapped(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,11 @@
+"""Parallelism strategies.
+
+The reference implements hierarchical data parallelism only (SURVEY §2.7);
+this package is the TPU build's superset: DP plus tensor (tp), pipeline
+(pp), sequence/context (sp, ring attention), and expert (ep) parallelism,
+all expressed as mesh axes under one ``shard_map`` — the north-star
+composition SURVEY §2.7/§7 calls for.
+"""
+
+from byteps_tpu.parallel.mesh_utils import factorize_mesh, make_training_mesh
+from byteps_tpu.parallel.ring_attention import ring_attention

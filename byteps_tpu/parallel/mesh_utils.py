@@ -1,0 +1,65 @@
+"""Mesh factorization helpers for multi-axis training meshes."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def factorize_mesh(
+    n_devices: int, want: Sequence[str] = ("pp", "sp", "tp", "dp")
+) -> Dict[str, int]:
+    """Split ``n_devices`` into axis sizes, preferring to give each axis in
+    ``want`` (priority order) a factor of 2 before growing any axis further.
+
+    E.g. 8 → {pp:2, sp:2, tp:2, dp:1}; 16 → {pp:2, sp:2, tp:2, dp:2};
+    4 → {pp:2, sp:2, tp:1, dp:1}; 1 → all 1.
+    """
+    sizes = {ax: 1 for ax in want}
+    remaining = n_devices
+    # distribute prime factors round-robin by priority
+    while remaining > 1:
+        progressed = False
+        for ax in want:
+            for p in (2, 3, 5, 7):
+                if remaining % p == 0:
+                    sizes[ax] *= p
+                    remaining //= p
+                    progressed = True
+                    break
+            if remaining == 1:
+                break
+        if not progressed:
+            # large prime: dump it on the last axis
+            sizes[want[-1]] *= remaining
+            remaining = 1
+    return sizes
+
+
+def make_training_mesh(
+    n_devices: Optional[int] = None,
+    axis_sizes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+    axis_order: Sequence[str] = ("dp", "pp", "sp", "tp"),
+) -> Mesh:
+    """Build a 4-D training mesh (dp, pp, sp, tp).
+
+    Expert parallelism reuses the ``sp`` axis (DeepSpeed-MoE-style grouping:
+    the ranks that shard the sequence also shard experts) so a 4-D mesh
+    exercises all five strategies.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if axis_sizes is None:
+        axis_sizes = factorize_mesh(n)
+        axis_sizes.setdefault("dp", 1)
+    shape = [axis_sizes.get(ax, 1) for ax in axis_order]
+    total = int(np.prod(shape))
+    if total != n:
+        raise ValueError(f"axis sizes {axis_sizes} != {n} devices")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_order))

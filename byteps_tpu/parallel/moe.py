@@ -1,0 +1,90 @@
+"""Expert parallelism: dense top-1 MoE with all-to-all dispatch.
+
+New scope beyond reference parity (SURVEY §2.7).  GShard-style dense
+formulation — routing is expressed as einsums with one-hot dispatch masks
+so everything is static-shaped for XLA, and tokens travel to their expert's
+rank via ``lax.all_to_all`` over the expert axis.
+
+Expert grouping follows DeepSpeed-MoE: the expert axis can be any mesh
+axis (we reuse ``sp`` in the default training mesh) — each rank in the
+group owns ``n_experts / group_size`` experts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_mlp(
+    x: jax.Array,
+    router_w: jax.Array,
+    w1: jax.Array,
+    b1: jax.Array,
+    w2: jax.Array,
+    b2: jax.Array,
+    axis_name: Optional[str],
+    axis_size: int,
+    capacity_factor: float = 2.0,
+) -> jax.Array:
+    """Top-1 routed expert MLP.
+
+    x:        (T, D) local tokens (flattened batch*seq)
+    router_w: (D, E) global router
+    w1:       (E_local, D, F), b1: (E_local, F)
+    w2:       (E_local, F, D), b2: (E_local, D)
+    where E = axis_size * E_local.
+
+    Returns (T, D).
+    """
+    t, d = x.shape
+    e_local = w1.shape[0]
+    e_total = e_local * max(1, axis_size)
+
+    logits = x @ router_w  # (T, E)
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)  # (T,)
+    gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=-1)[:, 0]
+
+    capacity = max(1, int(capacity_factor * t / e_total))
+    onehot = jax.nn.one_hot(expert_idx, e_total, dtype=x.dtype)  # (T, E)
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (T, E)
+    keep = (pos < capacity) * onehot  # drop overflow
+    pos_oh = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32), capacity, dtype=x.dtype)
+    # dispatch tensor: (T, E, C)
+    dispatch = keep[:, :, None] * pos_oh[:, None, :]
+    combine = dispatch * gate_val[:, None, None]
+
+    # gather tokens per expert slot: (E_total, C, D); global expert
+    # e = rank*e_local + local_idx, so contiguous dim-0 chunks map to ranks
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    if axis_name is not None and axis_size > 1:
+        # scatter expert chunks to their owning rank, gathering every
+        # peer's slots for OUR experts along the capacity dim:
+        # (E_total, C, D) → (E_local, n·C, D)
+        expert_in = lax.all_to_all(
+            expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    h = jnp.einsum("ecd,edf->ecf", expert_in, w1) + b1[:, None, :]
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+
+    if axis_name is not None and axis_size > 1:
+        # inverse route: (E_local, n·C, D) → (E_total, C, D)
+        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0, tiled=True)
+    # return tokens to their source positions, weighted by gate
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y
+
+
+def moe_aux_loss(x: jax.Array, router_w: jax.Array, axis_size: int, e_local: int) -> jax.Array:
+    """Load-balancing auxiliary loss (Switch-style): mean(gates)·mean(mask)·E."""
+    e_total = e_local * max(1, axis_size)
+    gates = jax.nn.softmax(x @ router_w, axis=-1)
+    mask = jax.nn.one_hot(jnp.argmax(gates, axis=-1), e_total, dtype=x.dtype)
+    return e_total * jnp.mean(jnp.mean(gates, axis=0) * jnp.mean(mask, axis=0))
