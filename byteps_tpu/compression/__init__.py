@@ -1,0 +1,24 @@
+"""Gradient compression subsystem.
+
+Two-level design matching the reference (docs/gradient-compression.md:9-21):
+level-1 intra-node fp16/bf16 casting lives in the plugin (``Compression``
+classes); level-2 aggressive inter-node compression runs on the host
+staging buffer after local reduce, before PUSH — these codecs.
+
+Codec compute prefers the native C++ library
+(byteps_tpu/native/compressor.cc); every codec also has a pure-numpy
+reference implementation that is bit-identical (shared xorshift128+ RNG),
+mirroring the reference's test strategy of re-simulating C++ codecs in
+numpy (tests/test_onebit.py etc., SURVEY §4).
+"""
+
+from byteps_tpu.compression.base import Compressor, Compression
+from byteps_tpu.compression.impl import (
+    OneBitCompressor,
+    TopKCompressor,
+    RandomKCompressor,
+    DitheringCompressor,
+)
+from byteps_tpu.compression.error_feedback import VanillaErrorFeedback
+from byteps_tpu.compression.momentum import NesterovMomentum
+from byteps_tpu.compression.registry import create_compressor

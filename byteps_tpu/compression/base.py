@@ -1,0 +1,78 @@
+"""Compressor interface + level-1 plugin compression.
+
+``Compressor`` mirrors the reference's C++ interface (compressor.h:53-127):
+``compress(fp32 array) → bytes``, ``decompress(bytes, n) → fp32 array``,
+plus ``sum_into`` for server-side sparse accumulation and
+``update_error`` used by the error-feedback decorator.
+
+``Compression`` mirrors the plugins' level-1 classes (torch/compression.py,
+mxnet/compression.py): none / fp16 (bf16 here — the TPU-native 16-bit).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class Compressor(abc.ABC):
+    """Level-2 codec operating on the flat fp32 staging buffer."""
+
+    def __init__(self, size: int) -> None:
+        self.size = size  # element count of the uncompressed tensor
+
+    @abc.abstractmethod
+    def compress(self, grad: np.ndarray) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def decompress(self, payload: bytes, n: int) -> np.ndarray:
+        ...
+
+    def sum_into(self, payload: bytes, acc: np.ndarray) -> None:
+        """Accumulate a compressed payload into a dense fp32 buffer
+        (server-side SUM_RECV).  Default: densify then add."""
+        acc += self.decompress(payload, acc.size)
+
+    def update_error(self, corrected: np.ndarray, payload: bytes) -> np.ndarray:
+        """e = corrected − decompress(compress(corrected)) — the
+        FastUpdateError hook (error_feedback.h:46-90)."""
+        return corrected - self.decompress(payload, corrected.size)
+
+
+class _NoneCompression:
+    def compress(self, tensor):
+        return tensor, None
+
+    def decompress(self, tensor, ctx):
+        return tensor
+
+
+class _Bf16Compression:
+    """Level-1: cast to bfloat16 for the wire (the reference uses fp16 —
+    compression.py in each plugin; bf16 is the TPU-native choice with the
+    same 2x ratio and a far safer exponent range)."""
+
+    def compress(self, tensor):
+        import ml_dtypes
+
+        t = np.asarray(tensor)
+        if t.dtype == np.float32:
+            return t.astype(ml_dtypes.bfloat16), t.dtype
+        return t, None
+
+    def decompress(self, tensor, ctx):
+        if ctx is not None:
+            return np.asarray(tensor).astype(ctx)
+        return tensor
+
+
+class Compression:
+    """Level-1 intra-node compression selectors (API parity with
+    bps.Compression.none / .fp16)."""
+
+    none = _NoneCompression()
+    fp16 = _Bf16Compression()  # name kept for API parity; bf16 on TPU
+    bf16 = _Bf16Compression()

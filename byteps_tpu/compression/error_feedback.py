@@ -1,0 +1,45 @@
+"""Error-feedback decorator (error_feedback.h:46-90).
+
+Wraps a codec: ``compress(g)`` first corrects the gradient with the
+residual of the previous round (``corrected = g + e``), compresses the
+corrected value, then stores the new residual
+``e = corrected − decompress(compressed)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from byteps_tpu.compression.base import Compressor
+
+
+class VanillaErrorFeedback(Compressor):
+    """Registered "vanilla_ef" in the reference
+    (vanilla_error_feedback.h:44-58; the lr.s mmap scaling is a
+    CrossBarrier-era detail — lr scaling is accepted via set_lr())."""
+
+    def __init__(self, inner: Compressor) -> None:
+        super().__init__(inner.size)
+        self.inner = inner
+        self.error: Optional[np.ndarray] = None
+        self.lr = 1.0
+
+    def set_lr(self, lr: float) -> None:
+        self.lr = float(lr)
+
+    def compress(self, grad: np.ndarray) -> bytes:
+        grad = np.ascontiguousarray(grad, dtype=np.float32)
+        if self.error is None:
+            self.error = np.zeros_like(grad)
+        corrected = grad + self.lr * self.error
+        payload = self.inner.compress(corrected)
+        self.error = self.inner.update_error(corrected, payload)
+        return payload
+
+    def decompress(self, payload: bytes, n: int) -> np.ndarray:
+        return self.inner.decompress(payload, n)
+
+    def sum_into(self, payload: bytes, acc: np.ndarray) -> None:
+        self.inner.sum_into(payload, acc)

@@ -1,0 +1,198 @@
+"""Codec implementations: native C++ fast path + bit-identical numpy.
+
+Wire formats (little-endian, defined in byteps_tpu/native/compressor.cc):
+
+    onebit:    [f32 scale][u32 packed sign words]      (bit set = negative)
+    topk:      [(i32 idx, f32 val) × k]  (indices ascending)
+    randomk:   [(i32 idx, f32 val) × k]  (indices from shared xorshift128+)
+    dithering: [f32 norm][i8 signed level × n]
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from byteps_tpu.compression.base import Compressor
+from byteps_tpu.compression.rng import XorShift128Plus, seed_pair_from
+from byteps_tpu.native import get_lib
+
+
+def _ptr(a: np.ndarray):
+    import ctypes
+
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class OneBitCompressor(Compressor):
+    """Sign compression packed 32:1, optional L1 scaling (onebit.cc:25,
+    registered "onebit_compressor")."""
+
+    def __init__(self, size: int, scaling: bool = False) -> None:
+        super().__init__(size)
+        self.scaling = scaling
+
+    def compress(self, grad: np.ndarray) -> bytes:
+        grad = np.ascontiguousarray(grad, dtype=np.float32)
+        n = grad.size
+        lib = get_lib()
+        if lib is not None:
+            out = np.empty(4 + 4 * ((n + 31) // 32), dtype=np.uint8)
+            ln = lib.bps_onebit_compress(_ptr(grad), n, _ptr(out), int(self.scaling))
+            return out[:ln].tobytes()
+        scale = np.float32(np.abs(grad).sum() / n) if self.scaling and n else np.float32(1.0)
+        neg = np.signbit(grad)
+        pad = (-n) % 32
+        bits = np.concatenate([neg, np.zeros(pad, bool)]).reshape(-1, 32)
+        words = (bits * (1 << np.arange(32, dtype=np.uint64))).sum(1).astype(np.uint32)
+        return np.float32(scale).tobytes() + words.tobytes()
+
+    def decompress(self, payload: bytes, n: int) -> np.ndarray:
+        lib = get_lib()
+        if lib is not None:
+            buf = np.frombuffer(payload, dtype=np.uint8)
+            out = np.empty(n, dtype=np.float32)
+            lib.bps_onebit_decompress(_ptr(buf), n, _ptr(out))
+            return out
+        scale = np.frombuffer(payload[:4], dtype=np.float32)[0]
+        words = np.frombuffer(payload[4:], dtype=np.uint32)
+        bits = (words[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+        neg = bits.reshape(-1)[:n].astype(bool)
+        return np.where(neg, -scale, scale).astype(np.float32)
+
+
+class TopKCompressor(Compressor):
+    """Largest-k (index, value) pairs (topk.cc:26)."""
+
+    def __init__(self, size: int, k: int) -> None:
+        super().__init__(size)
+        self.k = max(1, min(int(k), size))
+
+    def compress(self, grad: np.ndarray) -> bytes:
+        grad = np.ascontiguousarray(grad, dtype=np.float32)
+        n, k = grad.size, min(self.k, grad.size)
+        lib = get_lib()
+        if lib is not None:
+            out = np.empty(8 * k, dtype=np.uint8)
+            ln = lib.bps_topk_compress(_ptr(grad), n, k, _ptr(out))
+            return out[:ln].tobytes()
+        idx = np.argpartition(-np.abs(grad), k - 1)[:k]
+        idx.sort()
+        rec = np.empty(k, dtype=[("i", "<i4"), ("v", "<f4")])
+        rec["i"] = idx
+        rec["v"] = grad[idx]
+        return rec.tobytes()
+
+    def decompress(self, payload: bytes, n: int) -> np.ndarray:
+        rec = np.frombuffer(payload, dtype=[("i", "<i4"), ("v", "<f4")])
+        out = np.zeros(n, dtype=np.float32)
+        out[rec["i"]] = rec["v"]
+        return out
+
+    def sum_into(self, payload: bytes, acc: np.ndarray) -> None:
+        rec = np.frombuffer(payload, dtype=[("i", "<i4"), ("v", "<f4")])
+        np.add.at(acc, rec["i"], rec["v"])
+
+
+class RandomKCompressor(Compressor):
+    """Random-k with shared xorshift128+ seed (randomk.cc:25): worker and
+    server derive identical index draws from the declared seed."""
+
+    def __init__(self, size: int, k: int, seed: int = 0) -> None:
+        super().__init__(size)
+        self.k = max(1, min(int(k), size))
+        self.s0, self.s1 = seed_pair_from(seed)
+
+    def compress(self, grad: np.ndarray) -> bytes:
+        grad = np.ascontiguousarray(grad, dtype=np.float32)
+        n, k = grad.size, min(self.k, grad.size)
+        lib = get_lib()
+        if lib is not None:
+            out = np.empty(8 * k, dtype=np.uint8)
+            ln = lib.bps_randomk_compress(_ptr(grad), n, k, self.s0, self.s1, _ptr(out))
+            return out[:ln].tobytes()
+        rng = XorShift128Plus(self.s0, self.s1)
+        idx = np.array([rng.next() % n for _ in range(k)], dtype=np.int32)
+        rec = np.empty(k, dtype=[("i", "<i4"), ("v", "<f4")])
+        rec["i"] = idx
+        rec["v"] = grad[idx]
+        return rec.tobytes()
+
+    decompress = TopKCompressor.decompress
+    sum_into = TopKCompressor.sum_into
+
+
+class DitheringCompressor(Compressor):
+    """Stochastic quantization with linear/natural partition and max/L2
+    norm (dithering.h:43-78)."""
+
+    def __init__(
+        self, size: int, k: int = 4, partition: str = "linear",
+        normalize: str = "max", seed: int = 0,
+    ) -> None:
+        super().__init__(size)
+        self.s = max(1, int(k))  # number of levels
+        self.natural = 1 if partition in ("natural", "1", 1) else 0
+        self.l2 = 1 if normalize in ("l2", "L2", "1", 1) else 0
+        self.s0, self.s1 = seed_pair_from(seed)
+
+    def compress(self, grad: np.ndarray) -> bytes:
+        grad = np.ascontiguousarray(grad, dtype=np.float32)
+        n = grad.size
+        lib = get_lib()
+        if lib is not None:
+            out = np.empty(4 + n, dtype=np.uint8)
+            ln = lib.bps_dithering_compress(
+                _ptr(grad), n, self.s, self.natural, self.l2,
+                self.s0, self.s1, _ptr(out),
+            )
+            return out[:ln].tobytes()
+        # numpy reference (scalar loop on the shared RNG for bit parity)
+        norm = float(np.sqrt((grad.astype(np.float64) ** 2).sum())) if self.l2 \
+            else float(np.abs(grad.astype(np.float64)).max(initial=0.0))
+        if norm == 0.0:
+            norm = 1.0
+        rng = XorShift128Plus(self.s0, self.s1)
+        levels = np.zeros(n, dtype=np.int8)
+        s = self.s
+        for i in range(n):
+            p = abs(float(grad[i])) / norm
+            u = rng.uniform()
+            if self.natural:
+                if p <= 0.0:
+                    level = 0
+                else:
+                    j = int(np.floor(np.log2(p)))
+                    if j >= 0:
+                        level = s
+                    elif j < -s:
+                        lo, hi = 0.0, 2.0 ** (-s)
+                        level = 1 if (p - lo) / (hi - lo) > u else 0
+                    else:
+                        lo, hi = 2.0 ** j, 2.0 ** (j + 1)
+                        jl = s + j
+                        level = jl + 1 if (p - lo) / (hi - lo) > u else jl
+            else:
+                scaled = p * s
+                fl = int(np.floor(scaled))
+                level = fl + (1 if scaled - fl > u else 0)
+                level = min(level, s)
+            levels[i] = -level if np.signbit(grad[i]) else level
+        return np.float32(norm).tobytes() + levels.tobytes()
+
+    def decompress(self, payload: bytes, n: int) -> np.ndarray:
+        lib = get_lib()
+        if lib is not None:
+            buf = np.frombuffer(payload, dtype=np.uint8)
+            out = np.empty(n, dtype=np.float32)
+            lib.bps_dithering_decompress(_ptr(buf), n, self.s, self.natural, _ptr(out))
+            return out
+        norm = np.frombuffer(payload[:4], dtype=np.float32)[0]
+        levels = np.frombuffer(payload[4:4 + n], dtype=np.int8).astype(np.int32)
+        a = np.abs(levels)
+        if self.natural:
+            mag = np.where(a == 0, 0.0, 2.0 ** (a.astype(np.float64) - self.s))
+        else:
+            mag = a.astype(np.float64) / self.s
+        return (np.sign(levels) * mag * norm).astype(np.float32)

@@ -1,0 +1,93 @@
+"""Compressor factory from kwargs dicts.
+
+Parity with CompressorRegistry::Create (compressor_registry.cc:39-56) and
+the plugin-side kwargs translation (mxnet/__init__.py:236-290): config
+flows as a str→str dict with ``byteps_``-prefixed keys:
+
+    byteps_compressor_type           onebit | topk | randomk | dithering
+    byteps_compressor_onebit_scaling "True"/"False"
+    byteps_compressor_k              int (count, or ratio if < 1)
+    byteps_ef_type                   vanilla
+    byteps_momentum_type             nesterov
+    byteps_momentum_mu               float
+    byteps_seed                      int (shared randomk/dithering seed)
+    byteps_dithering_partition       0 (linear) | 1 (natural)
+    byteps_dithering_normalize       0 (max) | 1 (l2)
+
+Decorator chain: momentum → error-feedback → codec; the server passes
+``server=True`` to skip momentum.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from byteps_tpu.compression.base import Compressor
+from byteps_tpu.compression.error_feedback import VanillaErrorFeedback
+from byteps_tpu.compression.impl import (
+    DitheringCompressor,
+    OneBitCompressor,
+    RandomKCompressor,
+    TopKCompressor,
+)
+from byteps_tpu.compression.momentum import NesterovMomentum
+
+
+def _parse_k(kwargs: Dict[str, str], size: int) -> int:
+    raw = kwargs.get("byteps_compressor_k", "1")
+    val = float(raw)
+    if 0 < val < 1:  # ratio semantics (topk.cc:30-36)
+        return max(1, int(val * size))
+    return max(1, int(val))
+
+
+def create_compressor(
+    kwargs: Dict[str, str], size: int, server: bool = False
+) -> Optional[Compressor]:
+    """Build the decorator chain for a declared tensor; None when no
+    compressor is configured."""
+    kwargs = {str(k): str(v) for k, v in kwargs.items()}
+    ctype = kwargs.get("byteps_compressor_type") or kwargs.get("compressor")
+    if not ctype:
+        return None
+    seed = int(float(kwargs.get("byteps_seed", kwargs.get("seed", "0"))))
+
+    if ctype == "onebit":
+        scaling = kwargs.get(
+            "byteps_compressor_onebit_scaling", kwargs.get("scaling", "False")
+        ).lower() in ("true", "1")
+        codec: Compressor = OneBitCompressor(size, scaling=scaling)
+    elif ctype == "topk":
+        codec = TopKCompressor(size, _parse_k(kwargs, size))
+    elif ctype == "randomk":
+        codec = RandomKCompressor(size, _parse_k(kwargs, size), seed=seed)
+    elif ctype == "dithering":
+        codec = DitheringCompressor(
+            size,
+            k=_parse_k(kwargs, size),
+            partition="natural"
+            if kwargs.get("byteps_dithering_partition", "0") in ("1", "natural")
+            else "linear",
+            normalize="l2"
+            if kwargs.get("byteps_dithering_normalize", "0") in ("1", "l2")
+            else "max",
+            seed=seed,
+        )
+    else:
+        raise ValueError(f"unknown compressor type {ctype!r}")
+
+    ef = kwargs.get("byteps_ef_type") or kwargs.get("ef")
+    if ef:
+        if ef != "vanilla":
+            raise ValueError(f"unknown error-feedback type {ef!r}")
+        codec = VanillaErrorFeedback(codec)
+
+    if not server:
+        mom = kwargs.get("byteps_momentum_type") or kwargs.get("momentum")
+        if mom:
+            if mom != "nesterov":
+                raise ValueError(f"unknown momentum type {mom!r}")
+            mu = float(kwargs.get("byteps_momentum_mu", "0.9"))
+            codec = NesterovMomentum(codec, mu=mu)
+
+    return codec

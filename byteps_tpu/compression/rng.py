@@ -1,0 +1,48 @@
+"""xorshift128+ shared-seed RNG.
+
+Worker and server must draw identical random index/quantization sequences
+(randomk's whole correctness rests on it — randomk.cc:25, utils.h RNG in
+the reference; the reference tests reimplement it in numpy,
+tests/utils.py:32-51).  This numpy implementation is bit-identical to
+byteps_tpu/native/compressor.cc's xorshift128p.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MASK = np.uint64(0xFFFFFFFFFFFFFFFF)
+DEFAULT_S0 = 0x9E3779B97F4A7C15
+DEFAULT_S1 = 0xBF58476D1CE4E5B9
+
+
+class XorShift128Plus:
+    def __init__(self, s0: int = DEFAULT_S0, s1: int = DEFAULT_S1) -> None:
+        self.s0 = np.uint64(s0 if s0 else DEFAULT_S0)
+        self.s1 = np.uint64(s1 if s1 else DEFAULT_S1)
+
+    def next(self) -> int:
+        with np.errstate(over="ignore"):
+            x = self.s0
+            y = self.s1
+            self.s0 = y
+            x = (x ^ (x << np.uint64(23))) & _MASK
+            self.s1 = x ^ y ^ (x >> np.uint64(17)) ^ (y >> np.uint64(26))
+            return int((self.s1 + y) & _MASK)
+
+    def uniform(self) -> float:
+        """[0,1) double with 53-bit mantissa, matching the C++ (>>11 * 2^-53)."""
+        return (self.next() >> 11) * (1.0 / 9007199254740992.0)
+
+
+def seed_pair_from(seed: int) -> tuple:
+    """Derive a (s0, s1) pair from a single integer seed (splitmix-style)."""
+    if not seed:
+        return DEFAULT_S0, DEFAULT_S1
+    z = (seed + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    s0 = (z ^ (z >> 27)) or DEFAULT_S0
+    z = (z + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    s1 = (z ^ (z >> 27)) or DEFAULT_S1
+    return s0, s1

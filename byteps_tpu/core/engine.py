@@ -33,6 +33,7 @@ from byteps_tpu.common.partition import partition_tensor
 from byteps_tpu.common.registry import get_registry
 from byteps_tpu.common.types import (
     QueueType,
+    RequestType,
     Status,
     TensorTableEntry,
     to_datatype,
@@ -70,6 +71,10 @@ class PipelineEngine:
     #: host pipeline stage order (PS path); COMPRESS/DECOMPRESS spliced in
     #: when the tensor has a registered compressor (operations.cc:199-204)
     STAGES = [QueueType.COPYD2H, QueueType.PUSH, QueueType.PULL, QueueType.COPYH2D]
+    STAGES_COMPRESSED = [
+        QueueType.COPYD2H, QueueType.COMPRESS, QueueType.PUSH,
+        QueueType.PULL, QueueType.DECOMPRESS, QueueType.COPYH2D,
+    ]
 
     def __init__(self, cfg: Config, ps_client, telemetry=None, tracer=None) -> None:
         self.cfg = cfg
@@ -80,12 +85,17 @@ class PipelineEngine:
         credit = cfg.scheduling_credit
         self.queues: Dict[QueueType, ScheduledQueue] = {
             QueueType.COPYD2H: ScheduledQueue(QueueType.COPYD2H),
+            QueueType.COMPRESS: ScheduledQueue(QueueType.COMPRESS),
             QueueType.PUSH: ScheduledQueue(QueueType.PUSH, credit_bytes=credit),
             QueueType.PULL: ScheduledQueue(QueueType.PULL),
+            QueueType.DECOMPRESS: ScheduledQueue(QueueType.DECOMPRESS),
             QueueType.COPYH2D: ScheduledQueue(QueueType.COPYH2D),
         }
         self._threads: List[threading.Thread] = []
         self._init_lock = threading.Lock()
+        # per-key stateful codec chains (per-partition compressor
+        # instantiation, operations.cc:283-414)
+        self._compressors: Dict[int, object] = {}
 
     # --- lifecycle -------------------------------------------------------
 
@@ -94,8 +104,10 @@ class PipelineEngine:
         global.cc:299-317)."""
         for qt, fn in (
             (QueueType.COPYD2H, self._copy_d2h_once),
+            (QueueType.COMPRESS, self._compress_once),
             (QueueType.PUSH, self._push_once),
             (QueueType.PULL, self._pull_once),
+            (QueueType.DECOMPRESS, self._decompress_once),
             (QueueType.COPYH2D, self._copy_h2d_once),
         ):
             t = threading.Thread(
@@ -154,9 +166,7 @@ class PipelineEngine:
                     # blocking init-push doubles as the cross-worker barrier
                     # for the key (operations.cc:283-414)
                     self.client.init_tensor(part.key, part.length, dtype_id)
-                if ctx.kwargs.get("compressor"):
-                    for part in ctx.partitions:
-                        self.client.register_compressor(part.key, ctx.kwargs)
+                self._maybe_setup_compression(ctx, flat)
                 ctx.initialized = True
 
         ctx.version += 1
@@ -167,6 +177,8 @@ class PipelineEngine:
             pending=len(ctx.partitions), shape=np.shape(tensor),
             np_dtype=flat.dtype, is_jax=is_jax, version=ctx.version,
         )
+        compressed = ctx.partitions and ctx.partitions[0].key in self._compressors
+        stages = self.STAGES_COMPRESSED if compressed else self.STAGES
         for part in ctx.partitions:
             task = TensorTableEntry(
                 tensor_name=name,
@@ -176,10 +188,32 @@ class PipelineEngine:
                 offset=part.offset,
                 length=part.length,
                 total_partnum=len(ctx.partitions),
-                queue_list=list(self.STAGES),
+                queue_list=list(stages),
                 context=job,
             )
             self.queues[QueueType.COPYD2H].add_task(task)
+
+    def _maybe_setup_compression(self, ctx, flat: np.ndarray) -> None:
+        """Instantiate per-partition codec chains and ship the config to the
+        owning servers (InitTensor's kCompressedPushPull push,
+        operations.cc:396-408).  Engages only for fp32 tensors at least
+        BYTEPS_MIN_COMPRESS_BYTES large (global.cc:137)."""
+        from byteps_tpu.compression.registry import create_compressor
+
+        has_cfg = any(
+            k in ctx.kwargs
+            for k in ("byteps_compressor_type", "compressor")
+        )
+        if not has_cfg or flat.dtype != np.float32:
+            return
+        if flat.nbytes < self.cfg.min_compress_bytes:
+            return
+        for part in ctx.partitions:
+            codec = create_compressor(ctx.kwargs, part.length, server=False)
+            if codec is None:
+                return
+            self._compressors[part.key] = codec
+            self.client.register_compressor(part.key, ctx.kwargs)
 
     # --- stage bodies ----------------------------------------------------
 
@@ -230,30 +264,61 @@ class PipelineEngine:
         task.cpubuff = job.flat[task.offset : task.offset + task.length]
         self._proceed(task)
 
+    def _compress_once(self, task: TensorTableEntry) -> None:
+        """COMPRESS stage (core_loops.cc:498-536): run the codec chain on
+        the staged partition.  One thread per stage serializes same-key
+        rounds, keeping stateful EF/momentum buffers race-free."""
+        codec = self._compressors[task.key]
+        task.compressed = codec.compress(task.cpubuff)
+        self._proceed(task)
+
     def _push_once(self, task: TensorTableEntry) -> None:
         """Priority-ordered ZPush (RunPushLoopOnce, core_loops.cc:538-582)."""
         job: _Job = task.context
-        payload = task.cpubuff.tobytes()
+        if task.compressed is not None:
+            payload = task.compressed
+            rtype = RequestType.COMPRESSED_PUSH_PULL
+        else:
+            payload = task.cpubuff.tobytes()
+            rtype = RequestType.DEFAULT_PUSH_PULL
         if self.telemetry is not None:
             self.telemetry.record(len(payload))
         self.client.push(
             task.key, payload, job.dtype_id, task.version,
             cb=lambda: self._proceed(task),
+            request_type=rtype,
         )
 
     def _pull_once(self, task: TensorTableEntry) -> None:
         """ZPull into the result buffer (RunPullLoopOnce,
         core_loops.cc:584-618)."""
         job: _Job = task.context
+        compressed = task.key in self._compressors
 
         def on_pull(payload: bytes) -> None:
-            arr = np.frombuffer(payload, dtype=job.np_dtype)
-            job.result[task.offset : task.offset + task.length] = arr[: task.length]
             if self.telemetry is not None:
                 self.telemetry.record(len(payload))
+            if compressed:
+                task.compressed = payload  # decoded by DECOMPRESS stage
+            else:
+                arr = np.frombuffer(payload, dtype=job.np_dtype)
+                job.result[task.offset : task.offset + task.length] = arr[: task.length]
             self._proceed(task)
 
-        self.client.pull(task.key, task.version, on_pull, dtype_id=job.dtype_id)
+        self.client.pull(
+            task.key, task.version, on_pull, dtype_id=job.dtype_id,
+            request_type=RequestType.COMPRESSED_PUSH_PULL
+            if compressed else RequestType.DEFAULT_PUSH_PULL,
+        )
+
+    def _decompress_once(self, task: TensorTableEntry) -> None:
+        """DECOMPRESS stage: decode the pulled merged payload
+        (core_loops.cc:620-648)."""
+        job: _Job = task.context
+        codec = self._compressors[task.key]
+        arr = codec.decompress(task.compressed, task.length)
+        job.result[task.offset : task.offset + task.length] = arr[: task.length]
+        self._proceed(task)
 
     def _copy_h2d_once(self, task: TensorTableEntry) -> None:
         """Host→device hand-back (COPYH2D, core_loops.cc:650-753).  The

@@ -1,0 +1,107 @@
+"""ctypes bindings for the native C++ core (reducer + compression codecs).
+
+The library is built from byteps_tpu/native/*.cc via the Makefile; import
+succeeds (``HAVE_NATIVE = False``) even when the .so is missing so pure-
+Python fallbacks can take over (the reference hard-requires its C++ core;
+we degrade gracefully for portability but production runs should build it).
+
+Build: ``make -C byteps_tpu/native`` (auto-attempted on first import).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libbyteps_tpu.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _try_build() -> None:
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        pass
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and os.environ.get("BYTEPS_NATIVE_AUTOBUILD", "1") != "0":
+        _try_build()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    c = ctypes
+    lib.bps_sum.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int32]
+    lib.bps_sum.restype = c.c_int32
+    lib.bps_sum_scaled_f32.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64, c.c_float]
+    lib.bps_sum_scaled_f32.restype = c.c_int32
+    lib.bps_onebit_size.argtypes = [c.c_int64]
+    lib.bps_onebit_size.restype = c.c_int64
+    lib.bps_onebit_compress.argtypes = [c.c_void_p, c.c_int64, c.c_void_p, c.c_int32]
+    lib.bps_onebit_compress.restype = c.c_int64
+    lib.bps_onebit_decompress.argtypes = [c.c_void_p, c.c_int64, c.c_void_p]
+    lib.bps_onebit_decompress.restype = c.c_int32
+    lib.bps_topk_compress.argtypes = [c.c_void_p, c.c_int64, c.c_int64, c.c_void_p]
+    lib.bps_topk_compress.restype = c.c_int64
+    lib.bps_topk_decompress.argtypes = [c.c_void_p, c.c_int64, c.c_void_p, c.c_int64]
+    lib.bps_topk_decompress.restype = c.c_int32
+    lib.bps_topk_sum_into.argtypes = [c.c_void_p, c.c_int64, c.c_void_p, c.c_int64]
+    lib.bps_topk_sum_into.restype = c.c_int32
+    lib.bps_randomk_compress.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int64, c.c_uint64, c.c_uint64, c.c_void_p,
+    ]
+    lib.bps_randomk_compress.restype = c.c_int64
+    lib.bps_dithering_compress.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int32, c.c_int32, c.c_int32,
+        c.c_uint64, c.c_uint64, c.c_void_p,
+    ]
+    lib.bps_dithering_compress.restype = c.c_int64
+    lib.bps_dithering_decompress.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int32, c.c_int32, c.c_void_p,
+    ]
+    lib.bps_dithering_decompress.restype = c.c_int32
+    _lib = lib
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    return _load()
+
+
+HAVE_NATIVE = _load() is not None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class cpu_reducer:
+    """Namespace mirroring CpuReducer (cpu_reducer.h:40-205)."""
+
+    @staticmethod
+    def sum_into(dst: np.ndarray, src: np.ndarray) -> None:
+        """dst[:len(src)] += src (native when available)."""
+        from byteps_tpu.common.types import to_datatype
+
+        lib = _load()
+        n = src.size
+        if lib is None or not dst.flags.c_contiguous or not src.flags.c_contiguous:
+            np.add(dst[:n], src, out=dst[:n])
+            return
+        rc = lib.bps_sum(_ptr(dst), _ptr(src), n, int(to_datatype(src.dtype)))
+        if rc != 0:  # unsupported dtype → numpy
+            np.add(dst[:n], src, out=dst[:n])

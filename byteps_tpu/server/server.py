@@ -35,7 +35,12 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from byteps_tpu.common.config import Config
-from byteps_tpu.common.types import DataType, decode_command_type, to_numpy_dtype
+from byteps_tpu.common.types import (
+    DataType,
+    RequestType,
+    decode_command_type,
+    to_numpy_dtype,
+)
 from byteps_tpu.comm.transport import (
     Message,
     Op,
@@ -58,6 +63,8 @@ class _KeyState:
         "init_waiters",
         "dtype",
         "compressor_kwargs",
+        "compressor",
+        "pull_payload",
         "lock",
     )
 
@@ -71,7 +78,21 @@ class _KeyState:
         self.init_waiters: List[Tuple[socket.socket, threading.Lock, int]] = []
         self.dtype: Optional[np.dtype] = None
         self.compressor_kwargs: Dict[str, str] = {}
+        self.compressor = None  # server-side chain (no momentum)
+        self.pull_payload: Optional[bytes] = None  # compressed merged result
         self.lock = threading.Lock()
+
+    def wire_payload(self, compressed: bool, async_mode: bool = False) -> bytes:
+        """What a puller receives, honoring ITS requested wire format:
+        compressed pulls get the codec-compressed merged result
+        (server.cc:92-118), default pulls get raw bytes — mixed-config
+        workers on one key stay correct.  In async mode the store mutates
+        every push, so compressed pulls encode on demand."""
+        if compressed and self.compressor is not None:
+            if async_mode or self.pull_payload is None:
+                return self.compressor.compress(self.store)
+            return self.pull_payload
+        return self.store.tobytes()
 
 
 class _EngineQueue:
@@ -188,8 +209,17 @@ class PSServer:
                 if msg.op in (Op.PUSH, Op.PULL, Op.INIT):
                     self._enqueue(msg, conn, send_lock)
                 elif msg.op == Op.REGISTER_COMPRESSOR:
+                    # compressor registration init-push (server.cc:228-257);
+                    # server chain skips momentum (compressor_registry.cc:44)
+                    from byteps_tpu.compression.registry import create_compressor
+
                     ks = self._key_state(msg.key)
-                    ks.compressor_kwargs = pickle.loads(msg.payload)
+                    with ks.lock:
+                        ks.compressor_kwargs = pickle.loads(msg.payload)
+                        size = ks.store.size if ks.store is not None else 0
+                        ks.compressor = create_compressor(
+                            ks.compressor_kwargs, size, server=True
+                        )
                     send_message(conn, Message(Op.REGISTER_COMPRESSOR, seq=msg.seq), send_lock)
                 elif msg.op == Op.PING:
                     send_message(conn, Message(Op.PING, seq=msg.seq), send_lock)
@@ -260,8 +290,12 @@ class PSServer:
 
     def _handle_push(self, msg: Message, conn, send_lock) -> None:
         ks = self._key_state(msg.key)
-        _, dtype_id = decode_command_type(msg.cmd)
-        arr = np.frombuffer(msg.payload, dtype=to_numpy_dtype(DataType(dtype_id)))
+        rtype, dtype_id = decode_command_type(msg.cmd)
+        compressed = (
+            rtype == RequestType.COMPRESSED_PUSH_PULL and ks.compressor is not None
+        )
+        if not compressed:
+            arr = np.frombuffer(msg.payload, dtype=to_numpy_dtype(DataType(dtype_id)))
         flush: List = []
         with ks.lock:
             if ks.store is None:
@@ -269,11 +303,20 @@ class PSServer:
             if self.cfg.enable_async:
                 # async mode: parameter store, sum deltas in place
                 # (server.cc:315-319)
-                self._reducer(ks.store, arr)
+                if compressed:
+                    ks.compressor.sum_into(msg.payload, ks.store)
+                else:
+                    self._reducer(ks.store, arr)
                 ks.store_version += 1
                 ks.pushed_total += 1
             else:
-                if ks.recv_count == 0:
+                if compressed:
+                    # decompress-then-sum (server.cc:92-118)
+                    if ks.recv_count == 0:
+                        ks.accum[:] = ks.compressor.decompress(msg.payload, ks.accum.size)
+                    else:
+                        ks.compressor.sum_into(msg.payload, ks.accum)
+                elif ks.recv_count == 0:
                     ks.accum[: len(arr)] = arr  # COPY_FIRST (server.cc:296)
                 else:
                     self._reducer(ks.accum, arr)  # SUM_RECV
@@ -285,12 +328,18 @@ class PSServer:
                     ks.store, ks.accum = ks.accum, ks.store
                     ks.store_version += 1
                     ks.recv_count = 0
+                    if compressed:
+                        # compress the merged result once per round for
+                        # pull responses (server.cc:348-370)
+                        ks.pull_payload = ks.compressor.compress(ks.store)
                     still_pending = []
-                    for version, pconn, plock, pseq in ks.pending_pulls:
+                    for version, pconn, plock, pseq, pcomp in ks.pending_pulls:
                         if version <= ks.store_version:
-                            flush.append((pconn, plock, pseq, ks.store.tobytes(), ks.store_version))
+                            flush.append(
+                                (pconn, plock, pseq, ks.wire_payload(pcomp), ks.store_version)
+                            )
                         else:
-                            still_pending.append((version, pconn, plock, pseq))
+                            still_pending.append((version, pconn, plock, pseq, pcomp))
                     ks.pending_pulls = still_pending
         send_message(conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version), send_lock)
         for pconn, plock, pseq, payload, ver in flush:
@@ -302,15 +351,19 @@ class PSServer:
 
     def _handle_pull(self, msg: Message, conn, send_lock) -> None:
         ks = self._key_state(msg.key)
+        rtype, _ = decode_command_type(msg.cmd)
+        wants_compressed = rtype == RequestType.COMPRESSED_PUSH_PULL
         with ks.lock:
             if ks.store is None:
                 raise ConnectionError(f"pull for uninitialized key {msg.key}")
             ready = self.cfg.enable_async or msg.version <= ks.store_version
             if ready:
-                payload = ks.store.tobytes()
+                payload = ks.wire_payload(wants_compressed, self.cfg.enable_async)
                 ver = ks.store_version
             else:
-                ks.pending_pulls.append((msg.version, conn, send_lock, msg.seq))
+                ks.pending_pulls.append(
+                    (msg.version, conn, send_lock, msg.seq, wants_compressed)
+                )
                 return
         send_message(
             conn, Message(Op.PULL, key=msg.key, payload=payload, seq=msg.seq, version=ver), send_lock

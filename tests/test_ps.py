@@ -131,6 +131,113 @@ class TestFakeCluster:
         assert "PUSH" in stages and "PULL" in stages
 
 
+class TestCompressionOverPS:
+    """End-to-end gradient compression through the real PS path — the
+    reference's compression tests run a full fake cluster the same way
+    (tests/test_onebit.py + meta_test.py with BYTEPS_MIN_COMPRESS_BYTES=0)."""
+
+    def test_topk_full_k_is_lossless_identity(self, fake_cluster, monkeypatch):
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        import byteps_tpu as bps
+
+        bps.init()
+        n = 256
+        bps.declare_tensor(
+            "c.topk", byteps_compressor_type="topk", byteps_compressor_k=str(n)
+        )
+        x = np.random.default_rng(0).normal(size=n).astype(np.float32)
+        out = bps.push_pull(x, name="c.topk", average=False)
+        np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+        bps.shutdown()
+
+    def test_onebit_signs_through_ps(self, fake_cluster, monkeypatch):
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        import byteps_tpu as bps
+        from byteps_tpu.compression.impl import OneBitCompressor
+
+        bps.init()
+        n = 128
+        bps.declare_tensor(
+            "c.onebit",
+            byteps_compressor_type="onebit",
+            byteps_compressor_onebit_scaling="True",
+        )
+        x = np.random.default_rng(1).normal(size=n).astype(np.float32)
+        out = np.asarray(bps.push_pull(x, name="c.onebit", average=False))
+        # 1 worker ⇒ server stores decompress(compress(x)); pull returns
+        # compress of that again — simulate the double codec pass
+        sim = OneBitCompressor(n, scaling=True)
+        once = sim.decompress(sim.compress(x), n)
+        sim2 = OneBitCompressor(n, scaling=True)
+        expected = sim2.decompress(sim2.compress(once), n)
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+        bps.shutdown()
+
+    def test_ef_chain_trajectory_matches_simulation(self, fake_cluster, monkeypatch):
+        """Multi-round randomk+EF through the PS must bit-match an
+        in-process simulation of the worker→server→worker codec chain
+        (the reference's numpy re-simulation strategy)."""
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        import byteps_tpu as bps
+        from byteps_tpu.compression.registry import create_compressor
+
+        bps.init()
+        n, rounds = 64, 5
+        kwargs = {
+            "byteps_compressor_type": "randomk",
+            "byteps_compressor_k": "16",
+            "byteps_ef_type": "vanilla",
+            "byteps_seed": "77",
+        }
+        bps.declare_tensor("c.ef", **kwargs)
+        worker_sim = create_compressor(kwargs, n, server=False)
+        server_sim = create_compressor(kwargs, n, server=True)
+        rng = np.random.default_rng(2)
+        for r in range(rounds):
+            g = rng.normal(size=n).astype(np.float32)
+            out = np.asarray(bps.push_pull(g, name="c.ef", average=False))
+            pushed = worker_sim.compress(g)
+            merged = worker_sim.decompress(pushed, n)  # 1 worker: sum = self
+            pulled = server_sim.compress(merged)
+            expected = server_sim.decompress(pulled, n)
+            np.testing.assert_allclose(out, expected, rtol=1e-6, err_msg=f"round {r}")
+        bps.shutdown()
+
+
+    def test_async_mode_with_compression(self, monkeypatch):
+        """Async parameter-store mode + codec: pulls must come back in the
+        puller's requested wire format (compressed on demand).  The async
+        flag must be set before the server starts — worker and server modes
+        have to agree (as in the reference, both read BYTEPS_ENABLE_ASYNC)."""
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        monkeypatch.setenv("BYTEPS_ENABLE_ASYNC", "1")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+        import byteps_tpu as bps
+
+        bps.init()
+        n = 128
+        bps.declare_tensor(
+            "c.async", byteps_compressor_type="topk", byteps_compressor_k=str(n)
+        )
+        x = np.random.default_rng(4).normal(size=n).astype(np.float32)
+        out1 = np.asarray(bps.push_pull(x, name="c.async", average=False))
+        out2 = np.asarray(bps.push_pull(x, name="c.async", average=False))
+        # async store accumulates: round1 = x, round2 = 2x (topk k=n lossless)
+        np.testing.assert_allclose(out1, x, rtol=1e-6)
+        np.testing.assert_allclose(out2, 2 * x, rtol=1e-6)
+        bps.shutdown()
+        srv.stop()
+        sched.stop()
+
+
 _WORKER_SCRIPT = textwrap.dedent(
     """
     import os, sys
