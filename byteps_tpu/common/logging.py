@@ -1,0 +1,59 @@
+"""Leveled logging (BPS_LOG / BPS_CHECK equivalents, logging.h).
+
+Level from ``BYTEPS_LOG_LEVEL`` (TRACE|DEBUG|INFO|WARNING|ERROR|FATAL);
+FATAL raises.  Thin wrapper over stdlib logging so host apps can reroute.
+"""
+
+from __future__ import annotations
+
+import logging as _pylog
+import os
+import sys
+
+TRACE = 5
+_pylog.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "TRACE": TRACE,
+    "DEBUG": _pylog.DEBUG,
+    "INFO": _pylog.INFO,
+    "WARNING": _pylog.WARNING,
+    "ERROR": _pylog.ERROR,
+    "FATAL": _pylog.CRITICAL,
+}
+
+logger = _pylog.getLogger("byteps_tpu")
+if not logger.handlers:
+    _h = _pylog.StreamHandler(sys.stderr)
+    _h.setFormatter(
+        _pylog.Formatter("[%(asctime)s] BYTEPS %(levelname)s %(message)s", "%H:%M:%S")
+    )
+    logger.addHandler(_h)
+logger.setLevel(_LEVELS.get(os.environ.get("BYTEPS_LOG_LEVEL", "WARNING").upper(), _pylog.WARNING))
+
+
+def trace(msg, *a):
+    logger.log(TRACE, msg, *a)
+
+
+def debug(msg, *a):
+    logger.debug(msg, *a)
+
+
+def info(msg, *a):
+    logger.info(msg, *a)
+
+
+def warning(msg, *a):
+    logger.warning(msg, *a)
+
+
+def error(msg, *a):
+    logger.error(msg, *a)
+
+
+def check(cond: bool, msg: str = "") -> None:
+    """BPS_CHECK: fatal on failure (logging.h)."""
+    if not cond:
+        logger.critical("check failed: %s", msg)
+        raise AssertionError(f"BPS_CHECK failed: {msg}")

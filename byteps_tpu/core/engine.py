@@ -222,6 +222,22 @@ class PipelineEngine:
         advance to the next queue or finish the partition."""
         finished = task.queue_list.pop(0)
         job: _Job = task.context
+        if self.cfg.debug_sample_tensor and self.cfg.debug_sample_tensor in job.name:
+            # value sampling per stage (BYTEPS_DEBUG_SAMPLE_TENSOR,
+            # core_loops.cc:37-67) — the race-diagnosis tool
+            from byteps_tpu.common import logging as bpslog
+
+            if finished in (QueueType.PULL, QueueType.DECOMPRESS, QueueType.COPYH2D):
+                # pull-side stages: sample what came BACK, not what we sent
+                buf = job.result[task.offset : task.offset + task.length]
+            else:
+                buf = task.cpubuff
+            if buf is not None and buf.size:
+                bpslog.info(
+                    "sample %s key=%d stage=%s v=%d norm=%.6g first=%.6g",
+                    job.name, task.key, finished.name, task.version,
+                    float(np.linalg.norm(buf.astype(np.float64))), float(buf[0]),
+                )
         if self.tracer is not None:
             self.tracer.record(
                 job.name, finished.name, job.t0, time.time() - job.t0, job.version

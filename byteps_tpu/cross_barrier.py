@@ -1,0 +1,135 @@
+"""CrossBarrier equivalent — pipelined per-parameter optimizer.
+
+Re-design of torch/cross_barrier.py (SURVEY §2.5): the reference removes
+the per-step global barrier and re-implements sgd/adam/rmsprop so each
+parameter updates the moment ITS gradient arrives (per-param locks +
+poller thread), letting step N+1's forward start while low-priority
+gradients still sync.
+
+On TPU the in-step overlap is XLA's job; this host-side class provides the
+same semantics for the PS/DCN path: ``backward(grads)`` launches one async
+push_pull per parameter (priority = −declaration order, so front-layer
+params sync first), and ``wait(name)`` / ``step()`` apply updates lazily —
+callers that consume parameters front-to-back (the next forward pass)
+never wait on back-layer gradients.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+import byteps_tpu as bps
+
+
+class _SGD:
+    def __init__(self, lr: float, momentum: float = 0.0, weight_decay: float = 0.0):
+        self.lr, self.mu, self.wd = lr, momentum, weight_decay
+        self.state: Dict[str, np.ndarray] = {}
+
+    def update(self, name, param, grad):
+        if self.wd:
+            grad = grad + self.wd * param
+        if self.mu:
+            m = self.state.get(name)
+            m = grad if m is None else self.mu * m + grad
+            self.state[name] = m
+            grad = m
+        return param - self.lr * grad
+
+
+class _Adam:
+    def __init__(self, lr: float, betas=(0.9, 0.999), eps: float = 1e-8, weight_decay: float = 0.0):
+        self.lr, self.b1, self.b2, self.eps, self.wd = lr, betas[0], betas[1], eps, weight_decay
+        self.m: Dict[str, np.ndarray] = {}
+        self.v: Dict[str, np.ndarray] = {}
+        self.t: Dict[str, int] = {}
+
+    def update(self, name, param, grad):
+        if self.wd:
+            grad = grad + self.wd * param
+        t = self.t.get(name, 0) + 1
+        self.t[name] = t
+        m = self.b1 * self.m.get(name, np.zeros_like(grad)) + (1 - self.b1) * grad
+        v = self.b2 * self.v.get(name, np.zeros_like(grad)) + (1 - self.b2) * grad**2
+        self.m[name], self.v[name] = m, v
+        mhat = m / (1 - self.b1**t)
+        vhat = v / (1 - self.b2**t)
+        return param - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+
+class _RMSProp:
+    def __init__(self, lr: float, alpha: float = 0.99, eps: float = 1e-8, weight_decay: float = 0.0):
+        self.lr, self.alpha, self.eps, self.wd = lr, alpha, eps, weight_decay
+        self.sq: Dict[str, np.ndarray] = {}
+
+    def update(self, name, param, grad):
+        if self.wd:
+            grad = grad + self.wd * param
+        sq = self.alpha * self.sq.get(name, np.zeros_like(grad)) + (1 - self.alpha) * grad**2
+        self.sq[name] = sq
+        return param - self.lr * grad / (np.sqrt(sq) + self.eps)
+
+
+_OPTS = {"sgd": _SGD, "adam": _Adam, "rmsprop": _RMSProp}
+
+
+class CrossBarrierOptimizer:
+    """Per-parameter pipelined optimizer over async push_pull handles.
+
+    Supported opt_name: sgd | adam | rmsprop (the three the reference
+    re-implements, cross_barrier.py:28-425).
+    """
+
+    def __init__(
+        self,
+        params: Dict[str, np.ndarray],
+        opt_name: str = "sgd",
+        average: bool = True,
+        **opt_kwargs,
+    ) -> None:
+        if opt_name not in _OPTS:
+            raise ValueError(f"unsupported optimizer {opt_name!r}; use one of {list(_OPTS)}")
+        self.params = {k: np.asarray(v, dtype=np.float32) for k, v in params.items()}
+        self.opt = _OPTS[opt_name](**opt_kwargs)
+        self.average = average
+        self._order = {name: i for i, name in enumerate(self.params)}
+        self._handles: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        for name in self.params:
+            bps.declare_tensor(f"Gradient.{name}")
+
+    def backward(self, grads: Dict[str, np.ndarray]) -> None:
+        """Launch async push_pull for every gradient; returns immediately
+        (the hook behavior, cross_barrier.py:120-160).  A still-outstanding
+        handle for the same parameter is synchronized-and-applied first so
+        no gradient is ever dropped and no handle leaks."""
+        for name in grads:
+            self.wait(name)
+        with self._lock:
+            for name, g in grads.items():
+                self._handles[name] = bps.push_pull_async(
+                    np.asarray(g, dtype=np.float32),
+                    name=f"Gradient.{name}",
+                    average=self.average,
+                    priority=-self._order[name],
+                )
+
+    def wait(self, name: str) -> np.ndarray:
+        """Block until THIS parameter's gradient arrived, apply its update,
+        return the fresh value (per-param lock semantics)."""
+        with self._lock:
+            handle = self._handles.pop(name, None)
+        if handle is not None:
+            grad = np.asarray(bps.synchronize(handle))
+            self.params[name] = self.opt.update(name, self.params[name], grad)
+        return self.params[name]
+
+    def step(self) -> Dict[str, np.ndarray]:
+        """Apply all outstanding updates (a full barrier — what the
+        reference's plain DistributedOptimizer would do every step)."""
+        for name in list(self.params):
+            self.wait(name)
+        return self.params

@@ -1,0 +1,1 @@
+"""Launcher & deployment (SURVEY §2.6)."""

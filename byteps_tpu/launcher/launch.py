@@ -1,0 +1,126 @@
+"""bpslaunch equivalent — per-node process launcher.
+
+Re-design of launcher/launch.py (SURVEY §2.6) for TPU deployments:
+
+- Role from ``DMLC_ROLE`` (worker | server | scheduler | joint), with
+  topology either from explicit ``DMLC_*`` env or auto-discovered from TPU
+  VM metadata (``discover_tpu_topology``).
+- Worker role: the reference spawns one process per GPU
+  (launch.py:161-199); a JAX TPU worker is single-process multi-chip, so
+  we spawn ONE process per host and export BYTEPS_LOCAL_RANK=0,
+  BYTEPS_LOCAL_SIZE=1 — the intra-host axis lives in the device mesh
+  instead.  NUMA binding of the host process (the aggregation threads are
+  the reference's reason for numactl, launch.py:49-141) is kept via
+  ``BYTEPS_VISIBLE_CPU_CORES`` → numactl --physcpubind.
+- Server/scheduler roles: exec ``python -m byteps_tpu.server``
+  (launch.py:269-277 equivalent).
+- ``BYTEPS_ENABLE_GDB=1`` wraps the command in gdb (launch.py:187-192);
+  ``BYTEPS_TRACE_ON=1`` pre-creates the trace dir (launch.py:193-197).
+
+Usage:  python -m byteps_tpu.launcher.launch [--] CMD [ARGS...]
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+
+REQUIRED_ENV = ["DMLC_ROLE"]
+WORKER_REQUIRED_ENV = ["DMLC_NUM_WORKER", "DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT"]
+
+
+def discover_tpu_topology(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Derive DMLC_* topology from TPU slice metadata when present.
+
+    TPU VMs expose ``TPU_WORKER_ID`` and ``TPU_WORKER_HOSTNAMES``
+    (comma-separated) — the launcher maps worker 0's host to the scheduler
+    (DMLC_PS_ROOT_URI) and the host count to DMLC_NUM_WORKER, so a plain
+    ``bpslaunch python train.py`` works on a pod slice with zero explicit
+    config (the reference reads the analogous role info from env set by
+    dist_launcher, docs/env.md).
+    """
+    env = env if env is not None else dict(os.environ)
+    out: Dict[str, str] = {}
+    hostnames = env.get("TPU_WORKER_HOSTNAMES", "")
+    worker_id = env.get("TPU_WORKER_ID", "")
+    if hostnames and worker_id != "":
+        hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+        out["DMLC_NUM_WORKER"] = str(len(hosts))
+        out["DMLC_WORKER_ID"] = str(int(worker_id))
+        out.setdefault("DMLC_PS_ROOT_URI", hosts[0])
+        out.setdefault("DMLC_PS_ROOT_PORT", "9000")
+        out["BYTEPS_GLOBAL_RANK"] = str(int(worker_id))
+    return out
+
+
+def check_env(env: Dict[str, str]) -> None:
+    """Validate required topology env (check_env, launch.py:144-158)."""
+    missing = [k for k in REQUIRED_ENV if not env.get(k)]
+    if env.get("DMLC_ROLE") == "worker" and int(env.get("DMLC_NUM_WORKER", "1")) > 1:
+        missing += [k for k in WORKER_REQUIRED_ENV if not env.get(k)]
+    if missing:
+        raise SystemExit(f"bpslaunch: missing required env: {', '.join(missing)}")
+
+
+def numa_prefix(env: Dict[str, str]) -> List[str]:
+    """numactl binding for the worker's host threads
+    (allocate_cpu, launch.py:49-141).  Explicit core list only — the
+    per-GPU automatic quota logic has no TPU analogue since there is one
+    process per host."""
+    cores = env.get("BYTEPS_VISIBLE_CPU_CORES", "")
+    if not cores or not shutil.which("numactl"):
+        return []
+    return ["numactl", f"--physcpubind={cores}"]
+
+
+def build_worker_command(cmd: List[str], env: Dict[str, str]) -> List[str]:
+    full = numa_prefix(env) + cmd
+    if env.get("BYTEPS_ENABLE_GDB", "0") == "1":
+        full = ["gdb", "-ex", "run", "-ex", "bt", "--batch", "--args"] + full
+    return full
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+
+    env = dict(os.environ)
+    for k, v in discover_tpu_topology(env).items():
+        env.setdefault(k, v)
+    env.setdefault("DMLC_ROLE", "worker")
+    check_env(env)
+    role = env["DMLC_ROLE"]
+
+    if env.get("BYTEPS_TRACE_ON", "0") == "1":
+        trace_dir = env.get("BYTEPS_TRACE_DIR", ".")
+        os.makedirs(os.path.join(trace_dir, env.get("BYTEPS_LOCAL_RANK", "0")), exist_ok=True)
+
+    if role in ("server", "scheduler"):
+        # become the server/scheduler process (launch.py:269-277)
+        return subprocess.call([sys.executable, "-m", "byteps_tpu.server"], env=env)
+
+    if role == "joint":
+        # colocated server + worker on one host (mixed mode deployments)
+        senv = dict(env, DMLC_ROLE="server")
+        server = subprocess.Popen([sys.executable, "-m", "byteps_tpu.server"], env=senv)
+        try:
+            env["DMLC_ROLE"] = "worker"
+            rc = subprocess.call(build_worker_command(argv, env), env=env)
+        finally:
+            server.terminate()
+        return rc
+
+    if not argv:
+        raise SystemExit("bpslaunch: no command given for worker role")
+    env.setdefault("BYTEPS_LOCAL_RANK", "0")
+    env.setdefault("BYTEPS_LOCAL_SIZE", "1")
+    return subprocess.call(build_worker_command(argv, env), env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -117,6 +117,27 @@ class DistributedOptimizer:
         return self._tx
 
 
+def _compile_spmd_step(
+    local_step: Callable,
+    mesh: Optional[Mesh],
+    axis_name: str,
+    donate: bool,
+) -> Callable:
+    """Shared tail for the DDP step builders: shard_map over (replicated
+    state, replicated opt_state, dp-sharded batch) then jit with donation."""
+    mesh = mesh or get_global_mesh()
+    if mesh is None:
+        raise RuntimeError("no global mesh; call byteps_tpu.init() or pass mesh=")
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+
 def build_data_parallel_step(
     loss_fn: Callable[..., jax.Array],
     optimizer: optax.GradientTransformation,
@@ -132,9 +153,6 @@ def build_data_parallel_step(
     redundantly per member (cheap, keeps params replicated without a
     broadcast).
     """
-    mesh = mesh or get_global_mesh()
-    if mesh is None:
-        raise RuntimeError("no global mesh; call byteps_tpu.init() or pass mesh=")
 
     def local_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -146,11 +164,47 @@ def build_data_parallel_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    sharded = jax.shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(P(), P(), P(axis_name)),
-        out_specs=(P(), P(), P()),
-        check_vma=False,
-    )
-    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+    return _compile_spmd_step(local_step, mesh, axis_name, donate)
+
+
+def build_flax_data_parallel_step(
+    apply_fn: Callable,
+    loss_from_logits: Callable[[jax.Array, Any], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = DP_AXIS,
+    donate: bool = True,
+) -> Callable:
+    """DDP step for flax modules with mutable batch statistics (conv nets).
+
+    ``step(variables, opt_state, batch) → (variables, opt_state, loss)``
+    where ``variables = {"params": ..., "batch_stats": ...}``.  Gradients
+    AND updated batch statistics are pmean'd over the dp axis, matching
+    cross-replica BatchNorm behavior.
+    """
+
+    def local_step(variables, opt_state, batch):
+        x, y = batch
+        params = variables["params"]
+        rest = {k: v for k, v in variables.items() if k != "params"}
+
+        def loss_fn(p):
+            out, mutated = apply_fn(
+                {"params": p, **rest}, x, train=True, mutable=["batch_stats"]
+            )
+            return loss_from_logits(out, y), mutated
+
+        (loss, mutated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)
+        loss = lax.pmean(loss, axis_name)
+        new_stats = jax.tree_util.tree_map(
+            lambda s: lax.pmean(s, axis_name), mutated.get("batch_stats", {})
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        variables = {"params": params, **rest}
+        if new_stats:
+            variables["batch_stats"] = new_stats
+        return variables, opt_state, loss
+
+    return _compile_spmd_step(local_step, mesh, axis_name, donate)

@@ -1,0 +1,60 @@
+"""CrossBarrier-equivalent tests (torch/cross_barrier.py parity)."""
+
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.cross_barrier import CrossBarrierOptimizer
+
+
+class TestCrossBarrierLocal:
+    """Non-distributed: push_pull is identity, so the optimizers must match
+    plain math exactly."""
+
+    def test_sgd_matches_reference_math(self):
+        bps.init()
+        w0 = np.ones(8, np.float32)
+        opt = CrossBarrierOptimizer({"w": w0}, "sgd", lr=0.1, momentum=0.9)
+        g = np.full(8, 2.0, np.float32)
+        opt.backward({"w": g})
+        opt.step()
+        np.testing.assert_allclose(opt.params["w"], 1.0 - 0.1 * 2.0)
+        opt.backward({"w": g})
+        opt.step()
+        # m2 = 0.9*2 + 2 = 3.8 → w = 0.8 − 0.38
+        np.testing.assert_allclose(opt.params["w"], 0.8 - 0.1 * 3.8, rtol=1e-6)
+        bps.shutdown()
+
+    def test_adam_step(self):
+        bps.init()
+        opt = CrossBarrierOptimizer({"w": np.zeros(4, np.float32)}, "adam", lr=0.1)
+        opt.backward({"w": np.ones(4, np.float32)})
+        opt.step()
+        # first adam step with mhat=1, vhat=1 → −lr·1/(1+eps) ≈ −0.1
+        np.testing.assert_allclose(opt.params["w"], -0.1, rtol=1e-4)
+        bps.shutdown()
+
+    def test_per_param_wait_order(self):
+        bps.init()
+        params = {f"p{i}": np.zeros(4, np.float32) for i in range(4)}
+        opt = CrossBarrierOptimizer(params, "sgd", lr=1.0)
+        grads = {k: np.full(4, float(i), np.float32) for i, k in enumerate(params)}
+        opt.backward(grads)
+        # wait an arbitrary single param first (front-to-back consumption)
+        w2 = opt.wait("p2")
+        np.testing.assert_allclose(w2, -2.0)
+        opt.step()
+        np.testing.assert_allclose(opt.params["p3"], -3.0)
+        bps.shutdown()
+
+    def test_rmsprop(self):
+        bps.init()
+        opt = CrossBarrierOptimizer({"w": np.zeros(4, np.float32)}, "rmsprop", lr=0.01)
+        opt.backward({"w": np.ones(4, np.float32)})
+        opt.step()
+        assert np.all(opt.params["w"] < 0)
+        bps.shutdown()
+
+    def test_unknown_optimizer_raises(self):
+        with pytest.raises(ValueError, match="unsupported optimizer"):
+            CrossBarrierOptimizer({"w": np.zeros(2)}, "lamb")
