@@ -1,0 +1,90 @@
+"""Checkpoint/resume support surface.
+
+The reference has no checkpoint subsystem of its own — it delegates to the
+framework and provides the post-restore re-sync primitives
+(broadcast_parameters / broadcast_optimizer_state / broadcast_object,
+torch/__init__.py:268-466; SURVEY §5.4 says to keep exactly that split).
+Here the framework-side store is orbax; this module adds the BytePS-style
+wrappers:
+
+- save / restore  (orbax PyTreeCheckpointer)
+- restore_and_broadcast — restore on the root worker then broadcast to all
+  workers over the PS plane, the ``broadcast_parameters`` pattern
+- broadcast_optimizer_state — pickles non-array state via broadcast_object
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, tree: Any, force: bool = True) -> None:
+    """Save a pytree (params / full train state) to ``path``."""
+    _checkpointer().save(os.path.abspath(path), tree, force=force)
+
+
+def restore(path: str, template: Optional[Any] = None) -> Any:
+    """Restore a pytree; ``template`` (same structure, abstract or concrete
+    leaves) restores into matching dtypes/shardings."""
+    if template is not None:
+        return _checkpointer().restore(os.path.abspath(path), item=template)
+    return _checkpointer().restore(os.path.abspath(path))
+
+
+def restore_and_broadcast(
+    path: str, template: Any, root_rank: int = 0
+) -> Any:
+    """Elastic/multi-worker restore: only ``root_rank`` reads the
+    checkpoint; every other worker receives the values via the PS broadcast
+    (the zero-then-pushpull trick, torch/__init__.py:268-299).  All workers
+    must pass an identically-structured ``template``."""
+    import byteps_tpu as bps
+
+    if bps.rank() == root_rank:
+        tree = restore(path, template)
+    else:
+        tree = jax.tree_util.tree_map(np.zeros_like, template)
+    return bps.broadcast_parameters(tree, root_rank=root_rank)
+
+
+def broadcast_optimizer_state(
+    opt_state: Any, root_rank: int = 0, name: str = "OptState"
+) -> Any:
+    """Re-sync optimizer state after restore (broadcast_optimizer_state,
+    torch/__init__.py:302-466): array leaves ride broadcast_parameters
+    under ``name``-prefixed keys, non-array leaves (python scalars, enums)
+    ride broadcast_object so their types survive.
+
+    Pass a distinct ``name`` when broadcasting more than one state tree in
+    a process — tensor declarations are keyed by name, and two trees under
+    the same prefix would collide.
+    """
+    import byteps_tpu as bps
+
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    is_array = [hasattr(l, "dtype") and hasattr(l, "shape") for l in leaves]
+    arrays = {
+        f"{name}.{i}": np.asarray(l)
+        for i, (l, a) in enumerate(zip(leaves, is_array)) if a
+    }
+    others = [l for l, a in zip(leaves, is_array) if not a]
+    synced_arrays = bps.broadcast_parameters(arrays, root_rank=root_rank)
+    synced_others = bps.broadcast_object(others, root_rank=root_rank, name=f"{name}.pkl")
+    out_leaves, oi = [], 0
+    for i, a in enumerate(is_array):
+        if a:
+            out_leaves.append(synced_arrays[f"{name}.{i}"])
+        else:
+            out_leaves.append(synced_others[oi])
+            oi += 1
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)

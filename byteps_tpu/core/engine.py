@@ -227,9 +227,15 @@ class PipelineEngine:
             # core_loops.cc:37-67) — the race-diagnosis tool
             from byteps_tpu.common import logging as bpslog
 
-            if finished in (QueueType.PULL, QueueType.DECOMPRESS, QueueType.COPYH2D):
-                # pull-side stages: sample what came BACK, not what we sent
+            if finished in (QueueType.DECOMPRESS, QueueType.COPYH2D) or (
+                finished == QueueType.PULL and task.compressed is None
+            ):
+                # pull-side stages: sample what came BACK.  For compressed
+                # tensors job.result is only written at DECOMPRESS, so the
+                # PULL stage is skipped (payload is codec wire bytes).
                 buf = job.result[task.offset : task.offset + task.length]
+            elif finished == QueueType.PULL:
+                buf = None
             else:
                 buf = task.cpubuff
             if buf is not None and buf.size:

@@ -74,6 +74,11 @@ def main(args: Optional[List[str]] = None) -> int:
     p.add_argument("--scheduler-port", type=int, default=9000)
     p.add_argument("--env", action="append", default=[], metavar="KEY=VAL")
     p.add_argument("--log-dir", default="sshlog")
+    p.add_argument(
+        "--remote-python", default="python3",
+        help="python executable on remote hosts (the local sys.executable "
+        "path rarely exists remotely)",
+    )
     p.add_argument("cmd", nargs=argparse.REMAINDER)
     ns = p.parse_args(args)
 
@@ -85,7 +90,7 @@ def main(args: Optional[List[str]] = None) -> int:
     extra = dict(kv.split("=", 1) for kv in ns.env)
     sched_host = ns.scheduler_host or (servers[0] if servers else workers[0])
 
-    launch = [sys.executable, "-m", "byteps_tpu.launcher.launch", "--"]
+    launch = [ns.remote_python, "-m", "byteps_tpu.launcher.launch", "--"]
     worker_threads: List[threading.Thread] = []
     rcs: Dict[str, int] = {}
 

@@ -104,6 +104,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # become the server/scheduler process (launch.py:269-277)
         return subprocess.call([sys.executable, "-m", "byteps_tpu.server"], env=env)
 
+    # worker / joint both run the user command
+    if not argv:
+        raise SystemExit(f"bpslaunch: no command given for {role} role")
+    env.setdefault("BYTEPS_LOCAL_RANK", "0")
+    env.setdefault("BYTEPS_LOCAL_SIZE", "1")
+
     if role == "joint":
         # colocated server + worker on one host (mixed mode deployments)
         senv = dict(env, DMLC_ROLE="server")
@@ -115,10 +121,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             server.terminate()
         return rc
 
-    if not argv:
-        raise SystemExit("bpslaunch: no command given for worker role")
-    env.setdefault("BYTEPS_LOCAL_RANK", "0")
-    env.setdefault("BYTEPS_LOCAL_SIZE", "1")
     return subprocess.call(build_worker_command(argv, env), env=env)
 
 

@@ -1,0 +1,216 @@
+"""PyTorch plugin — Horovod-compatible adapter for torch models.
+
+Parity surface with the reference's byteps/torch plugin
+(torch/__init__.py:226-466, torch/ops.py:38-236): ``init``, ``shutdown``,
+``push_pull(_async)``, ``poll``, ``synchronize``, ``DistributedOptimizer``
+(per-gradient hooks, priority = −declaration order, ``synchronize()``
+before step, ``backward_passes_per_step``), ``broadcast_parameters``,
+``broadcast_optimizer_state``, and level-1 ``Compression``.
+
+The data plane is the shared byteps_tpu core: identity in single-worker
+mode, PS-over-DCN when distributed.  Intended for host-side torch models
+(data loaders, reference models) and torch-xla-style integration; the
+TPU-native compute path remains JAX.
+
+    import byteps_tpu.torch as bps
+    bps.init()
+    opt = bps.DistributedOptimizer(torch.optim.SGD(model.parameters(), lr=.1),
+                                   named_parameters=model.named_parameters())
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+import torch
+
+from byteps_tpu.api import (  # noqa: F401  (re-exported parity surface)
+    declare_tensor,
+    get_pushpull_speed,
+    init,
+    local_rank,
+    local_size,
+    rank,
+    resume,
+    shutdown,
+    size,
+    suspend,
+)
+from byteps_tpu.api import poll as _poll
+from byteps_tpu.api import push_pull_async as _core_push_pull_async
+from byteps_tpu.api import synchronize as _core_synchronize
+from byteps_tpu.compression.base import Compression  # noqa: F401
+
+
+def push_pull_async(
+    tensor: torch.Tensor,
+    average: bool = True,
+    name: Optional[str] = None,
+    version: int = 0,
+    priority: int = 0,
+) -> int:
+    """Async cross-worker push_pull of a torch tensor; returns a handle
+    (byteps_push_pull, torch/ops.py:157-174)."""
+    if name is None:
+        raise ValueError("name is required (cross-process aggregation key)")
+    return _core_push_pull_async(
+        tensor.detach().cpu().numpy(), name=name, average=average,
+        priority=priority, version=version,
+    )
+
+
+def poll(handle: int) -> bool:
+    return _poll(handle)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    out = _core_synchronize(handle)
+    return torch.as_tensor(np.asarray(out))
+
+
+def push_pull(
+    tensor: torch.Tensor,
+    average: bool = True,
+    name: Optional[str] = None,
+    priority: int = 0,
+) -> torch.Tensor:
+    """Synchronous push_pull returning a NEW tensor (torch/ops.py:86-106)."""
+    return synchronize(push_pull_async(tensor, average, name, priority=priority))
+
+
+def push_pull_inplace(
+    tensor: torch.Tensor,
+    average: bool = True,
+    name: Optional[str] = None,
+    priority: int = 0,
+) -> torch.Tensor:
+    out = push_pull(tensor, average, name, priority)
+    tensor.copy_(out.to(tensor.dtype))
+    return tensor
+
+
+class DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer with per-gradient push_pull hooks
+    (_DistributedOptimizer, torch/__init__.py:37-223).
+
+    Each parameter's post-accumulate-grad hook launches an async push_pull
+    named ``Gradient.<name>`` with priority = −declaration index;
+    ``step()`` synchronizes all handles, writes the reduced gradients back,
+    then delegates to the wrapped optimizer.  ``backward_passes_per_step``
+    delays communication for gradient accumulation.
+    """
+
+    def __init__(
+        self,
+        optimizer: torch.optim.Optimizer,
+        named_parameters: Optional[Iterable[Tuple[str, torch.nn.Parameter]]] = None,
+        compression: Any = Compression.none,
+        backward_passes_per_step: int = 1,
+    ) -> None:
+        self._inner = optimizer
+        self.param_groups = optimizer.param_groups
+        self.defaults = optimizer.defaults
+        self.state = optimizer.state
+        self.backward_passes_per_step = backward_passes_per_step
+        self._compression = compression
+        self._passes = 0
+        self._handles: Dict[torch.nn.Parameter, int] = {}
+        self._ctx: Dict[torch.nn.Parameter, Any] = {}
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [
+                (f"param_{gi}_{pi}", p)
+                for gi, group in enumerate(optimizer.param_groups)
+                for pi, p in enumerate(group["params"])
+            ]
+        self._names = {p: n for n, p in named}
+        self._order = {p: i for i, (_, p) in enumerate(named)}
+        dups = len(named) - len({n for n, _ in named})
+        if dups:
+            raise ValueError("named_parameters contains duplicate names")
+        for name, p in named:
+            declare_tensor(f"Gradient.{name}")
+            if p.requires_grad:
+                p.register_post_accumulate_grad_hook(self._make_hook())
+
+    def _make_hook(self):
+        def hook(p: torch.nn.Parameter) -> None:
+            if self._passes + 1 < self.backward_passes_per_step:
+                return  # accumulate locally; communicate on the last pass
+            if p in self._handles:  # double-hook within one pass
+                return
+            grad = p.grad
+            if grad is None:
+                return
+            compressed, ctx = self._compression.compress(grad.detach().cpu().numpy())
+            self._ctx[p] = ctx
+            self._handles[p] = _core_push_pull_async(
+                np.asarray(compressed),
+                name=f"Gradient.{self._names[p]}",
+                average=True,
+                priority=-self._order[p],
+            )
+
+        return hook
+
+    def synchronize(self) -> None:
+        """Wait for all in-flight gradient reductions and write them back
+        (torch/__init__.py:160-183)."""
+        for p, handle in list(self._handles.items()):
+            out = _core_synchronize(handle)
+            out = self._compression.decompress(np.asarray(out), self._ctx.pop(p, None))
+            p.grad.copy_(torch.as_tensor(out).to(p.grad.dtype).view_as(p.grad))
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self._passes += 1
+        if self._passes < self.backward_passes_per_step:
+            return None  # still accumulating; no comm, no step
+        self._passes = 0
+        self.synchronize()
+        return self._inner.step(closure)
+
+    def zero_grad(self, set_to_none: bool = True):
+        return self._inner.zero_grad(set_to_none=set_to_none)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._inner.load_state_dict(sd)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place sync of module params/state_dict from root
+    (torch/__init__.py:268-299)."""
+    from byteps_tpu.api import broadcast_parameters as _bp
+
+    if isinstance(params, dict):
+        items = list(params.items())
+    else:
+        items = list(params)
+    arrays = {n: p.detach().cpu().numpy() for n, p in items}
+    synced = _bp(arrays, root_rank=root_rank)
+    with torch.no_grad():
+        for n, p in items:
+            p.copy_(torch.as_tensor(np.asarray(synced[n])).to(p.dtype).view_as(p))
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer, root_rank: int = 0) -> None:
+    """Sync optimizer state dict from root via pickled broadcast_object
+    (torch/__init__.py:302-466)."""
+    from byteps_tpu.api import broadcast_object
+
+    sd = broadcast_object(optimizer.state_dict(), root_rank=root_rank, name="opt_state")
+    optimizer.load_state_dict(sd)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = "obj") -> Any:
+    from byteps_tpu.api import broadcast_object as _bo
+
+    return _bo(obj, root_rank=root_rank, name=name)

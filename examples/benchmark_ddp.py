@@ -58,7 +58,9 @@ def bench_bert(batch: int, steps: int):
     from byteps_tpu.parallel.mesh_utils import make_training_mesh
 
     cfg = bert_large(max_seq=128, compute_dtype=jnp.bfloat16)
-    mesh = make_training_mesh(1, {"dp": 1, "pp": 1, "sp": 1, "tp": 1})
+    # data-parallel over every visible device, like the conv benchmarks
+    n = jax.device_count()
+    mesh = make_training_mesh(n, {"dp": n, "pp": 1, "sp": 1, "tp": 1})
     params = shard_params(init_params(cfg), cfg, mesh)
     tx = optax.adamw(1e-4)
     opt_state = jax.jit(tx.init)(params)

@@ -1,0 +1,43 @@
+"""Checkpoint/resume surface tests (SURVEY §5.4: orbax store + post-restore
+broadcast primitives)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu import checkpoint as ckpt
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        tree = {
+            "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "nested": {"b": np.ones(5, dtype=np.float32)},
+        }
+        path = str(tmp_path / "ck1")
+        ckpt.save(path, tree)
+        out = ckpt.restore(path)
+        np.testing.assert_allclose(out["w"], tree["w"])
+        np.testing.assert_allclose(out["nested"]["b"], tree["nested"]["b"])
+
+    def test_restore_and_broadcast_single_worker(self, tmp_path):
+        bps.init()
+        tree = {"w": np.full((4,), 7.0, dtype=np.float32)}
+        path = str(tmp_path / "ck2")
+        ckpt.save(path, tree)
+        out = ckpt.restore_and_broadcast(path, {"w": np.zeros(4, np.float32)})
+        np.testing.assert_allclose(np.asarray(out["w"]), 7.0)
+        bps.shutdown()
+
+    def test_broadcast_optimizer_state(self):
+        import optax
+
+        bps.init()
+        params = {"w": jnp.ones(3)}
+        tx = optax.adam(1e-3)
+        st = tx.init(params)
+        out = ckpt.broadcast_optimizer_state(st, root_rank=0)
+        # structure preserved
+        assert type(out) is type(st)
+        bps.shutdown()

@@ -1,0 +1,175 @@
+"""Torch plugin tests (byteps/torch parity surface).
+
+Single-worker semantics: push_pull = identity, so DistributedOptimizer
+must train exactly like the bare optimizer (the reference's
+test_mxnet-style check applied to torch)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import byteps_tpu.torch as bps
+
+
+def _model(seed=0):
+    torch.manual_seed(seed)
+    return torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 1)
+    )
+
+
+def _data(seed=0):
+    g = torch.Generator().manual_seed(seed)
+    x = torch.randn(64, 8, generator=g)
+    y = torch.randn(64, 1, generator=g)
+    return x, y
+
+
+class TestTorchPushPull:
+    def test_identity(self):
+        bps.init()
+        t = torch.arange(10, dtype=torch.float32)
+        out = bps.push_pull(t, name="torch.t")
+        assert torch.allclose(out, t)
+        bps.shutdown()
+
+    def test_inplace(self):
+        bps.init()
+        t = torch.ones(5)
+        ret = bps.push_pull_inplace(t, name="torch.ip")
+        assert ret is t and torch.allclose(t, torch.ones(5))
+        bps.shutdown()
+
+    def test_async_poll(self):
+        bps.init()
+        h = bps.push_pull_async(torch.ones(3), name="torch.async")
+        assert bps.poll(h)
+        assert torch.allclose(bps.synchronize(h), torch.ones(3))
+        bps.shutdown()
+
+    def test_name_required(self):
+        bps.init()
+        with pytest.raises(ValueError, match="name"):
+            bps.push_pull_async(torch.ones(2))
+        bps.shutdown()
+
+
+class TestTorchDistributedOptimizer:
+    def test_matches_bare_optimizer(self):
+        bps.init()
+        m1, m2 = _model(), _model()
+        m2.load_state_dict(m1.state_dict())
+        x, y = _data()
+
+        opt_ref = torch.optim.SGD(m1.parameters(), lr=0.05)
+        opt_dist = bps.DistributedOptimizer(
+            torch.optim.SGD(m2.parameters(), lr=0.05),
+            named_parameters=m2.named_parameters(),
+        )
+        for _ in range(5):
+            opt_ref.zero_grad()
+            torch.nn.functional.mse_loss(m1(x), y).backward()
+            opt_ref.step()
+
+            opt_dist.zero_grad()
+            torch.nn.functional.mse_loss(m2(x), y).backward()
+            opt_dist.step()
+
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            assert torch.allclose(p1, p2, rtol=1e-5, atol=1e-7)
+        bps.shutdown()
+
+    def test_backward_passes_per_step(self):
+        bps.init()
+        m = _model()
+        x, y = _data()
+        opt = bps.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.05),
+            named_parameters=m.named_parameters(),
+            backward_passes_per_step=2,
+        )
+        p0 = [p.clone() for p in m.parameters()]
+        torch.nn.functional.mse_loss(m(x), y).backward()
+        assert opt.step() is None  # first pass: accumulate, no step
+        for p, q in zip(m.parameters(), p0):
+            assert torch.allclose(p, q)
+        torch.nn.functional.mse_loss(m(x), y).backward()
+        opt.step()  # second pass: communicate + step
+        changed = any(
+            not torch.allclose(p, q) for p, q in zip(m.parameters(), p0)
+        )
+        assert changed
+        bps.shutdown()
+
+    def test_duplicate_names_rejected(self):
+        bps.init()
+        m = _model()
+        with pytest.raises(ValueError, match="duplicate"):
+            bps.DistributedOptimizer(
+                torch.optim.SGD(m.parameters(), lr=0.1),
+                named_parameters=[("same", p) for p in m.parameters()],
+            )
+        bps.shutdown()
+
+
+class TestTorchBroadcast:
+    def test_broadcast_parameters_noop_single(self):
+        bps.init()
+        m = _model()
+        before = [p.clone() for p in m.parameters()]
+        bps.broadcast_parameters(m.state_dict(), root_rank=0)
+        for p, q in zip(m.parameters(), before):
+            assert torch.allclose(p, q)
+        bps.shutdown()
+
+    def test_broadcast_optimizer_state(self):
+        bps.init()
+        m = _model()
+        opt = torch.optim.Adam(m.parameters(), lr=1e-3)
+        torch.nn.functional.mse_loss(m(torch.randn(4, 8)), torch.randn(4, 1)).backward()
+        opt.step()
+        bps.broadcast_optimizer_state(opt, root_rank=0)  # must round-trip
+        assert len(opt.state) > 0
+        bps.shutdown()
+
+
+class TestMixedPrecision:
+    def test_dynamic_loss_scale_skips_overflow(self):
+        import jax.numpy as jnp
+        import optax
+
+        from byteps_tpu.mixed_precision import dynamic_loss_scale
+
+        tx = dynamic_loss_scale(optax.sgd(0.1), init_scale=4.0)
+        params = {"w": jnp.ones(4)}
+        st = tx.init(params)
+        # clean step: grads scaled by 4 → unscaled to 1 → update −0.1
+        up, st = tx.update({"w": jnp.full(4, 4.0)}, st, params)
+        np.testing.assert_allclose(np.asarray(up["w"]), -0.1, rtol=1e-6)
+        assert float(st.scale) == 4.0
+        # overflow: update zeroed, scale halves
+        up, st = tx.update({"w": jnp.full(4, np.inf)}, st, params)
+        np.testing.assert_allclose(np.asarray(up["w"]), 0.0)
+        assert float(st.scale) == 2.0
+
+    def test_master_weights_bf16(self):
+        import jax.numpy as jnp
+        import optax
+
+        from byteps_tpu.mixed_precision import master_weights
+
+        tx = master_weights(optax.sgd(0.01))
+        params = {"w": jnp.ones(64, jnp.bfloat16)}
+        st = tx.init(params)
+        assert st.masters["w"].dtype == jnp.float32
+        # tiny updates accumulate in the fp32 master even when each is
+        # below bf16 resolution around 1.0
+        g = {"w": jnp.full(64, 0.01, jnp.bfloat16)}
+        p = params
+        for _ in range(10):
+            up, st = tx.update(g, st, p)
+            p = optax.apply_updates(p, up)
+        np.testing.assert_allclose(
+            np.asarray(st.masters["w"]), 1.0 - 10 * 0.01 * 0.01, rtol=1e-3
+        )
