@@ -1,0 +1,132 @@
+"""Training-loop callbacks — the Keras-plugin parity layer.
+
+Re-design of the reference's shared Keras callbacks
+(_keras/callbacks.py:23-195, keras/callbacks.py) for functional JAX
+training loops: plain callables you invoke at the standard hook points
+(train begin / epoch begin / batch end).
+
+- :class:`BroadcastGlobalVariablesCallback` — one-shot param sync from
+  root at train start (BroadcastGlobalVariablesCallbackImpl).
+- :class:`MetricAverageCallback` — average logged metrics across workers
+  at epoch end (MetricAverageCallbackImpl).
+- :class:`LearningRateScheduleCallback` — multiplier-based LR schedule
+  with optional staircase, matching the reference's semantics.
+- :class:`LearningRateWarmupCallback` — linear warmup from lr/factor to
+  lr over N epochs (LearningRateWarmupCallbackImpl).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import byteps_tpu as bps
+
+
+class BroadcastGlobalVariablesCallback:
+    """Sync params (and optionally opt state) from root once, at the first
+    hook invocation."""
+
+    def __init__(self, root_rank: int = 0) -> None:
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, params: Any, opt_state: Any = None):
+        if self._done:
+            return params, opt_state
+        self._done = True
+        params = bps.broadcast_parameters(params, root_rank=self.root_rank)
+        if opt_state is not None:
+            from byteps_tpu.checkpoint import broadcast_optimizer_state
+
+            opt_state = broadcast_optimizer_state(opt_state, root_rank=self.root_rank)
+        return params, opt_state
+
+
+class MetricAverageCallback:
+    """Average a metrics dict across workers (each metric becomes the
+    cross-worker mean)."""
+
+    def on_epoch_end(self, metrics: Dict[str, float]) -> Dict[str, float]:
+        out = {}
+        for name, value in metrics.items():
+            arr = np.asarray([float(value)], dtype=np.float64)
+            out[name] = float(
+                np.asarray(bps.push_pull(arr, name=f"Metric.{name}", average=True))[0]
+            )
+        return out
+
+
+class LearningRateScheduleCallback:
+    """lr(epoch) = initial_lr * multiplier(epoch).
+
+    ``multiplier`` may be a constant (applied on [start_epoch, end_epoch))
+    or a callable of the epoch; ``staircase`` floors the epoch passed to
+    the callable.
+    """
+
+    def __init__(
+        self,
+        initial_lr: float,
+        multiplier,
+        start_epoch: int = 0,
+        end_epoch: Optional[int] = None,
+        staircase: bool = True,
+    ) -> None:
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        if callable(multiplier):
+            self._fn = multiplier
+            self._const = None
+        else:
+            self._fn = None
+            self._const = float(multiplier)
+
+    def lr(self, epoch: float) -> Optional[float]:
+        """Learning rate for (fractional) epoch; None when outside this
+        callback's window."""
+        if epoch < self.start_epoch:
+            return None
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return None
+        if self._const is not None:
+            return self.initial_lr * self._const
+        e = math.floor(epoch) if self.staircase else epoch
+        return self.initial_lr * self._fn(e - self.start_epoch)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warmup from initial_lr/warmup_factor up to initial_lr over
+    ``warmup_epochs`` (commonly paired with lr scaled by worker count —
+    the 'gradual warmup' recipe the reference implements)."""
+
+    def __init__(
+        self,
+        initial_lr: float,
+        warmup_epochs: int = 5,
+        momentum_correction: bool = False,
+        steps_per_epoch: Optional[int] = None,
+    ) -> None:
+        if momentum_correction:
+            raise NotImplementedError(
+                "momentum_correction is not implemented yet; rescale the "
+                "optimizer momentum manually during warmup (the reference "
+                "applies m' = m * (lr_new/lr_old) each adjustment)"
+            )
+        self.warmup_epochs = warmup_epochs
+
+        def mult(e: float) -> float:
+            if warmup_epochs <= 0:
+                return 1.0
+            frac = min(1.0, (e + 1) / warmup_epochs)
+            base = 1.0 / bps.size() if bps.size() else 1.0
+            return base + (1.0 - base) * frac
+
+        super().__init__(
+            initial_lr, mult, start_epoch=0, end_epoch=warmup_epochs,
+            staircase=False,
+        )

@@ -95,8 +95,18 @@ class PSClient:
         # scheduler receiver for barrier responses
         t = threading.Thread(target=self._sched_recv_loop, daemon=True)
         t.start()
+        # periodic heartbeat to the scheduler (ps-lite heartbeat parity;
+        # knob: BYTEPS_HEARTBEAT_INTERVAL via Config)
+        if self.cfg.heartbeat_interval > 0:
+            threading.Thread(
+                target=self._heartbeat_loop,
+                args=(self.cfg.heartbeat_interval,),
+                daemon=True,
+            ).start()
         # global barrier mirrors Postoffice::Barrier at init
-        # (global.cc:289-294; done even on recovery)
+        # (global.cc:289-294).  On elastic rejoin the scheduler releases
+        # the recovering node's barrier immediately (the rest of the
+        # cluster is mid-training, not waiting at a barrier).
         self.barrier(GROUP_ALL)
 
     def close(self) -> None:
@@ -113,26 +123,60 @@ class PSClient:
                 pass
         self._servers = []
 
-    def barrier(self, group: int = GROUP_WORKERS) -> None:
+    def _sched_request(self, msg: Message) -> Message:
+        """Send a scheduler request and wait for its seq-matched response.
+        Raises ConnectionError if the scheduler link dies while waiting."""
         with self._sched_cb_lock:
             seq = self._sched_seq
             self._sched_seq += 1
             ev = threading.Event()
-            self._sched_cbs[seq] = ev
-        send_message(
-            self._sched, Message(Op.BARRIER, flags=group, seq=seq), self._sched_lock
-        )
+            box: list = []
+            self._sched_cbs[seq] = (ev, box)
+        msg.seq = seq
+        send_message(self._sched, msg, self._sched_lock)
         ev.wait()
+        if not box:
+            raise ConnectionError("scheduler connection lost")
+        return box[0]
 
-    def _sched_recv_loop(self) -> None:
+    def barrier(self, group: int = GROUP_WORKERS) -> None:
+        self._sched_request(Message(Op.BARRIER, flags=group))
+
+    def query_cluster(self) -> dict:
+        """Heartbeat ages per node from the scheduler (failure detection,
+        SURVEY §5.3)."""
+        resp = self._sched_request(Message(Op.QUERY))
+        return pickle.loads(resp.payload)
+
+    def _heartbeat_loop(self, interval: float) -> None:
         while not self._stop.is_set():
+            if self._stop.wait(interval):
+                return
             try:
-                msg = recv_message(self._sched)
+                self._sched_request(Message(Op.PING))
             except (ConnectionError, OSError):
                 return
+
+    def _sched_recv_loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_message(self._sched)
+                except (ConnectionError, OSError):
+                    return
+                with self._sched_cb_lock:
+                    entry = self._sched_cbs.pop(msg.seq, None)
+                if entry is not None:
+                    ev, box = entry
+                    box.append(msg)
+                    ev.set()
+        finally:
+            # wake every pending waiter with an empty box → they raise
+            # ConnectionError instead of hanging on a dead scheduler
             with self._sched_cb_lock:
-                ev = self._sched_cbs.pop(msg.seq, None)
-            if ev is not None:
+                pending = list(self._sched_cbs.values())
+                self._sched_cbs.clear()
+            for ev, _ in pending:
                 ev.set()
 
     def _recv_loop(self, sc: _ServerConn) -> None:

@@ -11,6 +11,12 @@ server.cc:500-509).  Persistent connections then serve BARRIER requests
 Elastic rejoin: a REGISTER arriving after the population is full replaces
 the node's previous registration and immediately receives the current
 ADDRBOOK, flagged as recovery (is_recovery(), global.cc:291).
+
+Failure detection (ps-lite heartbeat equivalent, SURVEY §5.3): every
+message from a registered node refreshes its last-seen stamp; nodes ping
+every ``BYTEPS_HEARTBEAT_INTERVAL`` seconds and Op.QUERY returns per-node
+heartbeat ages — the policy for declaring a node dead (age threshold)
+belongs to the monitor consuming the ages.
 """
 
 from __future__ import annotations
@@ -18,7 +24,8 @@ from __future__ import annotations
 import pickle
 import socket
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 from byteps_tpu.comm.transport import (
     Message,
@@ -51,6 +58,12 @@ class Scheduler:
         self._barrier_round: Dict[int, int] = {GROUP_WORKERS: 0, GROUP_SERVERS: 0, GROUP_ALL: 0}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # conn → (role, rank) for heartbeat attribution
+        self._conn_ids: Dict[Any, Tuple[str, int]] = {}
+        self._last_seen: Dict[Tuple[str, int], float] = {}
+        # connections of recovering nodes: their first barrier releases
+        # immediately (the rest of the cluster is not at a barrier)
+        self._recovered_conns: set = set()
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, name="sched-accept", daemon=True)
@@ -82,17 +95,43 @@ class Scheduler:
         try:
             while not self._stop.is_set():
                 msg = recv_message(conn)
+                self._touch(conn)
                 if msg.op == Op.REGISTER:
                     self._handle_register(conn, send_lock, msg)
                 elif msg.op == Op.BARRIER:
                     self._handle_barrier(conn, send_lock, msg)
                 elif msg.op == Op.PING:
                     send_message(conn, Message(Op.PING, seq=msg.seq), send_lock)
+                elif msg.op == Op.QUERY:
+                    send_message(
+                        conn,
+                        Message(Op.QUERY, seq=msg.seq, payload=pickle.dumps(self.liveness())),
+                        send_lock,
+                    )
                 elif msg.op == Op.SHUTDOWN:
                     send_message(conn, Message(Op.SHUTDOWN, seq=msg.seq), send_lock)
                     return
         except (ConnectionError, OSError):
             return
+        finally:
+            with self._lock:
+                self._conn_ids.pop(conn, None)
+                self._recovered_conns.discard(conn)
+
+    def _touch(self, conn) -> None:
+        with self._lock:
+            ident = self._conn_ids.get(conn)
+            if ident is not None:
+                self._last_seen[ident] = time.monotonic()
+
+    def liveness(self) -> Dict[str, Dict[int, float]]:
+        """Heartbeat ages in seconds per registered node."""
+        now = time.monotonic()
+        out: Dict[str, Dict[int, float]] = {"worker": {}, "server": {}}
+        with self._lock:
+            for (role, rank), ts in self._last_seen.items():
+                out[role][rank] = now - ts
+        return out
 
     def _handle_register(self, conn, send_lock, msg: Message) -> None:
         info = pickle.loads(msg.payload)
@@ -106,13 +145,20 @@ class Scheduler:
             ]
             if existing and self._addrbook_sent:
                 rank = existing[0][0]
+                old_conn = existing[0][3]
+                # drop the dead connection's identity so its stray bytes
+                # can't refresh the rejoined node's liveness stamp
+                self._conn_ids.pop(old_conn, None)
                 nodes[nodes.index(existing[0])] = (
                     rank, info["host"], info["port"], conn, send_lock,
                 )
                 recovery = True
+                self._recovered_conns.add(conn)
             else:
                 rank = len(nodes)
                 nodes.append((rank, info["host"], info["port"], conn, send_lock))
+            self._conn_ids[conn] = (role, rank)
+            self._last_seen[(role, rank)] = time.monotonic()
             full = (
                 len(self._nodes["worker"]) >= self.num_workers
                 and len(self._nodes["server"]) >= self.num_servers
@@ -150,6 +196,16 @@ class Scheduler:
 
     def _handle_barrier(self, conn, send_lock, msg: Message) -> None:
         group = msg.flags or GROUP_ALL
+        with self._lock:
+            if conn in self._recovered_conns:
+                # recovering node's re-init barrier: release immediately —
+                # no other node is at a barrier to pair with
+                self._recovered_conns.discard(conn)
+                try:
+                    send_message(conn, Message(Op.BARRIER, seq=msg.seq, flags=group), send_lock)
+                except (ConnectionError, OSError):
+                    pass
+                return
         with self._lock:
             rnd = self._barrier_round[group]
             waiters = self._barriers.setdefault((group, rnd), [])

@@ -46,6 +46,7 @@ class Op(enum.IntEnum):
     # control
     PING = 20
     SHUTDOWN = 21
+    QUERY = 22        # cluster liveness snapshot (heartbeat ages)
 
 
 class Message:

@@ -72,6 +72,9 @@ class Config:
     server_enable_schedule: bool = False  # BYTEPS_SERVER_ENABLE_SCHEDULE
     enable_async: bool = False  # BYTEPS_ENABLE_ASYNC
 
+    # --- failure detection (ps-lite heartbeats, SURVEY §5.3) ---
+    heartbeat_interval: float = 5.0  # BYTEPS_HEARTBEAT_INTERVAL; 0 disables
+
     # --- debug / trace (global.cc:113-124) ---
     log_level: str = "WARNING"
     trace_on: bool = False
@@ -133,6 +136,9 @@ class Config:
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+            heartbeat_interval=float(
+                os.environ.get("BYTEPS_HEARTBEAT_INTERVAL", "5") or "5"
+            ),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),

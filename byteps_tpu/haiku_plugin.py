@@ -1,0 +1,42 @@
+"""dm-haiku adapter.
+
+The reference ships one thin plugin per framework (torch/tensorflow/
+mxnet/keras, SURVEY §2.5); JAX-side the native API already covers flax
+and raw-jax users, and this module gives haiku users the same one-liner
+surface:
+
+    params = hk.transform(net).init(rng, x)
+    params = byteps_tpu.haiku_plugin.broadcast_parameters(params)
+    step = byteps_tpu.haiku_plugin.build_train_step(loss_fn, optax.adam(1e-3))
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+
+from byteps_tpu.api import broadcast_parameters  # noqa: F401 (re-export)
+from byteps_tpu.comm.mesh import DP_AXIS
+from byteps_tpu.optim import build_data_parallel_step, distributed_optimizer
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    axis_names=(DP_AXIS,),
+    average: bool = True,
+) -> optax.GradientTransformation:
+    """Optax wrap usable with any haiku-transformed model (gradients are
+    all-reduced across the data axes under shard_map)."""
+    return distributed_optimizer(optimizer, axis_names, average)
+
+
+def build_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh=None,
+    donate: bool = True,
+) -> Callable:
+    """DDP step for a haiku apply-based ``loss_fn(params, batch)``."""
+    return build_data_parallel_step(loss_fn, optimizer, mesh=mesh, donate=donate)

@@ -61,6 +61,9 @@ class TransformerConfig:
     # trades ~30% more FLOPs for O(layers) less activation memory — the
     # HBM-vs-FLOPs dial the reference cannot turn (it owns no compute graph)
     remat: bool = True
+    # use the Pallas flash-attention kernel for the per-device attention
+    # when sequence parallelism is off (ring attention otherwise)
+    use_flash: bool = True
 
 
 def bert_large(**kw) -> TransformerConfig:
@@ -237,10 +240,15 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
         q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(cdt))
         k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(cdt))
         v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(cdt))
-        attn = ring_attention(
-            q, k, v, axis_name="sp" if sp > 1 else None, axis_size=sp,
-            causal=cfg.causal,
-        )
+        if sp == 1 and cfg.use_flash:
+            from byteps_tpu.ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=cfg.causal)
+        else:
+            attn = ring_attention(
+                q, k, v, axis_name="sp" if sp > 1 else None, axis_size=sp,
+                causal=cfg.causal,
+            )
         o = jnp.einsum("bhsk,hkd->bsd", attn, lp["wo"].astype(cdt))
         o = lax.psum(o, "tp")  # row-parallel combine (free at tp=1)
         x = x + o.astype(x.dtype)

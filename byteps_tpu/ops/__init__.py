@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the hot ops.
+
+- :mod:`flash_attention` — blocked online-softmax attention (VMEM-tiled,
+  MXU matmuls), used by the transformer's per-device attention.
+- :mod:`onebit_device` — on-device sign compression, shrinking the
+  device→host transfer 32× before the PS hop (the improvement SURVEY §7
+  "hard parts" identifies over the reference's CPU-side compression).
+
+Every kernel has a pure-jnp fallback selected automatically off-TPU.
+"""
+
+from byteps_tpu.ops.flash_attention import flash_attention
+from byteps_tpu.ops.onebit_device import onebit_compress_device, onebit_decompress_device

@@ -1,0 +1,173 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+Blocked attention in the flash style: one grid cell per (batch·head,
+query-block); the kernel streams key/value blocks through VMEM with a
+running (m, l, acc) online-softmax state, so the S×S score matrix never
+materializes.  MXU does the two matmuls per block; masking and the
+softmax bookkeeping ride the VPU.
+
+This is the per-device compute of the transformer's attention; sequence
+parallelism composes on top (ring attention rotates KV blocks *between*
+devices, this kernel handles the blocks *within* one device).
+
+Fallback: pure jnp (identical math) when not on TPU or when shapes don't
+meet the tiling constraints.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _dense_reference(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _make_kernel(dh: int, bq: int, bk: int, nk: int, causal: bool, scale: float):
+    """Grid-carried-accumulator flash kernel: the KV dimension is the
+    innermost (sequential) grid axis, so Pallas auto-pipelines one
+    (bk, dh) K/V block at a time through VMEM (O(block) footprint, not
+    O(S)); the online-softmax state lives in VMEM scratch that persists
+    across the KV grid steps.  Fully-masked causal blocks skip both MXU
+    matmuls via pl.when."""
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+        qi = pl.program_id(1)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        needed = True if not causal else (j * bk < (qi + 1) * bq)
+
+        @pl.when(needed)
+        def _block():
+            q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+            k = k_ref[0].astype(jnp.float32)  # (BK, D)
+            v = v_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (BQ, BK)
+            if causal:
+                rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            m = m_scr[:, 0]
+            l = l_scr[:, 0]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[:, 0] = m_new
+            l_scr[:, 0] = l_new
+
+        @pl.when(j == nk - 1)
+        def _emit():
+            l = l_scr[:, 0]
+            l = jnp.where(l == 0, 1.0, l)
+            o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+
+    return kernel
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, dh = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    nk = s // bk
+    bh = b * h
+    qf = q.reshape(bh, s, dh)
+    kf = k.reshape(bh, s, dh)
+    vf = v.reshape(bh, s, dh)
+    kernel = _make_kernel(dh, bq, bk, nk, causal, scale)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
+        grid=(bh, s // bq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, bk, dh), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda i, qi, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max m
+            pltpu.VMEM((bq, 1), jnp.float32),   # running denom l
+            pltpu.VMEM((bq, dh), jnp.float32),  # weighted-V accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, dh)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    # Backward recomputes attention with dense math (correct, O(S^2)
+    # memory during backward only).  A blocked backward kernel saving the
+    # forward's logsumexp is the planned upgrade; layer-level remat keeps
+    # today's activation footprint bounded regardless.
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _dense_reference(q_, k_, v_, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """q/k/v: (B, H, S, dh) → (B, H, S, dh).
+
+    Pallas kernel when on TPU and S divides the block sizes; dense jnp
+    fallback otherwise.  Differentiable via custom VJP.
+    """
+    b, h, s, dh = q.shape
+    scale = scale if scale is not None else dh**-0.5
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if (s % bq or s % bk) or (not on_tpu and not interpret):
+        return _dense_reference(q, k, v, causal, scale)
+    return _flash(q, k, v, causal, scale, bq, bk, interpret)

@@ -1,0 +1,98 @@
+"""On-device onebit compression.
+
+The reference compresses on the CPU after staging the full fp32 gradient
+to host (compress loop, core_loops.cc:498-536).  On TPU we can do better
+(SURVEY §7 hard parts): pack sign bits on the DEVICE, so only scale +
+n/32 words cross the device→host boundary — a 32× smaller transfer on the
+path that feeds the DCN PS hop.
+
+Wire format matches the host codec exactly ([f32 scale][u32 words],
+bit = negative — native/compressor.cc), so the server's C++ decompressor
+consumes device-compressed payloads unchanged.
+
+The packing is a Pallas kernel on TPU (sublane reduction over a 32-wide
+bit-weight expansion) with a jnp fallback elsewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pack_jnp(flat: jax.Array, scaling: bool) -> tuple:
+    n = flat.shape[0]
+    scale = jnp.where(
+        scaling, jnp.sum(jnp.abs(flat)) / n, jnp.float32(1.0)
+    ).astype(jnp.float32)
+    pad = (-n) % 32
+    bits = jnp.signbit(jnp.pad(flat, (0, pad))).astype(jnp.uint32).reshape(-1, 32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :]
+    words = jnp.sum(bits * weights, axis=1).astype(jnp.uint32)
+    return scale, words
+
+
+def _pack_kernel(words_per_block: int):
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, out_ref):
+        # x block: (words_per_block, 32) fp32; out block: (words_per_block,)
+        bits = jnp.signbit(x_ref[:]).astype(jnp.uint32)
+        weights = (jnp.uint32(1) << jax.lax.broadcasted_iota(jnp.uint32, bits.shape, 1))
+        out_ref[:] = jnp.sum(bits * weights, axis=1).astype(jnp.uint32)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("scaling", "interpret"))
+def onebit_compress_device(
+    grad: jax.Array, scaling: bool = True, interpret: bool = False
+) -> tuple:
+    """Compress on device: returns (scale f32 scalar, words uint32[ceil(n/32)]).
+
+    Transfer these (scale, words) to host and frame them as
+    [f32 scale][u32 words] — identical to OneBitCompressor's payload.
+    """
+    from jax.experimental import pallas as pl
+
+    flat = grad.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    nwords = (n + 31) // 32
+    if (not on_tpu and not interpret) or n % (32 * 256) != 0:
+        return _pack_jnp(flat, scaling)
+
+    scale = jnp.where(
+        scaling, jnp.sum(jnp.abs(flat)) / n, jnp.float32(1.0)
+    ).astype(jnp.float32)
+    x = flat.reshape(nwords, 32)
+    wpb = 256  # words per grid cell → (256, 32) fp32 blocks in VMEM
+    words = pl.pallas_call(
+        _pack_kernel(wpb),
+        out_shape=jax.ShapeDtypeStruct((nwords,), jnp.uint32),
+        grid=(nwords // wpb,),
+        in_specs=[pl.BlockSpec((wpb, 32), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((wpb,), lambda i: (i,)),
+        interpret=interpret,
+    )(x)
+    return scale, words
+
+
+def onebit_payload(scale: jax.Array, words: jax.Array) -> bytes:
+    """Frame device-compressed pieces as the host/C++ wire format."""
+    return (
+        np.float32(jax.device_get(scale)).tobytes()
+        + np.asarray(jax.device_get(words), dtype=np.uint32).tobytes()
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def onebit_decompress_device(scale: jax.Array, words: jax.Array, n: int) -> jax.Array:
+    """Device-side inverse (for pulling compressed payloads straight to
+    device): words uint32[ceil(n/32)] → fp32[n]."""
+    bits = (words[:, None] >> jnp.arange(32, dtype=jnp.uint32)[None, :]) & jnp.uint32(1)
+    neg = bits.reshape(-1)[:n].astype(bool)
+    return jnp.where(neg, -scale, scale).astype(jnp.float32)

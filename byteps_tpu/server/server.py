@@ -187,6 +187,20 @@ class PSServer:
         # global barrier before serving (server.cc:506)
         send_message(conn, Message(Op.BARRIER, flags=GROUP_ALL))
         recv_message(conn)
+        # periodic heartbeat so the scheduler's liveness view covers
+        # servers too (ps-lite heartbeats, SURVEY §5.3); this thread owns
+        # the scheduler connection from here on (synchronous ping/pong)
+        hb = self.cfg.heartbeat_interval
+        if hb > 0:
+            def beat() -> None:
+                while not self._stop.wait(hb):
+                    try:
+                        send_message(conn, Message(Op.PING))
+                        recv_message(conn)
+                    except (ConnectionError, OSError):
+                        return
+
+            threading.Thread(target=beat, name="ps-heartbeat", daemon=True).start()
 
     # --- connection plane ------------------------------------------------
 

@@ -1,0 +1,87 @@
+"""Callback layer + heartbeat/liveness tests."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.callbacks import (
+    BroadcastGlobalVariablesCallback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)
+
+
+class TestCallbacks:
+    def test_broadcast_once(self):
+        bps.init()
+        cb = BroadcastGlobalVariablesCallback(root_rank=0)
+        params = {"w": np.ones(4)}
+        p1, _ = cb.on_train_begin(params)
+        np.testing.assert_allclose(p1["w"], 1.0)
+        p2, _ = cb.on_train_begin(params)  # second call is a no-op
+        assert p2 is params
+        bps.shutdown()
+
+    def test_metric_average_single_worker(self):
+        bps.init()
+        cb = MetricAverageCallback()
+        out = cb.on_epoch_end({"loss": 2.5, "acc": 0.75})
+        assert out["loss"] == pytest.approx(2.5)
+        assert out["acc"] == pytest.approx(0.75)
+        bps.shutdown()
+
+    def test_lr_schedule_window(self):
+        cb = LearningRateScheduleCallback(0.1, multiplier=0.5, start_epoch=2, end_epoch=4)
+        assert cb.lr(1) is None
+        assert cb.lr(2) == pytest.approx(0.05)
+        assert cb.lr(4) is None
+
+    def test_lr_schedule_callable_staircase(self):
+        cb = LearningRateScheduleCallback(1.0, multiplier=lambda e: 0.1**e, staircase=True)
+        assert cb.lr(0.9) == pytest.approx(1.0)
+        assert cb.lr(1.5) == pytest.approx(0.1)
+
+    def test_warmup_reaches_full_lr(self):
+        bps.init()  # size() == 1 → warmup starts at full lr already
+        cb = LearningRateWarmupCallback(0.4, warmup_epochs=5)
+        assert cb.lr(4.99) == pytest.approx(0.4, rel=1e-6)
+        assert cb.lr(5) is None  # hand over to the main schedule
+        bps.shutdown()
+
+
+class TestHeartbeat:
+    def test_liveness_via_query(self, monkeypatch):
+        from byteps_tpu.common.config import Config
+        from byteps_tpu.comm.rendezvous import Scheduler
+        from byteps_tpu.server.server import PSServer
+
+        monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.2")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        import byteps_tpu as bps2
+
+        bps2.init()
+        from byteps_tpu.core.state import get_state
+
+        client = get_state().ps_client
+        live = client.query_cluster()
+        assert 0 in live["worker"] and 0 in live["server"]
+        time.sleep(0.6)  # a few heartbeat periods
+        live2 = client.query_cluster()
+        # worker heartbeats keep its age small
+        assert live2["worker"][0] < 0.5
+        bps2.shutdown()
+        srv.stop()
+        sched.stop()
