@@ -67,6 +67,26 @@ class _Job:
         self.t0 = time.time()
 
 
+class _StripedStage:
+    """N parallel queues for a stage, striped by key.
+
+    The reference offloads COMPRESS/DECOMPRESS to a thread pool
+    (``BYTEPS_THREADPOOL_SIZE``, core_loops.cc:498-536); striping by key
+    keeps each key's stateful EF/momentum codec on one thread so rounds of
+    the same key never race while different keys compress in parallel.
+    """
+
+    def __init__(self, queue_type: QueueType, n: int) -> None:
+        self.queue_type = queue_type
+        self.stripes = [ScheduledQueue(queue_type) for _ in range(max(1, n))]
+
+    def add_task(self, task: TensorTableEntry) -> None:
+        self.stripes[task.key % len(self.stripes)].add_task(task)
+
+    def report_finish(self, task: TensorTableEntry) -> None:
+        self.stripes[task.key % len(self.stripes)].report_finish(task)
+
+
 class PipelineEngine:
     #: host pipeline stage order (PS path); COMPRESS/DECOMPRESS spliced in
     #: when the tensor has a registered compressor (operations.cc:199-204)
@@ -83,12 +103,13 @@ class PipelineEngine:
         self.tracer = tracer
         self._stop = threading.Event()
         credit = cfg.scheduling_credit
-        self.queues: Dict[QueueType, ScheduledQueue] = {
+        pool = max(1, cfg.threadpool_size)
+        self.queues: Dict[QueueType, Any] = {
             QueueType.COPYD2H: ScheduledQueue(QueueType.COPYD2H),
-            QueueType.COMPRESS: ScheduledQueue(QueueType.COMPRESS),
+            QueueType.COMPRESS: _StripedStage(QueueType.COMPRESS, pool),
             QueueType.PUSH: ScheduledQueue(QueueType.PUSH, credit_bytes=credit),
             QueueType.PULL: ScheduledQueue(QueueType.PULL),
-            QueueType.DECOMPRESS: ScheduledQueue(QueueType.DECOMPRESS),
+            QueueType.DECOMPRESS: _StripedStage(QueueType.DECOMPRESS, pool),
             QueueType.COPYH2D: ScheduledQueue(QueueType.COPYH2D),
         }
         self._threads: List[threading.Thread] = []
@@ -101,20 +122,35 @@ class PipelineEngine:
 
     def start(self) -> None:
         """Spawn one loop thread per host stage (BytePSGlobal::Start,
-        global.cc:299-317)."""
+        global.cc:299-317).  The COMPRESS/DECOMPRESS striped pools spawn
+        lazily when the first codec registers — uncompressed workers don't
+        pay for 2×threadpool_size idle pollers."""
         for qt, fn in (
             (QueueType.COPYD2H, self._copy_d2h_once),
-            (QueueType.COMPRESS, self._compress_once),
             (QueueType.PUSH, self._push_once),
             (QueueType.PULL, self._pull_once),
-            (QueueType.DECOMPRESS, self._decompress_once),
             (QueueType.COPYH2D, self._copy_h2d_once),
         ):
+            self._spawn_stage(qt, fn)
+
+    def _spawn_stage(self, qt: QueueType, fn) -> None:
+        q = self.queues[qt]
+        stripes = q.stripes if isinstance(q, _StripedStage) else [q]
+        for si, sq in enumerate(stripes):
             t = threading.Thread(
-                target=self._loop, args=(qt, fn), name=f"bps-{qt.name}", daemon=True
+                target=self._loop, args=(sq, fn),
+                name=f"bps-{qt.name}-{si}", daemon=True,
             )
             t.start()
             self._threads.append(t)
+
+    def _ensure_compress_threads(self) -> None:
+        """First codec registration → bring up the striped pools."""
+        if getattr(self, "_compress_started", False):
+            return
+        self._compress_started = True
+        self._spawn_stage(QueueType.COMPRESS, self._compress_once)
+        self._spawn_stage(QueueType.DECOMPRESS, self._decompress_once)
 
     def stop(self) -> None:
         self._stop.set()
@@ -122,8 +158,7 @@ class PipelineEngine:
             t.join(timeout=2.0)
         self._threads = []
 
-    def _loop(self, qt: QueueType, fn) -> None:
-        q = self.queues[qt]
+    def _loop(self, q: ScheduledQueue, fn) -> None:
         while not self._stop.is_set():
             task = q.get_task(timeout=0.2)
             if task is None:
@@ -131,8 +166,9 @@ class PipelineEngine:
             try:
                 fn(task)
             except Exception as e:  # surface errors on the handle
+                q.report_finish(task)  # return scheduling credits
                 job: _Job = task.context
-                job_status = Status.Aborted(f"{qt.name}: {e!r}")
+                job_status = Status.Aborted(f"{q.queue_type.name}: {e!r}")
                 self._fail_job(job, job_status)
 
     # --- submission ------------------------------------------------------
@@ -212,6 +248,7 @@ class PipelineEngine:
             codec = create_compressor(ctx.kwargs, part.length, server=False)
             if codec is None:
                 return
+            self._ensure_compress_threads()
             self._compressors[part.key] = codec
             self.client.register_compressor(part.key, ctx.kwargs)
 
@@ -288,8 +325,10 @@ class PipelineEngine:
 
     def _compress_once(self, task: TensorTableEntry) -> None:
         """COMPRESS stage (core_loops.cc:498-536): run the codec chain on
-        the staged partition.  One thread per stage serializes same-key
-        rounds, keeping stateful EF/momentum buffers race-free."""
+        the staged partition.  Stripe routing (key % pool size in
+        _StripedStage) pins each key to one thread, so a key's stateful
+        EF/momentum buffers never race across rounds while different keys
+        compress in parallel."""
         codec = self._compressors[task.key]
         task.compressed = codec.compress(task.cpubuff)
         self._proceed(task)

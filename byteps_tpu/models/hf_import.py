@@ -1,0 +1,87 @@
+"""HuggingFace checkpoint import for the flagship transformer.
+
+Converts a ``transformers`` GPT-2 model's weights into the flat stacked
+param dict of :mod:`byteps_tpu.models.transformer`, giving checkpoint
+interoperability (load a pretrained torch GPT-2, continue training
+TPU-native with full 4-D parallelism) and an architecture cross-check:
+our logits must match HF's bit-for-bit up to float tolerance.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from byteps_tpu.models.transformer import TransformerConfig
+
+
+def config_from_gpt2(hf_config) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.n_embd,
+        n_heads=hf_config.n_head,
+        d_head=hf_config.n_embd // hf_config.n_head,
+        d_ff=hf_config.n_inner or 4 * hf_config.n_embd,
+        n_layers=hf_config.n_layer,
+        max_seq=hf_config.n_positions,
+        causal=True,
+        attn_bias=True,
+        remat=False,
+    )
+
+
+def load_gpt2_weights(hf_model, pp_size: int = 1) -> Tuple[TransformerConfig, Dict[str, np.ndarray]]:
+    """GPT2LMHeadModel → (config, params).  Layer params stacked with
+    leading dims (pp, layers_per_stage)."""
+    cfg = config_from_gpt2(hf_model.config)
+    D, H, dh, F, L = cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff, cfg.n_layers
+    if L % pp_size:
+        raise ValueError(f"n_layers {L} not divisible by pp {pp_size}")
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
+
+    def stack(fn):
+        per_layer = [fn(i) for i in range(L)]
+        arr = np.stack(per_layer)  # (L, ...)
+        return arr.reshape((pp_size, L // pp_size) + arr.shape[1:])
+
+    params: Dict[str, np.ndarray] = {
+        "embed": sd["transformer.wte.weight"].astype(np.float32),
+        "pos": sd["transformer.wpe.weight"].astype(np.float32),
+        "ln_f_s": sd["transformer.ln_f.weight"].astype(np.float32),
+        "ln_f_b": sd["transformer.ln_f.bias"].astype(np.float32),
+        # GPT-2 ties the LM head to the token embedding
+        "head": sd["transformer.wte.weight"].T.astype(np.float32),
+        "ln1_s": stack(lambda i: sd[f"transformer.h.{i}.ln_1.weight"]),
+        "ln1_b": stack(lambda i: sd[f"transformer.h.{i}.ln_1.bias"]),
+        "ln2_s": stack(lambda i: sd[f"transformer.h.{i}.ln_2.weight"]),
+        "ln2_b": stack(lambda i: sd[f"transformer.h.{i}.ln_2.bias"]),
+    }
+
+    # c_attn is HF Conv1D: weight (D, 3D) applied as x @ W + b
+    def qkv(i, which):
+        w = sd[f"transformer.h.{i}.attn.c_attn.weight"]  # (D, 3D)
+        part = np.split(w, 3, axis=1)[which]  # (D, D)
+        return part.reshape(D, H, dh)
+
+    def qkv_b(i, which):
+        b = sd[f"transformer.h.{i}.attn.c_attn.bias"]  # (3D,)
+        return np.split(b, 3)[which].reshape(H, dh)
+
+    params["wq"] = stack(lambda i: qkv(i, 0)).astype(np.float32)
+    params["wk"] = stack(lambda i: qkv(i, 1)).astype(np.float32)
+    params["wv"] = stack(lambda i: qkv(i, 2)).astype(np.float32)
+    params["wq_b"] = stack(lambda i: qkv_b(i, 0)).astype(np.float32)
+    params["wk_b"] = stack(lambda i: qkv_b(i, 1)).astype(np.float32)
+    params["wv_b"] = stack(lambda i: qkv_b(i, 2)).astype(np.float32)
+    params["wo"] = stack(
+        lambda i: sd[f"transformer.h.{i}.attn.c_proj.weight"].reshape(H, dh, D)
+    ).astype(np.float32)
+    params["wo_b"] = stack(
+        lambda i: sd[f"transformer.h.{i}.attn.c_proj.bias"]
+    ).astype(np.float32)
+    params["w1"] = stack(lambda i: sd[f"transformer.h.{i}.mlp.c_fc.weight"]).astype(np.float32)
+    params["b1"] = stack(lambda i: sd[f"transformer.h.{i}.mlp.c_fc.bias"]).astype(np.float32)
+    params["w2"] = stack(lambda i: sd[f"transformer.h.{i}.mlp.c_proj.weight"]).astype(np.float32)
+    params["b2"] = stack(lambda i: sd[f"transformer.h.{i}.mlp.c_proj.bias"]).astype(np.float32)
+    return cfg, params

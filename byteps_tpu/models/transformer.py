@@ -64,6 +64,8 @@ class TransformerConfig:
     # use the Pallas flash-attention kernel for the per-device attention
     # when sequence parallelism is off (ring attention otherwise)
     use_flash: bool = True
+    # qkv/proj bias terms (GPT-2-style checkpoints have them; BERT too)
+    attn_bias: bool = False
 
 
 def bert_large(**kw) -> TransformerConfig:
@@ -123,6 +125,16 @@ def _layouts(cfg: TransformerConfig) -> Dict[str, Tuple]:
         "wv": ((D, H, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
         "wo": ((H, dh, D), P("pp", None, "tp", None, None), ("dp", "sp")),
     }
+    if cfg.attn_bias:
+        table.update(
+            {
+                "wq_b": ((H, dh), P("pp", None, "tp", None), ("dp", "sp")),
+                "wk_b": ((H, dh), P("pp", None, "tp", None), ("dp", "sp")),
+                "wv_b": ((H, dh), P("pp", None, "tp", None), ("dp", "sp")),
+                # added after the tp psum, like b2
+                "wo_b": ((D,), P("pp"), ("dp", "sp", "tp")),
+            }
+        )
     if cfg.moe:
         table.update(
             {
@@ -240,6 +252,10 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
         q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(cdt))
         k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(cdt))
         v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(cdt))
+        if cfg.attn_bias:
+            q = q + lp["wq_b"].astype(cdt)[None, :, None, :]
+            k = k + lp["wk_b"].astype(cdt)[None, :, None, :]
+            v = v + lp["wv_b"].astype(cdt)[None, :, None, :]
         if sp == 1 and cfg.use_flash:
             from byteps_tpu.ops.flash_attention import flash_attention
 
@@ -251,6 +267,8 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
             )
         o = jnp.einsum("bhsk,hkd->bsd", attn, lp["wo"].astype(cdt))
         o = lax.psum(o, "tp")  # row-parallel combine (free at tp=1)
+        if cfg.attn_bias:
+            o = o + lp["wo_b"].astype(cdt)
         x = x + o.astype(x.dtype)
 
         g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)

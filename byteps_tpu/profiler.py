@@ -1,0 +1,42 @@
+"""Profiling surface.
+
+Two layers, matching SURVEY §5.1's split:
+
+- host communication stages → the Chrome tracer built into the engine
+  (BYTEPS_TRACE_*, core/tracing.py), viewable in chrome://tracing;
+- device compute/collectives → XLA's own profiler, exposed here as the
+  :func:`trace` context manager (view in TensorBoard or xprof).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracing: bool = True) -> Iterator[None]:
+    """Capture an XLA device profile (and flush the host comm trace into
+    the same directory on exit)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        if host_tracing:
+            from byteps_tpu.core.state import get_state
+
+            st = get_state()
+            if st.initialized and st.tracer is not None and st.tracer.enabled:
+                st.tracer.trace_dir = log_dir
+                st.tracer.flush()
+
+
+def annotate(name: str):
+    """Named region that shows up on the XLA timeline
+    (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
