@@ -1,17 +1,26 @@
-"""Flash attention — Pallas TPU kernel with online softmax.
+"""Flash attention — Pallas TPU kernels with online softmax, forward and
+backward.
 
-Blocked attention in the flash style: one grid cell per (batch·head,
-query-block); the kernel streams key/value blocks through VMEM with a
-running (m, l, acc) online-softmax state, so the S×S score matrix never
-materializes.  MXU does the two matmuls per block; masking and the
-softmax bookkeeping ride the VPU.
+Forward: one grid cell per (batch·head, query-block); the KV dimension is
+the innermost sequential grid axis so Pallas auto-pipelines one (bk, dh)
+K/V block at a time through VMEM (O(block) footprint, never the S×S score
+matrix).  Online-softmax state (m, l, acc) lives in VMEM scratch persisted
+across KV grid steps; the per-row logsumexp is emitted for the backward.
+
+Backward: the standard two-kernel split —
+  dQ kernel: grid (bh, nq, nk), accumulates dQ for its query block while
+             streaming K/V blocks;
+  dKV kernel: grid (bh, nk, nq), accumulates dK/dV for its key block while
+             streaming Q/dO blocks.
+Both recompute P = exp(QKᵀ·scale − lse) blockwise (no saved probabilities)
+using the forward's logsumexp and Δ = rowsum(dO ∘ O).
+
+Fully-masked causal blocks skip all matmuls via pl.when.  Dense jnp
+fallback off-TPU or for non-divisible shapes; differentiable end to end.
 
 This is the per-device compute of the transformer's attention; sequence
 parallelism composes on top (ring attention rotates KV blocks *between*
-devices, this kernel handles the blocks *within* one device).
-
-Fallback: pure jnp (identical math) when not on TPU or when shapes don't
-meet the tiling constraints.
+devices, these kernels handle the blocks *within* one device).
 """
 
 from __future__ import annotations
@@ -36,16 +45,29 @@ def _dense_reference(q, k, v, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _make_kernel(dh: int, bq: int, bk: int, nk: int, causal: bool, scale: float):
-    """Grid-carried-accumulator flash kernel: the KV dimension is the
-    innermost (sequential) grid axis, so Pallas auto-pipelines one
-    (bk, dh) K/V block at a time through VMEM (O(block) footprint, not
-    O(S)); the online-softmax state lives in VMEM scratch that persists
-    across the KV grid steps.  Fully-masked causal blocks skip both MXU
-    matmuls via pl.when."""
+def _block_needed(causal: bool, qi, j, bq: int, bk: int):
+    """Whether KV block j contributes anything to query block qi."""
+    return True if not causal else (j * bk < (qi + 1) * bq)
+
+
+def _causal_keep(qi, j, bq: int, bk: int):
+    """(bq, bk) bool mask of causally-visible positions for block pair."""
+    import jax
+
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows >= cols
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_factory(dh, bq, bk, nk, causal, scale):
     from jax.experimental import pallas as pl
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr):
         qi = pl.program_id(1)
         j = pl.program_id(2)
 
@@ -55,94 +77,243 @@ def _make_kernel(dh: int, bq: int, bk: int, nk: int, causal: bool, scale: float)
             l_scr[:] = jnp.zeros_like(l_scr)
             acc_scr[:] = jnp.zeros_like(acc_scr)
 
-        needed = True if not causal else (j * bk < (qi + 1) * bq)
-
-        @pl.when(needed)
+        @pl.when(_block_needed(causal, qi, j, bq, bk))
         def _block():
-            q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
-            k = k_ref[0].astype(jnp.float32)  # (BK, D)
+            q = q_ref[0].astype(jnp.float32) * scale
+            k = k_ref[0].astype(jnp.float32)
             v = v_ref[0].astype(jnp.float32)
             s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # (BQ, BK)
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
             if causal:
-                rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-                cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-                s = jnp.where(rows >= cols, s, NEG_INF)
+                s = jnp.where(_causal_keep(qi, j, bq, bk), s, NEG_INF)
             m = m_scr[:, 0]
             l = l_scr[:, 0]
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new[:, None])
-            l_new = l * alpha + jnp.sum(p, axis=-1)
-            acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
             m_scr[:, 0] = m_new
-            l_scr[:, 0] = l_new
+            l_scr[:, 0] = l * alpha + jnp.sum(p, axis=-1)
+            acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
 
         @pl.when(j == nk - 1)
         def _emit():
             l = l_scr[:, 0]
             l = jnp.where(l == 0, 1.0, l)
             o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+            lse_ref[0] = m_scr[:, 0] + jnp.log(l)
 
     return kernel
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, scale, bq, bk, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, dh = q.shape
-    bq = min(block_q, s)
-    bk = min(block_k, s)
     nk = s // bk
     bh = b * h
     qf = q.reshape(bh, s, dh)
     kf = k.reshape(bh, s, dh)
     vf = v.reshape(bh, s, dh)
-    kernel = _make_kernel(dh, bq, bk, nk, causal, scale)
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
+    out, lse = pl.pallas_call(
+        _fwd_kernel_factory(dh, bq, bk, nk, causal, scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ),
         grid=(bh, s // bq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
             pl.BlockSpec((1, bk, dh), lambda i, qi, j: (i, j, 0)),
             pl.BlockSpec((1, bk, dh), lambda i, qi, j: (i, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
+        out_specs=(
+            pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, bq), lambda i, qi, j: (i, qi)),
+        ),
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),   # running max m
-            pltpu.VMEM((bq, 1), jnp.float32),   # running denom l
-            pltpu.VMEM((bq, dh), jnp.float32),  # weighted-V accumulator
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, dh), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, dh)
+    return out.reshape(b, h, s, dh), lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel_factory(dh, bq, bk, nk, causal, scale):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr):
+        qi = pl.program_id(1)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            dq_scr[:] = jnp.zeros_like(dq_scr)
+
+        @pl.when(_block_needed(causal, qi, j, bq, bk))
+        def _block():
+            q = q_ref[0].astype(jnp.float32)
+            k = k_ref[0].astype(jnp.float32)
+            v = v_ref[0].astype(jnp.float32)
+            do = do_ref[0].astype(jnp.float32)
+            lse = lse_ref[0]
+            delta = delta_ref[0]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale
+            p = jnp.exp(s - lse[:, None])
+            if causal:
+                p = jnp.where(_causal_keep(qi, j, bq, bk), p, 0.0)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - delta[:, None])
+            dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+
+        @pl.when(j == nk - 1)
+        def _emit():
+            dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _bwd_dkv_kernel_factory(dh, bq, bk, nq, causal, scale):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+               dk_scr, dv_scr):
+        j = pl.program_id(1)   # key block
+        qi = pl.program_id(2)  # query block (sequential)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_scr[:] = jnp.zeros_like(dk_scr)
+            dv_scr[:] = jnp.zeros_like(dv_scr)
+
+        @pl.when(_block_needed(causal, qi, j, bq, bk))
+        def _block():
+            q = q_ref[0].astype(jnp.float32)
+            k = k_ref[0].astype(jnp.float32)
+            v = v_ref[0].astype(jnp.float32)
+            do = do_ref[0].astype(jnp.float32)
+            lse = lse_ref[0]
+            delta = delta_ref[0]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # (bq, bk)
+            p = jnp.exp(s - lse[:, None])
+            if causal:
+                p = jnp.where(_causal_keep(qi, j, bq, bk), p, 0.0)
+            dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - delta[:, None])
+            dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+
+        @pl.when(qi == nq - 1)
+        def _emit():
+            dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, dh = q.shape
+    bh = b * h
+    nq, nk = s // bq, s // bk
+    qf, kf, vf = (x.reshape(bh, s, dh) for x in (q, k, v))
+    dof = do.reshape(bh, s, dh)
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * o.reshape(bh, s, dh).astype(jnp.float32), axis=-1
+    )  # (bh, s)
+
+    dq = pl.pallas_call(
+        _bwd_dq_kernel_factory(dh, bq, bk, nk, causal, scale),
+        out_shape=jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, bk, dh), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda i, qi, j: (i, j, 0)),
+            pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, bq), lambda i, qi, j: (i, qi)),
+            pl.BlockSpec((1, bq), lambda i, qi, j: (i, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        _bwd_dkv_kernel_factory(dh, bq, bk, nq, causal, scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s, dh), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, dh), v.dtype),
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, bk, dh), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, bq, dh), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, bq), lambda i, j, qi: (i, qi)),
+            pl.BlockSpec((1, bq), lambda i, j, qi: (i, qi)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, dh), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda i, j, qi: (i, j, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, dh), jnp.float32),
+            pltpu.VMEM((bk, dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    shape = (b, h, s, dh)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash(q, k, v, causal, scale, bq, bk, interpret):
+    out, _ = _flash_forward(q, k, v, causal, scale, bq, bk, interpret)
+    return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
+    out, lse = _flash_forward(q, k, v, causal, scale, bq, bk, interpret)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # Backward recomputes attention with dense math (correct, O(S^2)
-    # memory during backward only).  A blocked backward kernel saving the
-    # forward's logsumexp is the planned upgrade; layer-level remat keeps
-    # today's activation footprint bounded regardless.
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _dense_reference(q_, k_, v_, causal, scale), q, k, v)
-    return vjp(g)
+def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, causal, scale, bq, bk, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -160,8 +331,8 @@ def flash_attention(
 ) -> jax.Array:
     """q/k/v: (B, H, S, dh) → (B, H, S, dh).
 
-    Pallas kernel when on TPU and S divides the block sizes; dense jnp
-    fallback otherwise.  Differentiable via custom VJP.
+    Pallas kernels (fwd + blocked bwd) when on TPU and S divides the block
+    sizes; dense jnp fallback otherwise.
     """
     b, h, s, dh = q.shape
     scale = scale if scale is not None else dh**-0.5

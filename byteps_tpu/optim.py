@@ -167,6 +167,103 @@ def build_data_parallel_step(
     return _compile_spmd_step(local_step, mesh, axis_name, donate)
 
 
+def build_zero1_step(
+    loss_fn: Callable[..., jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = DP_AXIS,
+    donate: bool = True,
+) -> Tuple[Callable, Callable]:
+    """ZeRO-1 data parallelism: optimizer state sharded across the dp axis.
+
+    Beyond reference parity (SURVEY §2.7: no ZeRO there), and the natural
+    TPU expression of the cross-replica weight-update sharding idea
+    (Xu et al. 2020, PAPERS.md): gradients are reduce-scattered (each
+    member owns 1/N of the flattened gradient), the optimizer updates only
+    its shard (state memory /N), and updated parameter shards are
+    all-gathered back — the same total comm volume as one all-reduce.
+
+    Returns ``init_fn(params) -> opt_state`` and
+    ``step(params, opt_state, batch)`` as a pair:
+
+        init_fn, step = build_zero1_step(loss_fn, tx, mesh)
+    """
+    mesh = mesh or get_global_mesh()
+    if mesh is None:
+        raise RuntimeError("no global mesh; call byteps_tpu.init() or pass mesh=")
+    n = mesh.shape[axis_name]
+
+    def _padded_size(params) -> int:
+        total = sum(l.size for l in jax.tree_util.tree_leaves(params))
+        return total + ((-total) % n)
+
+    def _flatten(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
+        pad = (-flat.size) % n
+        return jnp.pad(flat, (0, pad)) if pad else flat
+
+    def _unflatten(flat, tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        out, off = [], 0
+        for l in leaves:
+            out.append(flat[off : off + l.size].reshape(l.shape).astype(l.dtype))
+            off += l.size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def init_fn(params):
+        """Sharded optimizer state: each dp member owns 1/N of the flat
+        parameter vector's state, initialized from its REAL parameter
+        shard (value-capturing transforms like lookahead stay correct)."""
+        shard_sz = _padded_size(params) // n
+
+        def local_init(params):
+            flat_p = _flatten(params)
+            idx = lax.axis_index(axis_name) * shard_sz
+            p_shard = lax.dynamic_slice(flat_p, (idx,), (shard_sz,))
+            state = optimizer.init(p_shard)
+            return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], state)
+
+        init = jax.shard_map(
+            local_init, mesh=mesh, in_specs=(P(),), out_specs=P(axis_name),
+            check_vma=False,
+        )
+        return jax.jit(init)(params)
+
+    def local_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        flat_g = _flatten(grads)
+        # mean-gradient shard: reduce-scatter over dp
+        g_shard = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0, tiled=True) / n
+        flat_p = _flatten(params)
+        shard_sz = flat_p.size // n
+        idx = lax.axis_index(axis_name) * shard_sz
+        p_shard = lax.dynamic_slice(flat_p, (idx,), (shard_sz,))
+        opt_local = jax.tree_util.tree_map(lambda x: x[0], opt_state)
+        upd, opt_local = optimizer.update(g_shard, opt_local, p_shard)
+        p_shard = p_shard + upd
+        flat_new = lax.all_gather(p_shard, axis_name, axis=0, tiled=True)
+        params = _unflatten(flat_new, params)
+        loss = lax.pmean(loss, axis_name)
+        opt_state = jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], opt_local)
+        return params, opt_state, loss
+
+    step = _compile_spmd_step_with_state_axis(local_step, mesh, axis_name, donate)
+    return init_fn, step
+
+
+def _compile_spmd_step_with_state_axis(local_step, mesh, axis_name, donate):
+    """Like _compile_spmd_step but the optimizer state is dp-sharded."""
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name)),
+        out_specs=(P(), P(axis_name), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+
 def build_flax_data_parallel_step(
     apply_fn: Callable,
     loss_from_logits: Callable[[jax.Array, Any], jax.Array],
