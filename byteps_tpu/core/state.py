@@ -54,6 +54,13 @@ def init_state(fresh_env: bool = False) -> RuntimeState:
         cfg = reset_config() if fresh_env else get_config()
         st.config = cfg
         st.registry = get_registry()
+        # multi-host JAX runtime (pod slices): opt-in coordinator bring-up —
+        # the scheduler-node analogue for the ICI/DCN collective plane
+        # (SURVEY §5.8: coordinator ↔ jax.distributed.initialize)
+        import os
+
+        if os.environ.get("BYTEPS_JAX_DISTRIBUTED", "0") == "1":
+            jax.distributed.initialize()
         st.mesh = build_mesh(cfg.mesh_shape)
         set_global_mesh(st.mesh)
         st.telemetry = PushPullSpeed(enabled=cfg.telemetry_on)
