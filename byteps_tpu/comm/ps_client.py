@@ -206,7 +206,12 @@ class PSClient:
 
     def init_tensor(self, key: int, num_elements: int, dtype_id: int) -> None:
         """Blocking init-push; doubles as the cross-worker barrier for this
-        key (InitTensor blocking ZPush, operations.cc:283-414)."""
+        key (InitTensor blocking ZPush, operations.cc:283-414).
+
+        Wire payload is language-neutral (u64 nelems + u32 dtype, network
+        order) so the native C++ server parses it directly."""
+        import struct
+
         sc = self._servers[self.server_for(key)]
         done = threading.Event()
         seq = sc.alloc_seq(lambda msg: done.set())
@@ -216,9 +221,7 @@ class PSClient:
                 Op.INIT,
                 key=key,
                 seq=seq,
-                payload=pickle.dumps(
-                    {"num_elements": num_elements, "dtype": dtype_id}
-                ),
+                payload=struct.pack("!QI", num_elements, dtype_id),
             ),
             sc.send_lock,
         )
@@ -276,15 +279,17 @@ class PSClient:
 
     def register_compressor(self, key: int, kwargs: Dict[str, str]) -> None:
         """Ship compressor config to the owning server
-        (kCompressedPushPull init push, operations.cc:396-408)."""
+        (kCompressedPushPull init push, operations.cc:396-408).
+
+        Payload is newline-separated ``key=value`` text — parseable by the
+        Python and native C++ servers alike."""
         sc = self._servers[self.server_for(key)]
         done = threading.Event()
         seq = sc.alloc_seq(lambda msg: done.set())
+        payload = "\n".join(f"{k}={v}" for k, v in sorted(kwargs.items())).encode()
         send_message(
             sc.sock,
-            Message(
-                Op.REGISTER_COMPRESSOR, key=key, seq=seq, payload=pickle.dumps(kwargs)
-            ),
+            Message(Op.REGISTER_COMPRESSOR, key=key, seq=seq, payload=payload),
             sc.send_lock,
         )
         done.wait()

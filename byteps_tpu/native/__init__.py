@@ -35,15 +35,7 @@ def _try_build() -> None:
         pass
 
 
-def _load() -> Optional[ctypes.CDLL]:
-    global _lib
-    if _lib is not None:
-        return _lib
-    if not os.path.exists(_LIB_PATH) and os.environ.get("BYTEPS_NATIVE_AUTOBUILD", "1") != "0":
-        _try_build()
-    if not os.path.exists(_LIB_PATH):
-        return None
-    lib = ctypes.CDLL(_LIB_PATH)
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     c = ctypes
     lib.bps_sum.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int32]
     lib.bps_sum.restype = c.c_int32
@@ -74,8 +66,37 @@ def _load() -> Optional[ctypes.CDLL]:
         c.c_void_p, c.c_int64, c.c_int32, c.c_int32, c.c_void_p,
     ]
     lib.bps_dithering_decompress.restype = c.c_int32
-    _lib = lib
+    # native PS server data plane (ps_server.cc) — may be absent in a
+    # stale .so; codecs/reducer still work without it
+    if hasattr(lib, "bps_native_server_start"):
+        lib.bps_native_server_start.argtypes = [c.c_int32, c.c_int32, c.c_int32]
+        lib.bps_native_server_start.restype = c.c_int32
+        lib.bps_native_server_set_num_workers.argtypes = [c.c_int32]
+        lib.bps_native_server_set_num_workers.restype = None
+        lib.bps_native_server_stop.argtypes = []
+        lib.bps_native_server_stop.restype = None
     return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    autobuild = os.environ.get("BYTEPS_NATIVE_AUTOBUILD", "1") != "0"
+    if not os.path.exists(_LIB_PATH) and autobuild:
+        _try_build()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    if not hasattr(lib, "bps_native_server_start") and autobuild:
+        # stale library from before ps_server.cc existed: rebuild once
+        _try_build()
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+    _lib = _bind(lib)
+    return _lib
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

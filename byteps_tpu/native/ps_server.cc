@@ -1,0 +1,576 @@
+// Native PS server data plane.
+//
+// C++ end-to-end server engine matching byteps/server/server.cc's role
+// (SURVEY §2.3): per-connection reader threads parse the framed protocol
+// (byteps_tpu/comm/transport.py: 32-byte big-endian header + payload) and
+// execute the KV semantics under per-key locks — init-as-barrier,
+// COPY_FIRST/SUM_RECV/ALL_RECV rounds with buffered pulls, async
+// parameter-store mode, and server-side compression (decompress-or-
+// sparse-sum on push, compress-merged for pulls, optional error feedback;
+// momentum is worker-only, compressor_registry.cc:40-56).
+//
+// Control plane (scheduler registration, barriers, heartbeats) stays in
+// the Python wrapper — this engine owns only the worker-facing data
+// socket, where the throughput is.  No GIL: reader threads sum on all
+// cores through the same vectorized kernels in reducer.cc/compressor.cc.
+
+#include <arpa/inet.h>
+#include <endian.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// from reducer.cc / compressor.cc (same shared object)
+extern "C" {
+int32_t bps_sum(void* dst, const void* src, int64_t n, int32_t dtype);
+int64_t bps_onebit_size(int64_t n);
+int64_t bps_onebit_compress(const float* in, int64_t n, uint8_t* out, int32_t scaled);
+int32_t bps_onebit_decompress(const uint8_t* in, int64_t n, float* out);
+int64_t bps_topk_compress(const float* in, int64_t n, int64_t k, uint8_t* out);
+int32_t bps_topk_decompress(const uint8_t* in, int64_t k, float* out, int64_t n);
+int32_t bps_topk_sum_into(const uint8_t* in, int64_t k, float* acc, int64_t n);
+int64_t bps_randomk_compress(const float* in, int64_t n, int64_t k, uint64_t s0,
+                             uint64_t s1, uint8_t* out);
+int64_t bps_dithering_size(int64_t n);
+int64_t bps_dithering_compress(const float* in, int64_t n, int32_t s, int32_t natural,
+                               int32_t l2, uint64_t s0, uint64_t s1, uint8_t* out);
+int32_t bps_dithering_decompress(const uint8_t* in, int64_t n, int32_t s,
+                                 int32_t natural, float* out);
+}
+
+namespace {
+
+constexpr uint8_t kMagic = 0xB5;
+enum Opcode : uint8_t {
+  kInit = 10,
+  kPush = 11,
+  kPull = 12,
+  kRegisterCompressor = 13,
+  kPing = 20,
+  kShutdown = 21,
+};
+
+#pragma pack(push, 1)
+struct Header {
+  uint8_t magic, op, status, flags;
+  uint32_t seq;
+  uint64_t key;
+  uint32_t cmd;
+  uint32_t version;
+  uint64_t length;
+};
+#pragma pack(pop)
+static_assert(sizeof(Header) == 32, "header must be 32 bytes");
+
+int dtype_size(int32_t dt) {
+  switch (dt) {
+    case 0: return 4;  // f32
+    case 1: return 8;  // f64
+    case 2: return 2;  // f16
+    case 3: return 1;  // u8
+    case 4: return 4;  // i32
+    case 5: return 1;  // i8
+    case 6: return 8;  // i64
+    case 7: return 2;  // bf16
+  }
+  return 0;
+}
+
+void decode_cantor(uint32_t cmd, int32_t* rtype, int32_t* dtype) {
+  // inverse of common.cc:98 (see byteps_tpu.common.types)
+  uint64_t w = (uint64_t)((std::sqrt(8.0 * cmd + 1) - 1) / 2);
+  uint64_t t = w * (w + 1) / 2;
+  *dtype = (int32_t)(cmd - t);
+  *rtype = (int32_t)(w - *dtype);
+}
+
+// ---------------------------------------------------------------------------
+// server-side compressor chain (ef? → codec), mirroring registry.py
+// ---------------------------------------------------------------------------
+
+struct Codec {
+  std::string type;          // onebit | topk | randomk | dithering
+  int64_t n = 0;             // dense element count
+  int64_t k = 0;
+  int32_t onebit_scaled = 0;
+  int32_t dith_s = 4, dith_natural = 0, dith_l2 = 0;
+  uint64_t s0 = 0, s1 = 0;
+  bool has_ef = false;
+  std::vector<float> error;  // ef residual
+
+  void decompress(const uint8_t* in, int64_t len, float* out) const {
+    if (type == "onebit") {
+      bps_onebit_decompress(in, n, out);
+    } else if (type == "topk" || type == "randomk") {
+      bps_topk_decompress(in, len / 8, out, n);
+    } else {
+      bps_dithering_decompress(in, n, dith_s, dith_natural, out);
+    }
+  }
+
+  void sum_into(const uint8_t* in, int64_t len, float* acc) const {
+    if (type == "topk" || type == "randomk") {
+      bps_topk_sum_into(in, len / 8, acc, n);
+    } else {
+      std::vector<float> tmp(n);
+      decompress(in, len, tmp.data());
+      bps_sum(acc, tmp.data(), n, 0);
+    }
+  }
+
+  std::vector<uint8_t> compress(const float* dense) {
+    const float* src = dense;
+    std::vector<float> corrected;
+    if (has_ef) {
+      if (error.empty()) error.assign(n, 0.0f);
+      corrected.resize(n);
+      for (int64_t i = 0; i < n; ++i) corrected[i] = dense[i] + error[i];
+      src = corrected.data();
+    }
+    std::vector<uint8_t> out;
+    int64_t ln = 0;
+    if (type == "onebit") {
+      out.resize(bps_onebit_size(n));
+      ln = bps_onebit_compress(src, n, out.data(), onebit_scaled);
+    } else if (type == "topk") {
+      out.resize(8 * k);
+      ln = bps_topk_compress(src, n, k, out.data());
+    } else if (type == "randomk") {
+      out.resize(8 * k);
+      ln = bps_randomk_compress(src, n, k, s0, s1, out.data());
+    } else {
+      out.resize(bps_dithering_size(n));
+      ln = bps_dithering_compress(src, n, dith_s, dith_natural, dith_l2, s0, s1,
+                                  out.data());
+    }
+    out.resize(ln);
+    if (has_ef) {
+      // e = corrected − decompress(payload)  (error_feedback.h:46-90)
+      std::vector<float> dec(n);
+      decompress(out.data(), (int64_t)out.size(), dec.data());
+      for (int64_t i = 0; i < n; ++i) error[i] = src[i] - dec[i];
+    }
+    return out;
+  }
+};
+
+// splitmix-derived seed pair, bit-matching compression/rng.py seed_pair_from
+void seed_pair(uint64_t seed, uint64_t* s0, uint64_t* s1) {
+  const uint64_t D0 = 0x9E3779B97F4A7C15ull, D1 = 0xBF58476D1CE4E5B9ull;
+  if (!seed) { *s0 = D0; *s1 = D1; return; }
+  uint64_t z = seed + D0;
+  z = (z ^ (z >> 30)) * D1;
+  uint64_t a = z ^ (z >> 27); if (!a) a = D0;
+  z = z + D0;
+  z = (z ^ (z >> 30)) * D1;
+  uint64_t b = z ^ (z >> 27); if (!b) b = D1;
+  *s0 = a; *s1 = b;
+}
+
+std::unique_ptr<Codec> make_codec(const std::map<std::string, std::string>& kw,
+                                  int64_t size) {
+  auto get = [&](const char* a, const char* b, const std::string& dflt) {
+    auto it = kw.find(a);
+    if (it != kw.end()) return it->second;
+    it = kw.find(b);
+    if (it != kw.end()) return it->second;
+    return dflt;
+  };
+  std::string type = get("byteps_compressor_type", "compressor", "");
+  if (type.empty()) return nullptr;
+  auto c = std::make_unique<Codec>();
+  c->type = type;
+  c->n = size;
+  double kval = atof(get("byteps_compressor_k", "k", "1").c_str());
+  c->k = (kval > 0 && kval < 1) ? std::max<int64_t>(1, (int64_t)(kval * size))
+                                : std::max<int64_t>(1, (int64_t)kval);
+  if (c->k > size) c->k = size;
+  std::string sc = get("byteps_compressor_onebit_scaling", "scaling", "False");
+  c->onebit_scaled = (sc == "True" || sc == "true" || sc == "1") ? 1 : 0;
+  c->dith_s = c->k > 0 ? (int32_t)c->k : 4;
+  std::string part = get("byteps_dithering_partition", "partition", "0");
+  c->dith_natural = (part == "1" || part == "natural") ? 1 : 0;
+  std::string nrm = get("byteps_dithering_normalize", "normalize", "0");
+  c->dith_l2 = (nrm == "1" || nrm == "l2") ? 1 : 0;
+  uint64_t seed = strtoull(get("byteps_seed", "seed", "0").c_str(), nullptr, 10);
+  seed_pair(seed, &c->s0, &c->s1);
+  c->has_ef = !get("byteps_ef_type", "ef", "").empty();
+  return c;
+}
+
+// ---------------------------------------------------------------------------
+// key state + server
+// ---------------------------------------------------------------------------
+
+struct PendingPull {
+  uint32_t version;
+  int fd;
+  uint32_t seq;
+  bool wants_compressed;
+};
+
+struct KeyState {
+  std::mutex mu;
+  std::vector<uint8_t> store, accum;
+  int32_t dtype = 0;
+  int64_t nelems = 0;
+  int recv_count = 0;
+  uint32_t store_version = 0;
+  std::vector<PendingPull> pending;
+  std::vector<std::pair<int, uint32_t>> init_waiters;  // (fd, seq)
+  std::unique_ptr<Codec> codec;
+  std::vector<uint8_t> pull_payload;
+};
+
+class NativeServer {
+ public:
+  void set_num_workers(int n) { num_workers_.store(n); }
+
+  int start(int port, int num_workers, bool enable_async) {
+    num_workers_.store(num_workers);
+    async_ = enable_async;
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)port);
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) < 0) return -1;
+    if (listen(listen_fd_, 128) < 0) return -1;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &len);
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return ntohs(addr.sin_port);
+  }
+
+  void stop() {
+    stop_.store(true);
+    if (listen_fd_ >= 0) { shutdown(listen_fd_, SHUT_RDWR); close(listen_fd_); }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (int fd : conns_) { shutdown(fd, SHUT_RDWR); close(fd); }
+    for (auto& t : threads_) if (t.joinable()) t.join();
+    threads_.clear();
+    conns_.clear();
+  }
+
+ private:
+  void accept_loop() {
+    while (!stop_.load()) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        // transient failures (client RST before accept, signals, fd
+        // pressure) must not kill the acceptor
+        if (errno == EINTR || errno == ECONNABORTED || errno == EMFILE ||
+            errno == ENFILE || errno == ENOBUFS || errno == ENOMEM) {
+          continue;
+        }
+        return;  // listen socket closed (stop) or unrecoverable
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(conn_mu_);
+      conns_.push_back(fd);
+      threads_.emplace_back([this, fd] { serve(fd); });
+    }
+  }
+
+  static bool recv_exact(int fd, void* buf, size_t n) {
+    uint8_t* p = (uint8_t*)buf;
+    while (n) {
+      ssize_t r = recv(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r;
+      n -= (size_t)r;
+    }
+    return true;
+  }
+
+  static bool send_all(int fd, const void* buf, size_t n) {
+    const uint8_t* p = (const uint8_t*)buf;
+    while (n) {
+      ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        return false;  // stream is dead; caller's reader will notice EOF
+      }
+      p += r;
+      n -= (size_t)r;
+    }
+    return true;
+  }
+
+  void send_msg(int fd, uint8_t op, uint32_t seq, uint64_t key, uint32_t version,
+                const uint8_t* payload, uint64_t len) {
+    Header h{};
+    h.magic = kMagic;
+    h.op = op;
+    h.seq = htonl(seq);
+    h.key = htobe64(key);
+    h.cmd = 0;
+    h.version = htonl(version);
+    h.length = htobe64(len);
+    std::mutex* mu;
+    {
+      std::lock_guard<std::mutex> g(wm_mu_);
+      mu = &write_mu_[fd];
+    }
+    std::lock_guard<std::mutex> g(*mu);
+    if (!send_all(fd, &h, sizeof(h))) return;
+    if (len) send_all(fd, payload, len);
+  }
+
+  KeyState& key_state(uint64_t key) {
+    std::lock_guard<std::mutex> g(keys_mu_);
+    auto& slot = keys_[key];
+    if (!slot) slot = std::make_unique<KeyState>();
+    return *slot;
+  }
+
+  void serve(int fd) {
+    std::vector<uint8_t> payload;
+    while (!stop_.load()) {
+      Header h;
+      if (!recv_exact(fd, &h, sizeof(h)) || h.magic != kMagic) break;
+      uint32_t seq = ntohl(h.seq);
+      uint64_t key = be64toh(h.key);
+      uint32_t cmd = ntohl(h.cmd);
+      uint32_t version = ntohl(h.version);
+      uint64_t len = be64toh(h.length);
+      payload.resize(len);
+      if (len && !recv_exact(fd, payload.data(), len)) break;
+      switch (h.op) {
+        case kPing:
+          send_msg(fd, kPing, seq, 0, 0, nullptr, 0);
+          break;
+        case kShutdown:
+          send_msg(fd, kShutdown, seq, 0, 0, nullptr, 0);
+          return;
+        case kInit:
+          if (!handle_init(fd, seq, key, payload)) return;  // malformed → drop conn
+          break;
+        case kRegisterCompressor:
+          handle_register(fd, seq, key, payload);
+          break;
+        case kPush:
+          handle_push(fd, seq, key, cmd, version, payload);
+          break;
+        case kPull:
+          handle_pull(fd, seq, key, cmd, version);
+          break;
+        default:
+          break;
+      }
+    }
+  }
+
+  bool handle_init(int fd, uint32_t seq, uint64_t key,
+                   const std::vector<uint8_t>& payload) {
+    // malformed init must not silently strand the barrier: drop the
+    // connection so the worker sees EOF instead of hanging forever
+    if (payload.size() < 12) return false;
+    uint64_t n;
+    uint32_t dt;
+    std::memcpy(&n, payload.data(), 8);
+    std::memcpy(&dt, payload.data() + 8, 4);
+    n = be64toh(n);
+    dt = ntohl(dt);
+    auto& ks = key_state(key);
+    std::vector<std::pair<int, uint32_t>> waiters;
+    {
+      std::lock_guard<std::mutex> g(ks.mu);
+      if (ks.store.empty()) {
+        ks.dtype = (int32_t)dt;
+        ks.nelems = (int64_t)n;
+        size_t bytes = (size_t)n * dtype_size((int32_t)dt);
+        ks.store.assign(bytes, 0);
+        ks.accum.assign(bytes, 0);
+      }
+      ks.init_waiters.emplace_back(fd, seq);
+      if ((int)ks.init_waiters.size() >= num_workers_.load()) {
+        waiters.swap(ks.init_waiters);
+      }
+    }
+    for (auto& [wfd, wseq] : waiters)
+      send_msg(wfd, kInit, wseq, key, 0, nullptr, 0);
+    return true;
+  }
+
+  void handle_register(int fd, uint32_t seq, uint64_t key,
+                       const std::vector<uint8_t>& payload) {
+    std::map<std::string, std::string> kw;
+    std::string text((const char*)payload.data(), payload.size());
+    size_t pos = 0;
+    while (pos < text.size()) {
+      size_t nl = text.find('\n', pos);
+      std::string line = text.substr(pos, nl == std::string::npos ? nl : nl - pos);
+      size_t eq = line.find('=');
+      if (eq != std::string::npos)
+        kw[line.substr(0, eq)] = line.substr(eq + 1);
+      if (nl == std::string::npos) break;
+      pos = nl + 1;
+    }
+    auto& ks = key_state(key);
+    {
+      std::lock_guard<std::mutex> g(ks.mu);
+      ks.codec = make_codec(kw, ks.nelems);
+    }
+    send_msg(fd, kRegisterCompressor, seq, key, 0, nullptr, 0);
+  }
+
+  void handle_push(int fd, uint32_t seq, uint64_t key, uint32_t cmd,
+                   uint32_t version, const std::vector<uint8_t>& payload) {
+    int32_t rtype, dtype;
+    decode_cantor(cmd, &rtype, &dtype);
+    auto& ks = key_state(key);
+    std::vector<std::tuple<int, uint32_t, std::vector<uint8_t>, uint32_t>> flush;
+    {
+      std::lock_guard<std::mutex> g(ks.mu);
+      if (ks.store.empty()) return;  // push before init: drop (client bug)
+      bool compressed = (rtype == 2) && ks.codec != nullptr;
+      float* accf = (float*)ks.accum.data();
+      if (async_) {
+        if (compressed)
+          ks.codec->sum_into(payload.data(), (int64_t)payload.size(),
+                             (float*)ks.store.data());
+        else
+          bps_sum(ks.store.data(), payload.data(),
+                  (int64_t)payload.size() / dtype_size(ks.dtype), ks.dtype);
+        ks.store_version++;
+      } else {
+        if (compressed) {
+          if (ks.recv_count == 0) {
+            std::memset(ks.accum.data(), 0, ks.accum.size());
+            ks.codec->decompress(payload.data(), (int64_t)payload.size(), accf);
+          } else {
+            ks.codec->sum_into(payload.data(), (int64_t)payload.size(), accf);
+          }
+        } else if (ks.recv_count == 0) {
+          std::memcpy(ks.accum.data(), payload.data(),
+                      std::min(payload.size(), ks.accum.size()));
+        } else {
+          bps_sum(ks.accum.data(), payload.data(),
+                  (int64_t)payload.size() / dtype_size(ks.dtype), ks.dtype);
+        }
+        ks.recv_count++;
+        if (ks.recv_count >= num_workers_.load()) {
+          ks.store.swap(ks.accum);
+          ks.store_version++;
+          ks.recv_count = 0;
+          if (ks.codec)
+            ks.pull_payload = ks.codec->compress((const float*)ks.store.data());
+          std::vector<PendingPull> still;
+          for (auto& p : ks.pending) {
+            if (p.version <= ks.store_version) {
+              flush.emplace_back(p.fd, p.seq,
+                                 wire_payload_locked(ks, p.wants_compressed),
+                                 ks.store_version);
+            } else {
+              still.push_back(p);
+            }
+          }
+          ks.pending.swap(still);
+        }
+      }
+    }
+    send_msg(fd, kPush, seq, key, version, nullptr, 0);
+    for (auto& [pfd, pseq, data, ver] : flush)
+      send_msg(pfd, kPull, pseq, key, ver, data.data(), data.size());
+  }
+
+  std::vector<uint8_t> wire_payload_locked(KeyState& ks, bool wants_compressed) {
+    if (wants_compressed && ks.codec) {
+      if (async_ || ks.pull_payload.empty())
+        return ks.codec->compress((const float*)ks.store.data());
+      return ks.pull_payload;
+    }
+    return ks.store;
+  }
+
+  void handle_pull(int fd, uint32_t seq, uint64_t key, uint32_t cmd,
+                   uint32_t version) {
+    int32_t rtype, dtype;
+    decode_cantor(cmd, &rtype, &dtype);
+    auto& ks = key_state(key);
+    std::vector<uint8_t> data;
+    uint32_t ver;
+    {
+      std::lock_guard<std::mutex> g(ks.mu);
+      if (ks.store.empty()) return;
+      bool ready = async_ || version <= ks.store_version;
+      if (!ready) {
+        ks.pending.push_back({version, fd, seq, rtype == 2});
+        return;
+      }
+      data = wire_payload_locked(ks, rtype == 2);
+      ver = ks.store_version;
+    }
+    send_msg(fd, kPull, seq, key, ver, data.data(), data.size());
+  }
+
+  int listen_fd_ = -1;
+  std::atomic<int> num_workers_{1};
+  bool async_ = false;
+  std::atomic<bool> stop_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<int> conns_;
+  std::vector<std::thread> threads_;
+  std::mutex keys_mu_;
+  std::map<uint64_t, std::unique_ptr<KeyState>> keys_;
+  std::mutex wm_mu_;
+  std::map<int, std::mutex> write_mu_;
+};
+
+NativeServer* g_server = nullptr;
+std::mutex g_server_mu;
+
+}  // namespace
+
+extern "C" {
+
+// start the native data plane; returns the bound port (or -1)
+int32_t bps_native_server_start(int32_t port, int32_t num_workers,
+                                int32_t enable_async) {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  if (g_server) return -1;
+  g_server = new NativeServer();
+  int p = g_server->start(port, num_workers, enable_async != 0);
+  if (p < 0) {
+    delete g_server;
+    g_server = nullptr;
+  }
+  return p;
+}
+
+// update the engine's expected worker count (scheduler address book wins
+// over the launch-time env, matching the Python server)
+void bps_native_server_set_num_workers(int32_t n) {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  if (g_server) g_server->set_num_workers(n);
+}
+
+void bps_native_server_stop() {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  if (!g_server) return;
+  g_server->stop();
+  delete g_server;
+  g_server = nullptr;
+}
+
+}  // extern "C"

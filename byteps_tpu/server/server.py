@@ -224,16 +224,19 @@ class PSServer:
                     self._enqueue(msg, conn, send_lock)
                 elif msg.op == Op.REGISTER_COMPRESSOR:
                     # compressor registration init-push (server.cc:228-257);
-                    # server chain skips momentum (compressor_registry.cc:44)
+                    # server chain skips momentum (compressor_registry.cc:44);
+                    # payload is key=value lines (shared with the C++ server)
                     from byteps_tpu.compression.registry import create_compressor
 
                     ks = self._key_state(msg.key)
+                    kwargs = dict(
+                        ln.split("=", 1)
+                        for ln in msg.payload.decode().splitlines() if "=" in ln
+                    )
                     with ks.lock:
-                        ks.compressor_kwargs = pickle.loads(msg.payload)
+                        ks.compressor_kwargs = kwargs
                         size = ks.store.size if ks.store is not None else 0
-                        ks.compressor = create_compressor(
-                            ks.compressor_kwargs, size, server=True
-                        )
+                        ks.compressor = create_compressor(kwargs, size, server=True)
                     send_message(conn, Message(Op.REGISTER_COMPRESSOR, seq=msg.seq), send_lock)
                 elif msg.op == Op.PING:
                     send_message(conn, Message(Op.PING, seq=msg.seq), send_lock)
@@ -284,13 +287,15 @@ class PSServer:
                 continue
 
     def _handle_init(self, msg: Message, conn, send_lock) -> None:
-        """Init push = allocate + cross-worker barrier (server.cc:266-295)."""
-        meta = pickle.loads(msg.payload)
+        """Init push = allocate + cross-worker barrier (server.cc:266-295).
+        Payload: u64 nelems + u32 dtype, network order."""
+        import struct
+
+        n, dtype_id = struct.unpack("!QI", msg.payload)
         ks = self._key_state(msg.key)
         with ks.lock:
             if ks.store is None:
-                dtype = to_numpy_dtype(DataType(meta["dtype"]))
-                n = meta["num_elements"]
+                dtype = to_numpy_dtype(DataType(dtype_id))
                 ks.dtype = dtype
                 ks.store = np.zeros(n, dtype=dtype)
                 ks.accum = np.zeros(n, dtype=dtype)
@@ -384,6 +389,53 @@ class PSServer:
         )
 
 
+class NativePSServer:
+    """Python control shell around the C++ data plane (ps_server.cc).
+
+    The C++ engine owns the worker-facing socket (framing, KV rounds,
+    compression, summation — no GIL); this wrapper does what ps-lite's van
+    does for the reference server: scheduler registration, the init
+    barrier, and heartbeats.  Enable with ``BYTEPS_SERVER_NATIVE=1``.
+    """
+
+    def __init__(self, cfg: Config, host: str = "127.0.0.1") -> None:
+        from byteps_tpu.native import get_lib
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native server requested but libbyteps_tpu.so unavailable "
+                "(make -C byteps_tpu/native)"
+            )
+        self._lib = lib
+        self.cfg = cfg
+        self.host = host
+        self.port = lib.bps_native_server_start(0, cfg.num_worker, int(cfg.enable_async))
+        if self.port < 0:
+            raise RuntimeError("bps_native_server_start failed")
+        self.rank: Optional[int] = None
+        self.num_workers = cfg.num_worker
+        self._stop = threading.Event()
+        self._sched_conn: Optional[socket.socket] = None
+
+    def start(self, register: bool = True) -> None:
+        if register:
+            # identical control-plane bring-up to the Python server
+            PSServer._register_with_scheduler(self)  # type: ignore[arg-type]
+            # the scheduler's address book wins over launch-time env
+            # (PSServer adopts book["num_workers"]; mirror it in the engine)
+            self._lib.bps_native_server_set_num_workers(self.num_workers)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._lib.bps_native_server_stop()
+        if self._sched_conn is not None:
+            try:
+                self._sched_conn.close()
+            except OSError:
+                pass
+
+
 def _make_reducer():
     """Native C++ summation when available (cpu_reducer.cc equivalent),
     numpy otherwise."""
@@ -410,7 +462,12 @@ def run_server() -> None:
         sched.start()
         threading.Event().wait()  # serve forever
     elif cfg.role == "server":
-        srv = PSServer(cfg, host=cfg.node_host or "127.0.0.1")
+        import os
+
+        if os.environ.get("BYTEPS_SERVER_NATIVE", "0") == "1":
+            srv = NativePSServer(cfg, host=cfg.node_host or "127.0.0.1")
+        else:
+            srv = PSServer(cfg, host=cfg.node_host or "127.0.0.1")
         srv.start()
         threading.Event().wait()
     else:

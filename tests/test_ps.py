@@ -19,12 +19,19 @@ import pytest
 
 from byteps_tpu.common.config import Config
 from byteps_tpu.comm.rendezvous import Scheduler
-from byteps_tpu.server.server import PSServer
+from byteps_tpu.server.server import NativePSServer, PSServer
 
 
-@pytest.fixture
-def fake_cluster(monkeypatch):
-    """Scheduler + 1 server in-process; this process becomes the worker."""
+@pytest.fixture(params=["python", "native"])
+def fake_cluster(request, monkeypatch):
+    """Scheduler + 1 server in-process; this process becomes the worker.
+    Parametrized over the Python server and the C++ native data plane —
+    every PS test runs against both engines."""
+    if request.param == "native":
+        from byteps_tpu.native import HAVE_NATIVE
+
+        if not HAVE_NATIVE:
+            pytest.skip("native lib not built")
     sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
     sched.start()
     monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
@@ -34,7 +41,7 @@ def fake_cluster(monkeypatch):
     monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
 
     scfg = Config.from_env()
-    srv = PSServer(scfg)
+    srv = NativePSServer(scfg) if request.param == "native" else PSServer(scfg)
     t = threading.Thread(target=srv.start, daemon=True)  # registration blocks on barrier
     t.start()
     yield {"scheduler": sched, "server": srv}
