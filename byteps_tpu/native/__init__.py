@@ -89,12 +89,24 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     lib = ctypes.CDLL(_LIB_PATH)
     if not hasattr(lib, "bps_native_server_start") and autobuild:
-        # stale library from before ps_server.cc existed: rebuild once
+        # stale library from before ps_server.cc existed: rebuild, then
+        # load via a temp COPY — dlopen dedups by path/inode, so reloading
+        # the original path can hand back the old mapping
         _try_build()
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            import shutil
+            import tempfile
+
+            tmp = tempfile.NamedTemporaryFile(
+                suffix=".so", prefix="libbyteps_tpu_", delete=False
+            )
+            tmp.close()
+            shutil.copy(_LIB_PATH, tmp.name)
+            fresh = ctypes.CDLL(tmp.name)
+            if hasattr(fresh, "bps_native_server_start"):
+                lib = fresh
         except OSError:
-            return None
+            pass
     _lib = _bind(lib)
     return _lib
 

@@ -262,10 +262,18 @@ class NativeServer {
     stop_.store(true);
     if (listen_fd_ >= 0) { shutdown(listen_fd_, SHUT_RDWR); close(listen_fd_); }
     if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> threads;
+    {
+      // shutdown (not close) live fds so blocked recv()s return; the
+      // serve() epilogue closes and prunes.  Join OUTSIDE the lock —
+      // exiting serve threads take conn_mu_ to prune themselves.
+      std::lock_guard<std::mutex> g(conn_mu_);
+      for (int fd : conns_) shutdown(fd, SHUT_RDWR);
+      threads.swap(threads_);
+    }
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
     std::lock_guard<std::mutex> g(conn_mu_);
-    for (int fd : conns_) { shutdown(fd, SHUT_RDWR); close(fd); }
-    for (auto& t : threads_) if (t.joinable()) t.join();
-    threads_.clear();
     conns_.clear();
   }
 
@@ -325,10 +333,12 @@ class NativeServer {
     h.cmd = 0;
     h.version = htonl(version);
     h.length = htobe64(len);
-    std::mutex* mu;
+    std::shared_ptr<std::mutex> mu;
     {
       std::lock_guard<std::mutex> g(wm_mu_);
-      mu = &write_mu_[fd];
+      auto& slot = write_mu_[fd];
+      if (!slot) slot = std::make_shared<std::mutex>();
+      mu = slot;  // shared_ptr keeps the mutex alive across conn pruning
     }
     std::lock_guard<std::mutex> g(*mu);
     if (!send_all(fd, &h, sizeof(h))) return;
@@ -343,6 +353,22 @@ class NativeServer {
   }
 
   void serve(int fd) {
+    serve_inner(fd);
+    // reclaim per-connection state (long-lived servers see many
+    // reconnects; leaking fds eventually EMFILEs the acceptor)
+    {
+      std::lock_guard<std::mutex> g(wm_mu_);
+      write_mu_.erase(fd);
+    }
+    {
+      std::lock_guard<std::mutex> g(conn_mu_);
+      for (auto it = conns_.begin(); it != conns_.end(); ++it)
+        if (*it == fd) { conns_.erase(it); break; }
+    }
+    ::close(fd);
+  }
+
+  void serve_inner(int fd) {
     std::vector<uint8_t> payload;
     while (!stop_.load()) {
       Header h;
@@ -368,10 +394,10 @@ class NativeServer {
           handle_register(fd, seq, key, payload);
           break;
         case kPush:
-          handle_push(fd, seq, key, cmd, version, payload);
+          if (!handle_push(fd, seq, key, cmd, version, payload)) return;
           break;
         case kPull:
-          handle_pull(fd, seq, key, cmd, version);
+          if (!handle_pull(fd, seq, key, cmd, version)) return;
           break;
         default:
           break;
@@ -433,7 +459,7 @@ class NativeServer {
     send_msg(fd, kRegisterCompressor, seq, key, 0, nullptr, 0);
   }
 
-  void handle_push(int fd, uint32_t seq, uint64_t key, uint32_t cmd,
+  bool handle_push(int fd, uint32_t seq, uint64_t key, uint32_t cmd,
                    uint32_t version, const std::vector<uint8_t>& payload) {
     int32_t rtype, dtype;
     decode_cantor(cmd, &rtype, &dtype);
@@ -441,16 +467,21 @@ class NativeServer {
     std::vector<std::tuple<int, uint32_t, std::vector<uint8_t>, uint32_t>> flush;
     {
       std::lock_guard<std::mutex> g(ks.mu);
-      if (ks.store.empty()) return;  // push before init: drop (client bug)
+      if (ks.store.empty()) return false;  // push before init → drop conn
       bool compressed = (rtype == 2) && ks.codec != nullptr;
       float* accf = (float*)ks.accum.data();
+      // clamp to the allocated buffer: a payload larger than the declared
+      // size (client skew) must never write out of bounds
+      const int64_t max_elems =
+          (int64_t)ks.store.size() / dtype_size(ks.dtype);
+      const int64_t n_elems = std::min<int64_t>(
+          (int64_t)payload.size() / dtype_size(ks.dtype), max_elems);
       if (async_) {
         if (compressed)
           ks.codec->sum_into(payload.data(), (int64_t)payload.size(),
                              (float*)ks.store.data());
         else
-          bps_sum(ks.store.data(), payload.data(),
-                  (int64_t)payload.size() / dtype_size(ks.dtype), ks.dtype);
+          bps_sum(ks.store.data(), payload.data(), n_elems, ks.dtype);
         ks.store_version++;
       } else {
         if (compressed) {
@@ -464,8 +495,7 @@ class NativeServer {
           std::memcpy(ks.accum.data(), payload.data(),
                       std::min(payload.size(), ks.accum.size()));
         } else {
-          bps_sum(ks.accum.data(), payload.data(),
-                  (int64_t)payload.size() / dtype_size(ks.dtype), ks.dtype);
+          bps_sum(ks.accum.data(), payload.data(), n_elems, ks.dtype);
         }
         ks.recv_count++;
         if (ks.recv_count >= num_workers_.load()) {
@@ -491,6 +521,7 @@ class NativeServer {
     send_msg(fd, kPush, seq, key, version, nullptr, 0);
     for (auto& [pfd, pseq, data, ver] : flush)
       send_msg(pfd, kPull, pseq, key, ver, data.data(), data.size());
+    return true;
   }
 
   std::vector<uint8_t> wire_payload_locked(KeyState& ks, bool wants_compressed) {
@@ -502,7 +533,7 @@ class NativeServer {
     return ks.store;
   }
 
-  void handle_pull(int fd, uint32_t seq, uint64_t key, uint32_t cmd,
+  bool handle_pull(int fd, uint32_t seq, uint64_t key, uint32_t cmd,
                    uint32_t version) {
     int32_t rtype, dtype;
     decode_cantor(cmd, &rtype, &dtype);
@@ -511,16 +542,17 @@ class NativeServer {
     uint32_t ver;
     {
       std::lock_guard<std::mutex> g(ks.mu);
-      if (ks.store.empty()) return;
+      if (ks.store.empty()) return false;  // pull before init → drop conn
       bool ready = async_ || version <= ks.store_version;
       if (!ready) {
         ks.pending.push_back({version, fd, seq, rtype == 2});
-        return;
+        return true;
       }
       data = wire_payload_locked(ks, rtype == 2);
       ver = ks.store_version;
     }
     send_msg(fd, kPull, seq, key, ver, data.data(), data.size());
+    return true;
   }
 
   int listen_fd_ = -1;
@@ -534,7 +566,7 @@ class NativeServer {
   std::mutex keys_mu_;
   std::map<uint64_t, std::unique_ptr<KeyState>> keys_;
   std::mutex wm_mu_;
-  std::map<int, std::mutex> write_mu_;
+  std::map<int, std::shared_ptr<std::mutex>> write_mu_;
 };
 
 NativeServer* g_server = nullptr;

@@ -214,3 +214,6 @@ def broadcast_object(obj: Any, root_rank: int = 0, name: str = "obj") -> Any:
     from byteps_tpu.api import broadcast_object as _bo
 
     return _bo(obj, root_rank=root_rank, name=name)
+
+
+from byteps_tpu.torch import parallel  # noqa: E402,F401  (bps.parallel.DistributedDataParallel)

@@ -1,0 +1,144 @@
+"""DistributedDataParallel for torch modules.
+
+Parity with the reference's byteps/torch/parallel/distributed.py:13-287:
+wrap an ``nn.Module``; backward hooks launch one async push_pull per
+parameter bucket (group sync), gradients are averaged across workers
+before ``optimizer.step()``, and ``no_sync()`` suspends communication for
+gradient accumulation.
+
+    model = bps.parallel.DistributedDataParallel(net)
+    for x, y in loader:
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        model.grad_sync()          # wait + write back averaged grads
+        optimizer.step(); optimizer.zero_grad()
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+import torch
+
+from byteps_tpu.api import declare_tensor
+from byteps_tpu.api import push_pull_async as _push_pull_async
+from byteps_tpu.api import synchronize as _synchronize
+
+
+class DistributedDataParallel(torch.nn.Module):
+    """Gradient-averaging module wrapper over the PS plane.
+
+    ``bucket_bytes`` groups small parameters into one communication call
+    (the reference's push_pull_group_sync_inplace bucketing,
+    parallel/distributed.py:150-220) so tiny tensors don't pay per-key
+    round-trips.
+    """
+
+    _instances = 0  # per-process counter: bucket keys are instance-scoped
+
+    def __init__(self, module: torch.nn.Module, bucket_bytes: int = 1 << 20) -> None:
+        super().__init__()
+        self.module = module
+        self._sync_enabled = True
+        self._handles: List[tuple] = []
+        self._buckets: List[List[tuple]] = []
+        # two wrapped models in one process (GAN, teacher/student) must not
+        # collide on PS keys — scope names by instance index.  NOTE: every
+        # worker must construct its DDP wrappers in the same order.
+        self._iid = DistributedDataParallel._instances
+        DistributedDataParallel._instances += 1
+
+        # assign parameters to buckets in reverse declaration order (grads
+        # arrive back-to-front in backward)
+        bucket: List[tuple] = []
+        size = 0
+        named = [(n, p) for n, p in module.named_parameters() if p.requires_grad]
+        for name, p in reversed(named):
+            bucket.append((name, p))
+            size += p.numel() * p.element_size()
+            if size >= bucket_bytes:
+                self._buckets.append(bucket)
+                bucket, size = [], 0
+        if bucket:
+            self._buckets.append(bucket)
+        for bi, bucket in enumerate(self._buckets):
+            declare_tensor(self._bucket_name(bi))
+        self._pending: Dict[int, int] = {}  # bucket index → remaining grads
+        for bi, bucket in enumerate(self._buckets):
+            for _, p in bucket:
+                p.register_post_accumulate_grad_hook(self._make_hook(bi))
+
+    def _bucket_name(self, bi: int) -> str:
+        return f"DDP.{self._iid}.bucket.{bi}"
+
+    def forward(self, *args, **kwargs):
+        self._pending = {bi: len(b) for bi, b in enumerate(self._buckets)}
+        self._handles = []
+        return self.module(*args, **kwargs)
+
+    def _make_hook(self, bucket_idx: int):
+        def hook(p):
+            if not self._sync_enabled:
+                return
+            remaining = self._pending.get(bucket_idx)
+            if remaining is None:
+                return
+            self._pending[bucket_idx] = remaining - 1
+            if self._pending[bucket_idx] == 0:
+                self._launch_bucket(bucket_idx)
+
+        return hook
+
+    def _launch_bucket(self, bi: int) -> None:
+        bucket = self._buckets[bi]
+        flat = np.concatenate(
+            [p.grad.detach().cpu().numpy().reshape(-1) for _, p in bucket]
+        )
+        handle = _push_pull_async(
+            flat, name=self._bucket_name(bi), average=True, priority=bi
+        )
+        self._handles.append((bi, handle))
+
+    def grad_sync(self) -> None:
+        """Block until all launched buckets return; scatter the averaged
+        flats back into ``p.grad`` (synchronize(), distributed.py:230-260).
+
+        Raises if any parameter produced no gradient this iteration — a
+        stranded bucket would silently desynchronize workers (torch DDP
+        errors loudly for the same reason)."""
+        if self._sync_enabled:
+            stranded = {
+                bi: left for bi, left in self._pending.items() if left > 0
+            }
+            if stranded:
+                names = [
+                    n for bi in stranded for n, p in self._buckets[bi]
+                    if p.grad is None
+                ]
+                raise RuntimeError(
+                    "DistributedDataParallel: parameters received no "
+                    f"gradient this iteration (unused in forward?): {names}; "
+                    "their buckets were never communicated"
+                )
+        for bi, handle in self._handles:
+            flat = np.asarray(_synchronize(handle))
+            off = 0
+            for _, p in self._buckets[bi]:
+                n = p.grad.numel()
+                avg = torch.as_tensor(flat[off : off + n]).view_as(p.grad)
+                p.grad.copy_(avg.to(p.grad.dtype))
+                off += n
+        self._handles = []
+
+    @contextlib.contextmanager
+    def no_sync(self) -> Iterator[None]:
+        """Suspend gradient communication (gradient accumulation,
+        distributed.py:262-287)."""
+        old = self._sync_enabled
+        self._sync_enabled = False
+        try:
+            yield
+        finally:
+            self._sync_enabled = old

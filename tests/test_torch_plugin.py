@@ -173,3 +173,42 @@ class TestMixedPrecision:
         np.testing.assert_allclose(
             np.asarray(st.masters["w"]), 1.0 - 10 * 0.01 * 0.01, rtol=1e-3
         )
+
+
+class TestTorchDDP:
+    def test_ddp_matches_bare_training(self):
+        bps.init()
+        from byteps_tpu.torch.parallel import DistributedDataParallel
+
+        m1, m2 = _model(seed=3), _model(seed=3)
+        m2.load_state_dict(m1.state_dict())
+        ddp = DistributedDataParallel(m2, bucket_bytes=64)  # forces >1 bucket
+        assert len(ddp._buckets) > 1
+        x, y = _data(seed=3)
+        o1 = torch.optim.SGD(m1.parameters(), lr=0.05)
+        o2 = torch.optim.SGD(m2.parameters(), lr=0.05)
+        for _ in range(5):
+            o1.zero_grad()
+            torch.nn.functional.mse_loss(m1(x), y).backward()
+            o1.step()
+            o2.zero_grad()
+            torch.nn.functional.mse_loss(ddp(x), y).backward()
+            ddp.grad_sync()
+            o2.step()
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            assert torch.allclose(p1, p2, rtol=1e-5, atol=1e-7)
+        bps.shutdown()
+
+    def test_no_sync_accumulation(self):
+        bps.init()
+        from byteps_tpu.torch.parallel import DistributedDataParallel
+
+        m = _model(seed=4)
+        ddp = DistributedDataParallel(m)
+        x, y = _data(seed=4)
+        with ddp.no_sync():
+            torch.nn.functional.mse_loss(ddp(x), y).backward()
+        assert ddp._handles == []  # nothing communicated
+        torch.nn.functional.mse_loss(ddp(x), y).backward()
+        ddp.grad_sync()  # second (sync) pass communicates
+        bps.shutdown()
