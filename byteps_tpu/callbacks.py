@@ -18,7 +18,7 @@ training loops: plain callables you invoke at the standard hook points
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 

@@ -21,9 +21,8 @@ data-parallel topology.
 
 from __future__ import annotations
 
-import contextlib
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np

@@ -16,7 +16,7 @@ from __future__ import annotations
 import pickle
 import socket
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
 from byteps_tpu.common.config import Config
 from byteps_tpu.common.hashing import assign_server

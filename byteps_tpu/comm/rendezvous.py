@@ -25,7 +25,7 @@ import pickle
 import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from byteps_tpu.comm.transport import (
     Message,

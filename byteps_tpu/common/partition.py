@@ -17,7 +17,7 @@ from typing import List
 
 import numpy as np
 
-from byteps_tpu.common.types import Partition, align
+from byteps_tpu.common.types import Partition
 from byteps_tpu.common.registry import MAX_PARTS_PER_TENSOR, TensorContext
 
 

@@ -12,8 +12,6 @@ mxnet/compression.py): none / fp16 (bf16 here — the TPU-native 16-bit).
 from __future__ import annotations
 
 import abc
-from typing import Dict, Optional, Tuple
-
 import numpy as np
 
 

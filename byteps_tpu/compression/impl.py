@@ -10,8 +10,6 @@ Wire formats (little-endian, defined in byteps_tpu/native/compressor.cc):
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from byteps_tpu.compression.base import Compressor

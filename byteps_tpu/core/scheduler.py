@@ -45,10 +45,13 @@ class ScheduledQueue:
         self._ready_table = table
 
     def add_task(self, task: TensorTableEntry) -> None:
+        import bisect
+
         with self._cv:
-            self._tasks.append(task)
-            # (priority desc, key asc) — scheduled_queue.cc:82-102
-            self._tasks.sort(key=lambda t: (-t.priority, t.key))
+            # (priority desc, key asc) — scheduled_queue.cc:82-102;
+            # bisect keeps insertion O(log n) compare + O(n) shift instead
+            # of re-sorting the whole queue per task
+            bisect.insort(self._tasks, task, key=lambda t: (-t.priority, t.key))
             self._cv.notify_all()
 
     def _eligible(self, task: TensorTableEntry) -> bool:

@@ -10,10 +10,10 @@ it selects which host loops exist based on role and distributed-ness.
 from __future__ import annotations
 
 import threading
-from typing import Any, Optional
+from typing import Optional
 
 from byteps_tpu.common.config import Config, get_config, reset_config
-from byteps_tpu.common.registry import TensorRegistry, get_registry, reset_registry
+from byteps_tpu.common.registry import TensorRegistry, get_registry
 from byteps_tpu.core.handle_manager import HandleManager
 
 

@@ -17,7 +17,7 @@ never wait on back-layer gradients.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterable, Optional
+from typing import Dict
 
 import numpy as np
 

@@ -15,8 +15,7 @@ build the two pieces worth owning are:
 from __future__ import annotations
 
 import collections
-import itertools
-from typing import Any, Callable, Iterable, Iterator, Optional
+from typing import Any, Iterable, Iterator, Optional
 
 import jax
 import numpy as np

@@ -12,9 +12,8 @@ surface:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Callable
 
-import jax
 import optax
 
 from byteps_tpu.api import broadcast_parameters  # noqa: F401 (re-export)

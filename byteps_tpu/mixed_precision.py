@@ -17,7 +17,7 @@ fp16 parity and for extremely deep models.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -21,16 +21,15 @@ surfaces:
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from byteps_tpu.comm.mesh import DP_AXIS, FSDP_AXIS, get_global_mesh
+from byteps_tpu.comm.mesh import DP_AXIS, get_global_mesh
 
 
 def allreduce_gradients(

@@ -11,7 +11,7 @@ Two layers, matching SURVEY §5.1's split:
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+from typing import Iterator
 
 
 @contextlib.contextmanager

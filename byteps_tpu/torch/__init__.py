@@ -20,8 +20,6 @@ TPU-native compute path remains JAX.
 
 from __future__ import annotations
 
-import io
-import pickle
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 import numpy as np
