@@ -12,6 +12,12 @@ import sys as _sys
 
 _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
 
+if _os.environ.get("JAX_PLATFORMS"):  # make the platform choice stick even
+    import jax as _jax                 # when a plugin preregisters itself
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+
 import numpy as np
 
 import byteps_tpu as bps
