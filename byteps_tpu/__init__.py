@@ -46,6 +46,25 @@ from byteps_tpu.optim import DistributedOptimizer, distributed_optimizer
 
 __version__ = "0.1.0"
 
+_SUBMODULES = (
+    "api", "optim", "checkpoint", "callbacks", "cross_barrier", "data",
+    "mixed_precision", "profiler", "compression", "models", "ops",
+    "parallel", "comm", "core", "common", "server", "launcher", "native",
+    "haiku_plugin",
+)
+
+
+def __getattr__(name: str):
+    """Lazy submodule access: ``bps.checkpoint.save(...)`` without an
+    explicit import (heavy deps like orbax/torch load on first touch)."""
+    if name in _SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"byteps_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'byteps_tpu' has no attribute {name!r}")
+
 __all__ = [
     "Config",
     "get_config",
