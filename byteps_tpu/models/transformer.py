@@ -396,18 +396,25 @@ def _local_forward(cfg: TransformerConfig, mesh: Mesh, params, tokens):
 
 
 def _local_loss(cfg: TransformerConfig, mesh: Mesh, params, tokens, targets):
-    """Global mean token cross-entropy, identical on every rank after psums."""
+    """Global mean token cross-entropy, identical on every rank after psums.
+
+    Positions with ``target < 0`` are ignored — that one convention covers
+    BERT-style masked-LM pretraining (loss only on masked positions; the
+    reference's headline benchmark is exactly this workload) and padding.
+    """
     pp = mesh.shape.get("pp", 1)
     logits, aux = _local_forward(cfg, mesh, params, tokens)
     m = logits.shape[0]
     tgt = targets.reshape(m, -1, targets.shape[-1])
+    valid = (tgt >= 0).astype(jnp.float32)
+    safe_tgt = jnp.maximum(tgt, 0)
     logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     gold = jnp.take_along_axis(
-        logits.astype(jnp.float32), tgt[..., None], axis=-1
+        logits.astype(jnp.float32), safe_tgt[..., None], axis=-1
     )[..., 0]
-    token_loss = logz - gold  # (M, Bmb, S_local)
+    token_loss = (logz - gold) * valid  # (M, Bmb, S_local)
     local_sum = jnp.sum(token_loss)
-    local_cnt = jnp.sum(jnp.ones_like(token_loss))
+    local_cnt = jnp.sum(valid)
     # only the last stage holds real logits; the pp-psum picks its value
     # (free no-ops at axis size 1, and they make the loss VMA-invariant
     # over every mesh axis so it is truly replicated)

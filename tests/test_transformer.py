@@ -179,3 +179,50 @@ class TestForward:
         logits = fwd(params, tokens)
         # (M=pp=1 microbatch, B, S, V)
         assert logits.shape == (1, 4, cfg.max_seq, cfg.vocab_size)
+
+
+class TestMaskedLoss:
+    def test_ignore_index_positions_excluded(self):
+        """target < 0 positions (MLM unmasked / padding) must not affect
+        the loss: masking half the targets equals computing the mean over
+        only the kept positions."""
+        cfg = tiny_test()
+        mesh = _mesh()
+        params = shard_params(init_params(cfg), cfg, mesh)
+        import optax
+
+        from byteps_tpu.models.transformer import _local_loss
+        from jax.sharding import PartitionSpec as P
+
+        tokens, targets = _data(cfg, batch=4)
+        t_np = np.asarray(targets)
+        masked = t_np.copy()
+        masked[:, ::2] = -1  # ignore every other position
+
+        def loss_of(tgt):
+            fn = jax.jit(
+                jax.shard_map(
+                    lambda p, tok, tg: _local_loss(cfg, mesh, p, tok, tg),
+                    mesh=mesh,
+                    in_specs=(
+                        __import__("byteps_tpu.models.transformer", fromlist=["param_specs"]).param_specs(cfg),
+                        P("dp", "sp"), P("dp", "sp"),
+                    ),
+                    out_specs=P(),
+                    check_vma=True,
+                )
+            )
+            return fn(params, tokens, jnp.asarray(tgt))
+
+        full = float(loss_of(t_np))
+        half = float(loss_of(masked))
+        # independent check: recompute the expected masked mean from logits
+        fwd = build_forward(cfg, mesh)
+        logits = np.asarray(fwd(params, tokens))[0].astype(np.float64)
+        logz = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) + logits.max(-1).reshape(*logits.shape[:-1])
+        rows = np.take_along_axis(logits, np.maximum(t_np, 0)[..., None], axis=-1)[..., 0]
+        tok_loss = logz - rows
+        keep = masked >= 0
+        expected = tok_loss[keep].mean()
+        np.testing.assert_allclose(half, expected, rtol=1e-4)
+        assert abs(full - half) > 1e-6  # masking actually changes the value
