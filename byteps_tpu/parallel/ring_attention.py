@@ -76,23 +76,36 @@ def ring_attention(
         k_t, v_t, m_acc, l_acc, o_acc = carry
         # the block currently held started t hops upstream
         src_block = (my_block - t) % axis_size
+
+        def attend(operand):
+            k_t, v_t, m_acc, l_acc, o_acc = operand
+            if causal:
+                # src older → full attend; same block → diagonal mask
+                bias = jnp.where(src_block < my_block, 0.0, diag_bias)
+            else:
+                bias = jnp.zeros((s_local, s_local))
+            m_t, l_t, pv_t = _block_attend(q, k_t, v_t, bias)
+            # online-softmax merge of (m_acc, l_acc, o_acc) with block t
+            m_new = jnp.maximum(m_acc, m_t)
+            a = jnp.exp(m_acc - m_new)
+            b = jnp.exp(m_t - m_new)
+            l_new = l_acc * a + l_t * b
+            o_new = o_acc * a[..., None] + pv_t * b[..., None]
+            return m_new, l_new, o_new
+
+        def skip(operand):
+            # fully-masked future block: contributes nothing — skip both
+            # einsums (the block still rotates; downstream devices need it)
+            _, _, m_acc, l_acc, o_acc = operand
+            return m_acc, l_acc, o_acc
+
+        operand = (k_t, v_t, m_acc, l_acc, o_acc)
         if causal:
-            # src older → full attend; same → diagonal; younger → masked
-            full = jnp.zeros((s_local, s_local))
-            none = jnp.full((s_local, s_local), NEG_INF)
-            bias = jnp.where(
-                src_block < my_block, full,
-                jnp.where(src_block == my_block, diag_bias, none),
+            m_new, l_new, o_new = lax.cond(
+                src_block <= my_block, attend, skip, operand
             )
         else:
-            bias = jnp.zeros((s_local, s_local))
-        m_t, l_t, pv_t = _block_attend(q, k_t, v_t, bias)
-        # online-softmax merge of (m_acc, l_acc, o_acc) with block t
-        m_new = jnp.maximum(m_acc, m_t)
-        a = jnp.exp(m_acc - m_new)
-        b = jnp.exp(m_t - m_new)
-        l_new = l_acc * a + l_t * b
-        o_new = o_acc * a[..., None] + pv_t * b[..., None]
+            m_new, l_new, o_new = attend(operand)
         # rotate kv to the next ring position
         k_n = lax.ppermute(k_t, axis_name, perm)
         v_n = lax.ppermute(v_t, axis_name, perm)
