@@ -75,8 +75,12 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "32"))
     seq = int(os.environ.get("BENCH_SEQ", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
+    # measured config: batch 32 fits HBM without remat at 44.5% MFU;
+    # BENCH_REMAT=1 + BENCH_BATCH=64 trades recompute for batch (validate
+    # on hardware before making it the default)
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
 
-    cfg = bert_large(max_seq=seq, compute_dtype=jnp.bfloat16)
+    cfg = bert_large(max_seq=seq, compute_dtype=jnp.bfloat16, remat=remat)
     mesh = make_training_mesh(1, {"dp": 1, "pp": 1, "sp": 1, "tp": 1})
     params = shard_params(init_params(cfg, seed=0, pp_size=1), cfg, mesh)
     tx = optax.adamw(1e-4)
