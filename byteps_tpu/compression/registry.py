@@ -41,6 +41,42 @@ def _parse_k(kwargs: Dict[str, str], size: int) -> int:
     return max(1, int(val))
 
 
+def translate_compression_params(params: Optional[Dict]) -> Dict[str, str]:
+    """User-facing ``compression_params`` dict → byteps_* declare kwargs.
+
+    Same translation the reference's DistributedTrainer performs
+    (mxnet/__init__.py:236-290): {"compressor": "onebit", "ef": "vanilla",
+    "momentum": "nesterov", "k": 0.01, "scaling": True, "seed": 42,
+    "partition": "natural", "normalize": "l2", "momentum_mu": 0.9}.
+    """
+    out: Dict[str, str] = {}
+    if not params:
+        return out
+    if params.get("compressor"):
+        out["byteps_compressor_type"] = str(params["compressor"])
+    if params.get("ef"):
+        out["byteps_ef_type"] = str(params["ef"])
+    if params.get("momentum"):
+        out["byteps_momentum_type"] = str(params["momentum"])
+    if "k" in params:
+        out["byteps_compressor_k"] = str(params["k"])
+    if "scaling" in params:
+        out["byteps_compressor_onebit_scaling"] = str(params["scaling"])
+    if "seed" in params:
+        out["byteps_seed"] = str(params["seed"])
+    if params.get("partition"):
+        out["byteps_dithering_partition"] = (
+            "1" if params["partition"] in ("natural", 1, "1") else "0"
+        )
+    if params.get("normalize"):
+        out["byteps_dithering_normalize"] = (
+            "1" if params["normalize"] in ("l2", 1, "1") else "0"
+        )
+    if "momentum_mu" in params:
+        out["byteps_momentum_mu"] = str(params["momentum_mu"])
+    return out
+
+
 def create_compressor(
     kwargs: Dict[str, str], size: int, server: bool = False
 ) -> Optional[Compressor]:

@@ -107,6 +107,7 @@ class DistributedOptimizer(torch.optim.Optimizer):
         named_parameters: Optional[Iterable[Tuple[str, torch.nn.Parameter]]] = None,
         compression: Any = Compression.none,
         backward_passes_per_step: int = 1,
+        compression_params: Optional[Dict] = None,
     ) -> None:
         self._inner = optimizer
         self.param_groups = optimizer.param_groups
@@ -131,8 +132,13 @@ class DistributedOptimizer(torch.optim.Optimizer):
         dups = len(named) - len({n for n, _ in named})
         if dups:
             raise ValueError("named_parameters contains duplicate names")
+        # level-2 (server-side) compression config, DistributedTrainer-style
+        # (mxnet/__init__.py:236-290): translated to byteps_* declare kwargs
+        from byteps_tpu.compression.registry import translate_compression_params
+
+        kw = translate_compression_params(compression_params)
         for name, p in named:
-            declare_tensor(f"Gradient.{name}")
+            declare_tensor(f"Gradient.{name}", **kw)
             if p.requires_grad:
                 p.register_post_accumulate_grad_hook(self._make_hook())
 

@@ -212,3 +212,37 @@ class TestTorchDDP:
         torch.nn.functional.mse_loss(ddp(x), y).backward()
         ddp.grad_sync()  # second (sync) pass communicates
         bps.shutdown()
+
+
+class TestCompressionParams:
+    def test_translation(self):
+        from byteps_tpu.compression.registry import (
+            create_compressor,
+            translate_compression_params,
+        )
+
+        kw = translate_compression_params(
+            {"compressor": "randomk", "k": 0.1, "ef": "vanilla",
+             "momentum": "nesterov", "momentum_mu": 0.8, "seed": 9}
+        )
+        assert kw["byteps_compressor_type"] == "randomk"
+        assert kw["byteps_compressor_k"] == "0.1"
+        assert kw["byteps_ef_type"] == "vanilla"
+        c = create_compressor(kw, 1000)
+        from byteps_tpu.compression.momentum import NesterovMomentum
+
+        assert isinstance(c, NesterovMomentum) and c.mu == 0.8
+
+    def test_torch_optimizer_declares_compression(self):
+        bps.init()
+        from byteps_tpu.common.registry import get_registry
+
+        m = _model(seed=7)
+        bps.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.1),
+            named_parameters=m.named_parameters(),
+            compression_params={"compressor": "topk", "k": 0.5},
+        )
+        ctx = get_registry().get("Gradient.0.weight")
+        assert ctx.kwargs["byteps_compressor_type"] == "topk"
+        bps.shutdown()
