@@ -143,6 +143,9 @@ class PSServer:
         self.num_workers = cfg.num_worker
         self._sched_conn: Optional[socket.socket] = None
         self._reducer = _make_reducer()
+        import os
+
+        self._debug = os.environ.get("BYTEPS_SERVER_DEBUG", "0") == "1"
 
     # --- lifecycle -------------------------------------------------------
 
@@ -310,6 +313,15 @@ class PSServer:
     def _handle_push(self, msg: Message, conn, send_lock) -> None:
         ks = self._key_state(msg.key)
         rtype, dtype_id = decode_command_type(msg.cmd)
+        if self._debug:
+            # per-request key log (BYTEPS_SERVER_DEBUG, server.cc:120-144)
+            from byteps_tpu.common import logging as bpslog
+
+            bpslog.info(
+                "server push key=%d len=%d v=%d recv=%d/%d",
+                msg.key, len(msg.payload), msg.version, ks.recv_count + 1,
+                self.num_workers,
+            )
         compressed = (
             rtype == RequestType.COMPRESSED_PUSH_PULL and ks.compressor is not None
         )
