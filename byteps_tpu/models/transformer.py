@@ -470,6 +470,33 @@ def build_forward(cfg: TransformerConfig, mesh: Mesh) -> Callable:
     return jax.jit(shmapped)
 
 
+def build_generate(cfg: TransformerConfig, mesh: Mesh) -> Callable:
+    """Greedy decoding: ``generate(params, prompt, n_new) → (B, S0+n_new)``.
+
+    Recompute-based (no KV cache yet): each step runs the cached jitted
+    forward on the fixed ``max_seq`` window — causal masking makes the
+    right-padding inert.  Requires ``cfg.causal``.
+    """
+    if not cfg.causal:
+        raise ValueError("generation requires a causal config")
+    fwd = build_forward(cfg, mesh)
+
+    def generate(params, prompt: np.ndarray, n_new: int) -> np.ndarray:
+        prompt = np.asarray(prompt, dtype=np.int32)
+        b, s0 = prompt.shape
+        if s0 + n_new > cfg.max_seq:
+            raise ValueError(f"{s0}+{n_new} exceeds max_seq {cfg.max_seq}")
+        buf = np.zeros((b, cfg.max_seq), dtype=np.int32)
+        buf[:, :s0] = prompt
+        for i in range(s0, s0 + n_new):
+            logits = fwd(params, jnp.asarray(buf))  # (M, B, S, V)
+            step_logits = np.asarray(logits).reshape(-1, cfg.max_seq, cfg.vocab_size)
+            buf[:, i] = step_logits[:, i - 1, :].argmax(-1)
+        return buf[:, : s0 + n_new]
+
+    return generate
+
+
 def build_train_step(
     cfg: TransformerConfig,
     mesh: Mesh,
