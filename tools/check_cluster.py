@@ -1,0 +1,54 @@
+"""Cluster health CLI: query the scheduler's heartbeat table.
+
+    python tools/check_cluster.py [--uri 127.0.0.1] [--port 9000] \
+        [--dead-after 30]
+
+Prints per-node heartbeat ages (seconds since last message) and exits
+nonzero if any node's age exceeds ``--dead-after`` — pluggable into any
+watchdog/orchestrator (the failure-detection policy layer, SURVEY §5.3).
+"""
+
+import argparse
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from byteps_tpu.comm.transport import Message, Op, connect, recv_message, send_message
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--uri", default=os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"))
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")))
+    ap.add_argument("--dead-after", type=float, default=30.0)
+    args = ap.parse_args()
+
+    try:
+        sock = connect(args.uri, args.port, timeout=5)
+    except OSError as e:
+        print(f"scheduler unreachable at {args.uri}:{args.port}: {e}")
+        return 2
+    send_message(sock, Message(Op.QUERY, seq=1))
+    live = pickle.loads(recv_message(sock).payload)
+    sock.close()
+
+    rc = 0
+    for role in ("worker", "server"):
+        nodes = live.get(role, {})
+        if not nodes:
+            print(f"{role}s: none registered")
+            continue
+        for rank in sorted(nodes):
+            age = nodes[rank]
+            state = "OK" if age <= args.dead_after else "DEAD?"
+            if state != "OK":
+                rc = 1
+            print(f"{role}[{rank}]: last heartbeat {age:6.1f}s ago  {state}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
