@@ -10,13 +10,17 @@ This file must set env before jax is imported anywhere.
 """
 
 import os
+import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # the image presets JAX_PLATFORMS=axon
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_pat = r"--xla_force_host_platform_device_count=\d+"
+_m = re.search(_pat, _flags)
+if _m is None:
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+elif int(_m.group().rsplit("=", 1)[1]) < 8:
+    _flags = re.sub(_pat, "--xla_force_host_platform_device_count=8", _flags)
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 
