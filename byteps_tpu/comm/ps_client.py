@@ -13,7 +13,7 @@ FinishOrProceed.
 
 from __future__ import annotations
 
-import pickle
+import json
 import socket
 import threading
 from typing import Callable, Dict, List, Optional
@@ -25,6 +25,7 @@ from byteps_tpu.comm.rendezvous import GROUP_ALL, GROUP_WORKERS
 from byteps_tpu.comm.transport import (
     Message,
     Op,
+    close_socket,
     connect,
     recv_message,
     send_message,
@@ -53,8 +54,11 @@ class _ServerConn:
 
 
 class PSClient:
-    def __init__(self, cfg: Config) -> None:
+    def __init__(self, cfg: Config, node_uid: Optional[str] = None) -> None:
         self.cfg = cfg
+        from byteps_tpu.common.config import resolve_node_uid
+
+        self.node_uid = resolve_node_uid(node_uid)
         self.rank: Optional[int] = None
         self.num_workers = cfg.num_worker
         self.num_servers = cfg.num_server
@@ -77,10 +81,12 @@ class PSClient:
             self._sched,
             Message(
                 Op.REGISTER,
-                payload=pickle.dumps({"role": "worker", "host": "", "port": 0}),
+                payload=json.dumps(
+                    {"role": "worker", "host": "", "port": 0, "uid": self.node_uid}
+                ).encode(),
             ),
         )
-        book = pickle.loads(recv_message(self._sched).payload)
+        book = json.loads(recv_message(self._sched).payload.decode())
         self.rank = book["rank"]
         self.num_workers = book["num_workers"]
         self.num_servers = book["num_servers"]
@@ -112,15 +118,8 @@ class PSClient:
     def close(self) -> None:
         self._stop.set()
         for sc in self._servers:
-            try:
-                sc.sock.close()
-            except OSError:
-                pass
-        if self._sched is not None:
-            try:
-                self._sched.close()
-            except OSError:
-                pass
+            close_socket(sc.sock)
+        close_socket(self._sched)
         self._servers = []
 
     def _sched_request(self, msg: Message) -> Message:
@@ -144,9 +143,11 @@ class PSClient:
 
     def query_cluster(self) -> dict:
         """Heartbeat ages per node from the scheduler (failure detection,
-        SURVEY §5.3)."""
+        SURVEY §5.3).  JSON wire format stringifies rank keys; restore ints
+        so consumers index by rank."""
         resp = self._sched_request(Message(Op.QUERY))
-        return pickle.loads(resp.payload)
+        raw = json.loads(resp.payload.decode())
+        return {role: {int(r): age for r, age in d.items()} for role, d in raw.items()}
 
     def _heartbeat_loop(self, interval: float) -> None:
         while not self._stop.is_set():

@@ -10,7 +10,15 @@ server.cc:500-509).  Persistent connections then serve BARRIER requests
 
 Elastic rejoin: a REGISTER arriving after the population is full replaces
 the node's previous registration and immediately receives the current
-ADDRBOOK, flagged as recovery (is_recovery(), global.cc:291).
+ADDRBOOK, flagged as recovery (is_recovery(), global.cc:291).  Rejoins are
+matched on a *stable node uid* carried in the REGISTER payload (workers
+register with host=''/port=0, so an address match would alias them all);
+clients persist the uid across suspend/resume.
+
+Control-plane payloads are JSON, not pickle: the scheduler listens on
+0.0.0.0 and must never unpickle attacker-reachable bytes.  Arbitrary
+object transfer stays on the data plane's explicitly documented
+``broadcast_object`` API.
 
 Failure detection (ps-lite heartbeat equivalent, SURVEY §5.3): every
 message from a registered node refreshes its last-seen stamp; nodes ping
@@ -21,10 +29,11 @@ belongs to the monitor consuming the ages.
 
 from __future__ import annotations
 
-import pickle
+import json
 import socket
 import threading
 import time
+from dataclasses import dataclass
 from typing import Any, Dict, List, Tuple
 
 from byteps_tpu.comm.transport import (
@@ -40,6 +49,16 @@ GROUP_SERVERS = 2
 GROUP_ALL = 3
 
 
+@dataclass
+class _Node:
+    rank: int
+    host: str
+    port: int
+    conn: Any
+    send_lock: Any
+    uid: str
+
+
 class Scheduler:
     """Run with role=scheduler (the reference starts it via
     ``import byteps.server`` with DMLC_ROLE=scheduler,
@@ -50,8 +69,7 @@ class Scheduler:
         self.num_servers = num_servers
         self._sock, self.port = listen(host, port)
         self._lock = threading.Lock()
-        # role → list of (rank, host, port, conn, send_lock)
-        self._nodes: Dict[str, List] = {"worker": [], "server": []}
+        self._nodes: Dict[str, List[_Node]] = {"worker": [], "server": []}
         self._addrbook_sent = False
         # (group, barrier_round) → list of (conn, send_lock, seq)
         self._barriers: Dict[Tuple[int, int], List] = {}
@@ -105,7 +123,7 @@ class Scheduler:
                 elif msg.op == Op.QUERY:
                     send_message(
                         conn,
-                        Message(Op.QUERY, seq=msg.seq, payload=pickle.dumps(self.liveness())),
+                        Message(Op.QUERY, seq=msg.seq, payload=json.dumps(self.liveness()).encode()),
                         send_lock,
                     )
                 elif msg.op == Op.SHUTDOWN:
@@ -134,29 +152,54 @@ class Scheduler:
         return out
 
     def _handle_register(self, conn, send_lock, msg: Message) -> None:
-        info = pickle.loads(msg.payload)
+        info = json.loads(msg.payload.decode())
         role = info["role"]
+        # Stable node identity: workers register with host=''/port=0 (they
+        # dial out, they don't listen), so rejoin matching MUST key on the
+        # uid the node persists across suspend/resume — an address match
+        # would alias every worker to the first entry.  Servers without a
+        # uid fall back to their (stable) listen address.
+        uid = info.get("uid") or f"{info['host']}:{info['port']}"
         recovery = False
         with self._lock:
             nodes = self._nodes[role]
-            # elastic rejoin: same role+host+port replaces old entry
-            existing = [
-                n for n in nodes if n[1] == info["host"] and n[2] == info["port"]
-            ]
+            existing = [n for n in nodes if n.uid == uid]
             if existing and self._addrbook_sent:
-                rank = existing[0][0]
-                old_conn = existing[0][3]
+                node = existing[0]
+                rank = node.rank
                 # drop the dead connection's identity so its stray bytes
                 # can't refresh the rejoined node's liveness stamp
-                self._conn_ids.pop(old_conn, None)
-                nodes[nodes.index(existing[0])] = (
-                    rank, info["host"], info["port"], conn, send_lock,
+                self._conn_ids.pop(node.conn, None)
+                nodes[nodes.index(node)] = _Node(
+                    rank, info["host"], info["port"], conn, send_lock, uid
                 )
+                recovery = True
+                self._recovered_conns.add(conn)
+            elif self._addrbook_sent:
+                # Unknown uid joining a full cluster: a process-level restart
+                # lost its uuid (BYTEPS_NODE_UID unset).  Adopt a dead
+                # member's slot when one exists; otherwise append a fresh
+                # rank.  Either way reply immediately — a registrant must
+                # never be left hanging with no ADDRBOOK.
+                dead = [n for n in nodes if n.conn not in self._conn_ids]
+                if dead:
+                    node = dead[0]
+                    rank = node.rank
+                    nodes[nodes.index(node)] = _Node(
+                        rank, info["host"], info["port"], conn, send_lock, uid
+                    )
+                else:
+                    rank = len(nodes)
+                    nodes.append(
+                        _Node(rank, info["host"], info["port"], conn, send_lock, uid)
+                    )
                 recovery = True
                 self._recovered_conns.add(conn)
             else:
                 rank = len(nodes)
-                nodes.append((rank, info["host"], info["port"], conn, send_lock))
+                nodes.append(
+                    _Node(rank, info["host"], info["port"], conn, send_lock, uid)
+                )
             self._conn_ids[conn] = (role, rank)
             self._last_seen[(role, rank)] = time.monotonic()
             full = (
@@ -169,21 +212,25 @@ class Scheduler:
             if full and not self._addrbook_sent:
                 self._addrbook_sent = True
                 for r in ("worker", "server"):
-                    for nrank, _, _, nconn, nlock in self._nodes[r]:
-                        self._send_addrbook_to(nconn, nlock, r, nrank, 0)
+                    for node in self._nodes[r]:
+                        self._send_addrbook_to(node.conn, node.send_lock, r, node.rank, 0)
 
     def _send_addrbook_to(self, conn, send_lock, role, rank, seq, recovery=False) -> None:
-        servers = sorted(self._nodes["server"], key=lambda n: n[0])
+        servers = sorted(self._nodes["server"], key=lambda n: n.rank)
         book = {
             "role": role,
             "rank": rank,
             "num_workers": self.num_workers,
             "num_servers": self.num_servers,
-            "servers": [(h, p) for _, h, p, _, _ in servers],
+            "servers": [(n.host, n.port) for n in servers],
             "is_recovery": recovery,
         }
         try:
-            send_message(conn, Message(Op.ADDRBOOK, payload=pickle.dumps(book), seq=seq), send_lock)
+            send_message(
+                conn,
+                Message(Op.ADDRBOOK, payload=json.dumps(book).encode(), seq=seq),
+                send_lock,
+            )
         except (ConnectionError, OSError):
             pass
 

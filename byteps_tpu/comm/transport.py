@@ -130,6 +130,26 @@ def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:
     return sock
 
 
+def close_socket(sock: Optional[socket.socket]) -> None:
+    """shutdown(SHUT_RDWR) then close.
+
+    A bare ``close()`` while another thread is blocked in ``recv`` on the
+    same socket does NOT close the fd (CPython defers it until the blocking
+    call returns) — no FIN is sent and the peer never learns we left.
+    ``shutdown`` sends the FIN immediately and wakes the blocked reader.
+    """
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def listen(host: str = "0.0.0.0", port: int = 0) -> Tuple[socket.socket, int]:
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

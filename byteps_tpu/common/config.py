@@ -15,6 +15,15 @@ import os
 from typing import Optional
 
 
+def resolve_node_uid(explicit: Optional[str] = None) -> str:
+    """Stable node identity for scheduler rejoin matching: explicit value
+    (runtime state persists one across suspend/resume) > ``BYTEPS_NODE_UID``
+    env (operator-assigned, survives process restart) > fresh uuid."""
+    import uuid
+
+    return explicit or os.environ.get("BYTEPS_NODE_UID") or uuid.uuid4().hex
+
+
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
     return int(v) if v not in (None, "") else default

@@ -29,6 +29,11 @@ class RuntimeState:
         self.tracer = None  # core.tracing.Tracer
         self.initialized = False
         self.resuming = False
+        # stable across suspend/resume so the scheduler matches the rejoin
+        # to this worker's previous registration (not another live worker's);
+        # resolved lazily at first init so a BYTEPS_NODE_UID set after import
+        # still wins
+        self.node_uid: Optional[str] = None
         self._lock = threading.Lock()
 
 
@@ -76,10 +81,13 @@ def init_state(fresh_env: bool = False) -> RuntimeState:
             # scheduler, learn server addresses) and the staged host engine
             # (the loops the reference starts in BytePSGlobal::Start,
             # global.cc:299-403).
+            from byteps_tpu.common.config import resolve_node_uid
             from byteps_tpu.comm.ps_client import PSClient
             from byteps_tpu.core.engine import PipelineEngine
 
-            st.ps_client = PSClient(cfg)
+            if st.node_uid is None:
+                st.node_uid = resolve_node_uid()
+            st.ps_client = PSClient(cfg, node_uid=st.node_uid)
             st.ps_client.connect()
             st.engine = PipelineEngine(cfg, st.ps_client, st.telemetry, st.tracer)
             st.engine.start()

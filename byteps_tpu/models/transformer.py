@@ -488,9 +488,20 @@ def build_generate(cfg: TransformerConfig, mesh: Mesh) -> Callable:
             raise ValueError(f"{s0}+{n_new} exceeds max_seq {cfg.max_seq}")
         buf = np.zeros((b, cfg.max_seq), dtype=np.int32)
         buf[:, :s0] = prompt
+        dp = mesh.shape.get("dp", 1)
         for i in range(s0, s0 + n_new):
-            logits = fwd(params, jnp.asarray(buf))  # (M, B, S, V)
-            step_logits = np.asarray(logits).reshape(-1, cfg.max_seq, cfg.vocab_size)
+            logits = fwd(params, jnp.asarray(buf))  # (M, dp*Bmb, S, V)
+            arr = np.asarray(logits)
+            m, g, s, v = arr.shape
+            # Undo the assembly permutation: dim 1 is dp-shard-major while
+            # input rows are dp-major with each shard's rows split across
+            # the M microbatches — (M, dp, Bmb) must come back together as
+            # (dp, M, Bmb) to restore input batch order.
+            step_logits = (
+                arr.reshape(m, dp, g // dp, s, v)
+                .transpose(1, 0, 2, 3, 4)
+                .reshape(-1, s, v)
+            )
             buf[:, i] = step_logits[:, i - 1, :].argmax(-1)
         return buf[:, : s0 + n_new]
 

@@ -24,13 +24,19 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def _try_build() -> None:
+    """Run make under a file lock: many worker processes import this module
+    concurrently on a fresh checkout, and only one should compile."""
     try:
-        subprocess.run(
-            ["make", "-C", _DIR, "-s"],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        import fcntl
+
+        with open(os.path.join(_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            subprocess.run(
+                ["make", "-C", _DIR, "-s"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
     except Exception:
         pass
 
@@ -83,11 +89,16 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None:
         return _lib
     autobuild = os.environ.get("BYTEPS_NATIVE_AUTOBUILD", "1") != "0"
-    if not os.path.exists(_LIB_PATH) and autobuild:
+    if autobuild:
+        # the .so is not committed (build artifact); make is a fast no-op
+        # when sources are unchanged and rebuilds on .cc edits
         _try_build()
     if not os.path.exists(_LIB_PATH):
         return None
-    lib = ctypes.CDLL(_LIB_PATH)
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None  # corrupt/partial .so → pure-Python fallbacks
     if not hasattr(lib, "bps_native_server_start") and autobuild:
         # stale library from before ps_server.cc existed: rebuild, then
         # load via a temp COPY — dlopen dedups by path/inode, so reloading

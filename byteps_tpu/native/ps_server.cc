@@ -111,6 +111,17 @@ struct Codec {
   bool has_ef = false;
   std::vector<float> error;  // ef residual
 
+  // Wire-size validation: the onebit/dithering decoders read a fixed
+  // n-derived byte count, so a short payload would be an out-of-bounds
+  // heap read.  Reject before any codec touches the bytes (the dense path
+  // is clamped; this is the compressed equivalent).
+  bool wire_ok(int64_t len) const {
+    if (type == "onebit") return len == bps_onebit_size(n);
+    if (type == "topk" || type == "randomk")
+      return len % 8 == 0 && len / 8 <= (k > 0 ? k : n);
+    return len == bps_dithering_size(n);  // dithering
+  }
+
   void decompress(const uint8_t* in, int64_t len, float* out) const {
     if (type == "onebit") {
       bps_onebit_decompress(in, n, out);
@@ -469,6 +480,9 @@ class NativeServer {
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) return false;  // push before init → drop conn
       bool compressed = (rtype == 2) && ks.codec != nullptr;
+      // malformed compressed payload → drop conn (mirrors malformed-init)
+      if (compressed && !ks.codec->wire_ok((int64_t)payload.size()))
+        return false;
       float* accf = (float*)ks.accum.data();
       // clamp to the allocated buffer: a payload larger than the declared
       // size (client skew) must never write out of bounds

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import pickle
+import json
 import socket
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -44,6 +44,7 @@ from byteps_tpu.common.types import (
 from byteps_tpu.comm.transport import (
     Message,
     Op,
+    close_socket,
     connect,
     listen,
     recv_message,
@@ -145,7 +146,12 @@ class PSServer:
         self._reducer = _make_reducer()
         import os
 
+        from byteps_tpu.common.config import resolve_node_uid
+
         self._debug = os.environ.get("BYTEPS_SERVER_DEBUG", "0") == "1"
+        # stable identity for scheduler rejoin matching (the listen address
+        # is also stable, but a restarted server gets a fresh ephemeral port)
+        self.node_uid = resolve_node_uid()
 
     # --- lifecycle -------------------------------------------------------
 
@@ -164,12 +170,11 @@ class PSServer:
 
     def stop(self) -> None:
         self._stop.set()
-        for sock in (self._sock, self._sched_conn):
-            if sock is not None:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+        try:
+            self._sock.close()  # listener: no peer to FIN
+        except OSError:
+            pass
+        close_socket(self._sched_conn)
 
     def _register_with_scheduler(self) -> None:
         """ps::StartPS + barrier equivalent (server.cc:500-509)."""
@@ -179,12 +184,17 @@ class PSServer:
             conn,
             Message(
                 Op.REGISTER,
-                payload=pickle.dumps(
-                    {"role": "server", "host": self.host, "port": self.port}
-                ),
+                payload=json.dumps(
+                    {
+                        "role": "server",
+                        "host": self.host,
+                        "port": self.port,
+                        "uid": self.node_uid,
+                    }
+                ).encode(),
             ),
         )
-        book = pickle.loads(recv_message(conn).payload)
+        book = json.loads(recv_message(conn).payload.decode())
         self.rank = book["rank"]
         self.num_workers = book["num_workers"]
         # global barrier before serving (server.cc:506)
@@ -287,6 +297,23 @@ class PSServer:
                 elif msg.op == Op.PULL:
                     self._handle_pull(msg, conn, send_lock)
             except (ConnectionError, OSError):
+                continue
+            except Exception as e:  # noqa: BLE001
+                # A malformed request (truncated compressed payload, skewed
+                # dtype, out-of-range topk index, …) must never kill the
+                # engine thread — every key pinned to it would stop being
+                # served.  Drop the offending connection, mirroring the
+                # native server's malformed-payload handling.
+                from byteps_tpu.common import logging as bpslog
+
+                bpslog.warning(
+                    "dropping connection after malformed request key=%d op=%d: %r",
+                    msg.key, int(msg.op), e,
+                )
+                try:
+                    conn.close()
+                except OSError:
+                    pass
                 continue
 
     def _handle_init(self, msg: Message, conn, send_lock) -> None:
@@ -429,6 +456,9 @@ class NativePSServer:
         self.num_workers = cfg.num_worker
         self._stop = threading.Event()
         self._sched_conn: Optional[socket.socket] = None
+        from byteps_tpu.common.config import resolve_node_uid
+
+        self.node_uid = resolve_node_uid()
 
     def start(self, register: bool = True) -> None:
         if register:
@@ -441,11 +471,7 @@ class NativePSServer:
     def stop(self) -> None:
         self._stop.set()
         self._lib.bps_native_server_stop()
-        if self._sched_conn is not None:
-            try:
-                self._sched_conn.close()
-            except OSError:
-                pass
+        close_socket(self._sched_conn)
 
 
 def _make_reducer():

@@ -74,3 +74,122 @@ class TestElasticAgainstLiveCluster:
         live = get_state().ps_client.query_cluster()
         assert live["worker"][0] < 5.0  # fresh stamp from the new connection
         bps.shutdown()
+
+
+class TestMultiWorkerRejoinIdentity:
+    def test_rejoin_matches_by_node_uid_not_address(self):
+        """Workers register with host=''/port=0; a rejoin must be matched to
+        the SAME worker's previous registration (by its persisted node uid),
+        never aliased onto another live worker (round-1 advisory:
+        rendezvous matched on (host, port), handing every rejoiner the
+        first worker's rank)."""
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.server.server import PSServer
+
+        sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
+        sched.start()
+        env = {
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "1",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+        }
+        import os
+
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            cfg = Config.from_env()
+            srv = PSServer(cfg)
+            threading.Thread(target=srv.start, daemon=True).start()
+
+            w0 = PSClient(cfg, node_uid="uid-w0")
+            w1 = PSClient(cfg, node_uid="uid-w1")
+            t0 = threading.Thread(target=w0.connect, daemon=True)
+            t0.start()
+            w1.connect()
+            t0.join(10)
+            ranks = {w0.node_uid: w0.rank, w1.node_uid: w1.rank}
+            assert sorted(ranks.values()) == [0, 1]
+
+            # w1 dies and rejoins with the same uid → must get ITS rank back
+            w1_rank = ranks["uid-w1"]
+            w1.close()
+            w1b = PSClient(cfg, node_uid="uid-w1")
+            w1b.connect()
+            assert w1b.rank == w1_rank
+            assert w1b.is_recovery
+
+            # w0 (still live) keeps a fresh liveness stamp under its own rank
+            live = w1b.query_cluster()
+            assert set(live["worker"]) == {0, 1}
+
+            # an unknown uid after the book is full is NOT a recovery match
+            # for an existing entry — it must not steal w0's rank
+            w0.close()
+            w0b = PSClient(cfg, node_uid="uid-w0")
+            w0b.connect()
+            assert w0b.rank == ranks["uid-w0"]
+            w0b.close()
+            w1b.close()
+            srv.stop()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        sched.stop()
+
+    def test_unknown_uid_restart_adopts_dead_slot(self):
+        """A restarted process that lost its uuid (BYTEPS_NODE_UID unset)
+        must adopt a dead member's slot — and must never be left hanging
+        with no ADDRBOOK reply."""
+        import os
+        import time
+
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.server.server import PSServer
+
+        sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
+        sched.start()
+        env = {
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "1",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+        }
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            cfg = Config.from_env()
+            srv = PSServer(cfg)
+            threading.Thread(target=srv.start, daemon=True).start()
+            w0 = PSClient(cfg, node_uid="alpha")
+            w1 = PSClient(cfg, node_uid="beta")
+            t0 = threading.Thread(target=w0.connect, daemon=True)
+            t0.start()
+            w1.connect()
+            t0.join(10)
+            beta_rank = w1.rank
+            w1.close()  # shutdown() sends FIN so the scheduler notices
+            time.sleep(0.5)
+            w_new = PSClient(cfg)  # fresh random uid
+            done = threading.Event()
+            threading.Thread(
+                target=lambda: (w_new.connect(), done.set()), daemon=True
+            ).start()
+            assert done.wait(10), "unknown-uid register hung (no ADDRBOOK)"
+            assert w_new.rank == beta_rank and w_new.is_recovery
+            w0.close()
+            w_new.close()
+            srv.stop()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        sched.stop()

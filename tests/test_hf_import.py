@@ -79,3 +79,27 @@ class TestGeneration:
                 pad_token_id=0,
             ).numpy()
         np.testing.assert_array_equal(ours, theirs.astype(np.int32))
+
+    def test_greedy_dp2_pp2_batch_order(self, gpt2_small):
+        """dp>1 together with pipeline microbatches permutes the assembled
+        logits batch dim; generate must undo it — regression for greedy
+        tokens landing in the wrong batch rows (round-1 advisory)."""
+        from byteps_tpu.models.transformer import build_generate
+
+        cfg, params_np = load_gpt2_weights(gpt2_small, pp_size=2)
+        mesh = make_training_mesh(4, {"dp": 2, "pp": 2, "sp": 1, "tp": 1})
+        params = shard_params(params_np, cfg, mesh)
+        gen = build_generate(cfg, mesh)
+
+        # 4 DISTINCT prompts: any batch-row permutation changes the output
+        prompt = np.array(
+            [[5, 17, 42, 7], [9, 3, 88, 21], [1, 2, 3, 4], [60, 61, 62, 63]],
+            dtype=np.int32,
+        )
+        ours = gen(params, prompt, n_new=6)
+        with torch.no_grad():
+            theirs = gpt2_small.generate(
+                torch.from_numpy(prompt.astype(np.int64)),
+                max_new_tokens=6, do_sample=False, pad_token_id=0,
+            ).numpy()
+        np.testing.assert_array_equal(ours, theirs.astype(np.int32))

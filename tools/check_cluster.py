@@ -9,8 +9,8 @@ watchdog/orchestrator (the failure-detection policy layer, SURVEY §5.3).
 """
 
 import argparse
+import json
 import os
-import pickle
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
@@ -32,7 +32,8 @@ def main() -> int:
         print(f"scheduler unreachable at {args.uri}:{args.port}: {e}")
         return 2
     send_message(sock, Message(Op.QUERY, seq=1))
-    live = pickle.loads(recv_message(sock).payload)
+    raw = json.loads(recv_message(sock).payload.decode())
+    live = {role: {int(r): age for r, age in d.items()} for role, d in raw.items()}
     sock.close()
 
     rc = 0
