@@ -1,0 +1,114 @@
+"""Keras plugin — wraps the TF plugin for keras-native workflows.
+
+Parity surface with byteps/keras/__init__.py:32-128 + _keras/__init__.py:
+``DistributedOptimizer``, ``broadcast_global_variables``, ``push_pull``,
+``broadcast``, and ``load_model`` (re-wrapping the saved optimizer so its
+state continues training distributed, keras/__init__.py:94-128).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import keras
+import numpy as np
+import tensorflow as tf
+
+from byteps_tpu.api import (  # noqa: F401
+    init,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from byteps_tpu.tensorflow import Compression  # noqa: F401
+from byteps_tpu.tensorflow import DistributedOptimizer as _tf_distributed_optimizer
+from byteps_tpu.tensorflow import broadcast as _tf_broadcast
+from byteps_tpu.tensorflow import push_pull as _tf_push_pull
+from byteps_tpu.keras import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(
+    optimizer,
+    name: Optional[str] = None,
+    compression=Compression.none,
+    scope: str = "opt",
+):
+    """Keras optimizer wrap (keras/__init__.py:32-57)."""
+    return _tf_distributed_optimizer(
+        optimizer, name=name, compression=compression, scope=scope
+    )
+
+
+def push_pull(value, name: Optional[str] = None, average: bool = True):
+    """Reduce a tensor-compatible value across workers
+    (keras/__init__.py:68-79)."""
+    t = tf.constant(np.asarray(value))
+    return np.asarray(_tf_push_pull(t, average=average, name=name))
+
+
+def broadcast(value, root_rank: int = 0, name: Optional[str] = None):
+    """Root's value everywhere (keras/__init__.py:82-93)."""
+    t = tf.constant(np.asarray(value))
+    return np.asarray(_tf_broadcast(t, root_rank, name=name))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """Deprecated graph-mode API; in Keras 3 use
+    ``callbacks.BroadcastGlobalVariablesCallback`` (the reference
+    deprecates it the same way for TF2, tensorflow/__init__.py:95-110)."""
+    raise RuntimeError(
+        "broadcast_global_variables() requires graph-mode sessions; with "
+        "Keras 3 use byteps_tpu.keras.callbacks.BroadcastGlobalVariablesCallback"
+    )
+
+
+def load_model(
+    filepath,
+    custom_optimizers=None,
+    custom_objects=None,
+    compression=Compression.none,
+):
+    """Load a saved Keras model with its optimizer re-wrapped as a
+    DistributedOptimizer (keras/__init__.py:94-128).
+
+    The saved config names the plain optimizer class (the wrapper reuses
+    the wrapped class's name exactly so models saved with byteps restore
+    without it); here we inject custom_objects mapping those names back to
+    wrapping factories.
+    """
+
+    import os
+
+    from byteps_tpu.tensorflow import Average, _wrap_keras_optimizer_class
+
+    enable_async = int(os.getenv("BYTEPS_ENABLE_ASYNC", "0")) != 0
+
+    def wrap_optimizer(cls):
+        # Keras 3 deserialization instantiates via cls.from_config, so the
+        # custom object must be an Optimizer CLASS — hand it the wrapped
+        # subclass (same name as the original, from_config inherited).
+        return _wrap_keras_optimizer_class(
+            cls, compression, Average, "opt", enable_async
+        )
+
+    byteps_objects = {}
+    for attr in dir(keras.optimizers):
+        obj = getattr(keras.optimizers, attr)
+        if (
+            isinstance(obj, type)
+            and issubclass(obj, keras.optimizers.Optimizer)
+            and obj is not keras.optimizers.Optimizer
+            and obj.__name__ not in byteps_objects
+        ):
+            wrapped = wrap_optimizer(obj)
+            byteps_objects[obj.__name__] = wrapped
+            byteps_objects[obj.__name__.lower()] = wrapped
+    if custom_optimizers is not None:
+        byteps_objects.update(
+            {cls.__name__: wrap_optimizer(cls) for cls in custom_optimizers}
+        )
+    if custom_objects is not None:
+        byteps_objects.update(custom_objects)
+    return keras.models.load_model(filepath, custom_objects=byteps_objects)

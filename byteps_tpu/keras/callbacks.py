@@ -1,0 +1,159 @@
+"""Keras-3-native callbacks (byteps/_keras/callbacks.py:23-195 parity).
+
+- :class:`BroadcastGlobalVariablesCallback` — one-shot model+optimizer
+  variable sync from root at train start.
+- :class:`MetricAverageCallback` — average epoch metrics across workers.
+- :class:`LearningRateScheduleCallback` / :class:`LearningRateWarmupCallback`
+  — multiplier schedules and size-aware gradual warmup.
+
+These subclass ``keras.callbacks.Callback`` so they drop straight into
+``model.fit(callbacks=[...])``; the JAX-loop equivalents live in
+:mod:`byteps_tpu.callbacks`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import keras
+import numpy as np
+
+import byteps_tpu.tensorflow as bps
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast model + optimizer variables from root once, at the end of
+    the first batch (after variables exist — the reference broadcasts
+    on_batch_end for the same reason, _keras/callbacks.py:31-49)."""
+
+    def __init__(self, root_rank: int = 0) -> None:
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done or bps.size() <= 1:
+            return
+        bps.broadcast_variables(self.model.weights, root_rank=self.root_rank)
+        if getattr(self.model, "optimizer", None) is not None:
+            bps.broadcast_variables(
+                self.model.optimizer.variables, root_rank=self.root_rank
+            )
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average logged metrics across workers at epoch end
+    (_keras/callbacks.py:51-106): with one worker a no-op; metrics are
+    reduced sorted-by-name so every worker issues the same op order."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or bps.size() <= 1:
+            return
+        import tensorflow as tf
+
+        for metric in sorted(logs):
+            value = logs[metric]
+            if isinstance(value, (int, float, np.floating)):
+                logs[metric] = float(
+                    np.asarray(
+                        bps.push_pull(
+                            tf.constant(float(value), dtype=tf.float64),
+                            name=f"Metric.{metric}",
+                            average=True,
+                        )
+                    )
+                )
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """lr(epoch) = initial_lr * multiplier(epoch) on
+    [start_epoch, end_epoch) (_keras/callbacks.py:108-159)."""
+
+    def __init__(
+        self,
+        initial_lr: float,
+        multiplier,
+        start_epoch: int = 0,
+        end_epoch: Optional[int] = None,
+        staircase: bool = True,
+        steps_per_epoch: Optional[int] = None,
+    ) -> None:
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if callable(multiplier):
+            self._fn = multiplier
+        else:
+            self._fn = lambda e: float(multiplier)
+
+    def _lr(self, epoch: float) -> Optional[float]:
+        if epoch < self.start_epoch:
+            return None
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return None
+        e = math.floor(epoch) if self.staircase else epoch
+        return self.initial_lr * self._fn(e - self.start_epoch)
+
+    def _set_lr(self, lr: float) -> None:
+        self.model.optimizer.learning_rate.assign(lr)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase or self.steps_per_epoch is None:
+            lr = self._lr(epoch)
+            if lr is not None:
+                self._set_lr(lr)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase and self.steps_per_epoch is not None:
+            lr = self._lr(self.current_epoch + batch / self.steps_per_epoch)
+            if lr is not None:
+                self._set_lr(lr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = float(
+                np.asarray(self.model.optimizer.learning_rate)
+            )
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr/size to lr over ``warmup_epochs``
+    (_keras/callbacks.py:161-195, the Goyal et al. recipe)."""
+
+    def __init__(
+        self,
+        initial_lr: float,
+        warmup_epochs: int = 5,
+        momentum_correction: bool = False,
+        steps_per_epoch: Optional[int] = None,
+        verbose: int = 0,
+    ) -> None:
+        if momentum_correction:
+            raise NotImplementedError(
+                "momentum_correction: rescale optimizer momentum manually "
+                "(m' = m * lr_new/lr_old per adjustment, as the reference does)"
+            )
+        self.warmup_epochs = warmup_epochs
+
+        def mult(e: float) -> float:
+            if warmup_epochs <= 0:
+                return 1.0
+            frac = min(1.0, (e + 1) / warmup_epochs)
+            base = 1.0 / max(1, bps.size())
+            return base + (1.0 - base) * frac
+
+        super().__init__(
+            initial_lr,
+            mult,
+            start_epoch=0,
+            end_epoch=warmup_epochs,
+            staircase=False,
+            steps_per_epoch=steps_per_epoch,
+        )

@@ -1,0 +1,51 @@
+"""Level-1 (intra-node, framework-side) gradient compression for the TF
+plugin — parity with byteps/tensorflow/compression.py: ``Compression.none``
+and ``Compression.fp16`` (cast floating grads to fp16 for the wire, cast
+back after aggregation)."""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface: compress(tensor) -> (tensor, ctx); decompress(tensor, ctx)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, tensor.dtype
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and ctx.is_floating and tensor.dtype != ctx:
+            return tf.cast(tensor, ctx)
+        return tensor
+
+
+class Compression:
+    """Selector, mirroring the reference's class-attribute style."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

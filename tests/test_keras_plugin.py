@@ -1,0 +1,108 @@
+"""Keras plugin tests (byteps/keras + _keras parity): optimizer wrap,
+save/load_model round-trip re-wrapping the optimizer, and callbacks —
+the reference's tests/test_tensorflow_keras.py translated to Keras 3."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+import byteps_tpu.keras as bps_keras
+
+
+def _model(seed=0):
+    init = keras.initializers.GlorotUniform(seed=seed)
+    return keras.Sequential(
+        [
+            keras.layers.Input((8,)),
+            keras.layers.Dense(16, activation="relu", kernel_initializer=init),
+            keras.layers.Dense(1, kernel_initializer=init),
+        ]
+    )
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.standard_normal((64, 8)).astype(np.float32),
+        rng.standard_normal((64, 1)).astype(np.float32),
+    )
+
+
+class TestKerasValueOps:
+    def test_push_pull_value(self):
+        bps_keras.init()
+        out = bps_keras.push_pull(np.array([2.0, 4.0]), name="k.v", average=True)
+        np.testing.assert_allclose(out, [2.0, 4.0])
+        bps_keras.shutdown()
+
+    def test_broadcast_value(self):
+        bps_keras.init()
+        out = bps_keras.broadcast(np.array([7.0]), root_rank=0, name="k.b")
+        np.testing.assert_allclose(out, [7.0])
+        bps_keras.shutdown()
+
+
+class TestKerasLoadModel:
+    def test_save_load_roundtrip_rewraps_optimizer(self, tmp_path):
+        """Train → save → load_model: the restored optimizer must be the
+        byteps wrapper (same class name as the original, so it also loads
+        WITHOUT byteps) and training must continue (keras/__init__.py:94-128)."""
+        bps_keras.init()
+        x, y = _data(1)
+        m = _model(seed=1)
+        m.compile(
+            optimizer=bps_keras.DistributedOptimizer(keras.optimizers.SGD(0.05)),
+            loss="mse",
+        )
+        m.fit(x, y, epochs=2, batch_size=32, verbose=0)
+        path = str(tmp_path / "model.keras")
+        m.save(path)
+
+        m2 = bps_keras.load_model(path)
+        assert type(m2.optimizer).__name__ == "SGD"
+        assert getattr(type(m2.optimizer), "_byteps_wrapped", False)
+        h = m2.fit(x, y, epochs=2, batch_size=32, verbose=0)
+        assert np.isfinite(h.history["loss"][-1])
+        bps_keras.shutdown()
+
+
+class TestKerasCallbacks:
+    def test_broadcast_and_metric_average_noop_single_worker(self):
+        bps_keras.init()
+        x, y = _data(2)
+        m = _model(seed=2)
+        m.compile(
+            optimizer=bps_keras.DistributedOptimizer(keras.optimizers.SGD(0.05)),
+            loss="mse",
+        )
+        cbs = [
+            bps_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+            bps_keras.callbacks.MetricAverageCallback(),
+        ]
+        h = m.fit(x, y, epochs=2, batch_size=32, verbose=0, callbacks=cbs)
+        assert np.isfinite(h.history["loss"][-1])
+        bps_keras.shutdown()
+
+    def test_warmup_schedule_values(self):
+        bps_keras.init()
+        cb = bps_keras.callbacks.LearningRateWarmupCallback(
+            initial_lr=0.1, warmup_epochs=5
+        )
+        # size()==1 → base=1 → multiplier 1 from the start
+        assert abs(cb._lr(0.0) - 0.1 * (1 / 1 + 0)) < 1e-9
+        bps_keras.shutdown()
+
+    def test_lr_schedule_applied_in_fit(self):
+        bps_keras.init()
+        x, y = _data(3)
+        m = _model(seed=3)
+        m.compile(optimizer=keras.optimizers.SGD(1.0), loss="mse")
+        cb = bps_keras.callbacks.LearningRateScheduleCallback(
+            initial_lr=0.25, multiplier=lambda e: 0.5 ** e
+        )
+        m.fit(x, y, epochs=2, batch_size=32, verbose=0, callbacks=[cb])
+        # epoch 1 (0-based) → 0.25 * 0.5
+        assert abs(float(np.asarray(m.optimizer.learning_rate)) - 0.125) < 1e-6
+        bps_keras.shutdown()

@@ -1,0 +1,146 @@
+"""TensorFlow plugin tests (byteps/tensorflow parity surface).
+
+Single-worker semantics: push_pull = identity, so DistributedOptimizer /
+DistributedGradientTape must train exactly like their bare equivalents —
+the reference's test pattern (tests/test_tensorflow_keras.py) with the
+torch-plugin test structure."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import byteps_tpu.tensorflow as bps
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    y = rng.standard_normal((64, 1)).astype(np.float32)
+    return tf.constant(x), tf.constant(y)
+
+
+def _model(seed=0):
+    init = tf.keras.initializers.GlorotUniform(seed=seed)
+    return tf.keras.Sequential(
+        [
+            tf.keras.layers.Dense(16, activation="relu", kernel_initializer=init),
+            tf.keras.layers.Dense(1, kernel_initializer=init),
+        ]
+    )
+
+
+class TestTFPushPull:
+    def test_identity_eager(self):
+        bps.init()
+        t = tf.range(10, dtype=tf.float32)
+        out = bps.push_pull(t, name="tf.t")
+        np.testing.assert_allclose(np.asarray(out), np.arange(10, dtype=np.float32))
+        bps.shutdown()
+
+    def test_inside_tf_function(self):
+        bps.init()
+
+        @tf.function
+        def fn(x):
+            return bps.push_pull(x, name="tf.fn")
+
+        out = fn(tf.ones(4))
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+        bps.shutdown()
+
+    def test_gradient_flows_through(self):
+        """The registered gradient of push_pull is push_pull of the grad
+        (ops.py:136-146): d/dx sum(push_pull(x)) == ones (1 worker)."""
+        bps.init()
+        x = tf.Variable(tf.ones(5))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(bps.push_pull(x, name="tf.grad", average=False))
+        g = tape.gradient(y, x)
+        np.testing.assert_allclose(np.asarray(g), 1.0)
+        bps.shutdown()
+
+    def test_fp16_compression_roundtrip(self):
+        bps.init()
+        t = tf.constant([1.0, 2.5, -3.25], dtype=tf.float32)
+        out = bps.push_pull(t, name="tf.fp16", compression=bps.Compression.fp16)
+        assert out.dtype == tf.float32
+        np.testing.assert_allclose(np.asarray(out), [1.0, 2.5, -3.25])
+        bps.shutdown()
+
+    def test_broadcast_identity_single(self):
+        bps.init()
+        t = tf.constant([3.0, 4.0])
+        out = bps.broadcast(t, root_rank=0, name="tf.b")
+        np.testing.assert_allclose(np.asarray(out), [3.0, 4.0])
+        bps.shutdown()
+
+
+class TestTFDistributedGradientTape:
+    def test_matches_bare_tape(self):
+        bps.init()
+        x, y = _data()
+        m = _model(seed=1)
+        m.build((None, 8))
+        with tf.GradientTape() as bare:
+            loss1 = tf.reduce_mean((m(x) - y) ** 2)
+        g1 = bare.gradient(loss1, m.trainable_variables)
+
+        dtape = bps.DistributedGradientTape(tf.GradientTape())
+        with dtape:
+            loss2 = tf.reduce_mean((m(x) - y) ** 2)
+        g2 = dtape.gradient(loss2, m.trainable_variables)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        bps.shutdown()
+
+
+class TestTFDistributedOptimizer:
+    def test_matches_bare_optimizer(self):
+        bps.init()
+        x, y = _data(2)
+        m1, m2 = _model(seed=2), _model(seed=2)
+        m1.build((None, 8))
+        m2.build((None, 8))
+        for v1, v2 in zip(m2.weights, m1.weights):
+            v1.assign(v2)
+
+        opt_ref = tf.keras.optimizers.SGD(0.05)
+        opt_dist = bps.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+        # wrapper keeps the wrapped class's name (load_model contract)
+        assert type(opt_dist).__name__ == "SGD"
+
+        for _ in range(5):
+            with tf.GradientTape() as t1:
+                l1 = tf.reduce_mean((m1(x) - y) ** 2)
+            opt_ref.apply_gradients(
+                zip(t1.gradient(l1, m1.trainable_variables), m1.trainable_variables)
+            )
+            with tf.GradientTape() as t2:
+                l2 = tf.reduce_mean((m2(x) - y) ** 2)
+            opt_dist.apply_gradients(
+                zip(t2.gradient(l2, m2.trainable_variables), m2.trainable_variables)
+            )
+        for p1, p2 in zip(m1.weights, m2.weights):
+            np.testing.assert_allclose(
+                np.asarray(p1), np.asarray(p2), rtol=1e-5, atol=1e-7
+            )
+        bps.shutdown()
+
+    def test_model_fit_trains(self):
+        """End-to-end keras compile/fit with the wrapped optimizer."""
+        bps.init()
+        x, y = _data(3)
+        m = _model(seed=3)
+        m.compile(optimizer=bps.DistributedOptimizer(tf.keras.optimizers.Adam(0.01)),
+                  loss="mse")
+        h = m.fit(np.asarray(x), np.asarray(y), epochs=3, batch_size=16, verbose=0)
+        losses = h.history["loss"]
+        assert losses[-1] < losses[0]
+        bps.shutdown()
+
+    def test_rejects_non_keras_optimizer(self):
+        bps.init()
+        with pytest.raises(ValueError, match="keras optimizer"):
+            bps.DistributedOptimizer(object())
+        bps.shutdown()
