@@ -122,14 +122,18 @@ def push_pull_async(
         # (operations.cc:46-53): identity.
         st.handles.mark_done(handle, tensor)
         return handle
+    # The tensor is handed to the engine UN-materialized: device→host
+    # staging happens per partition on the COPYD2H stage thread, so this
+    # call returns while the device computation producing the gradient may
+    # still be in flight (the reference's ready-event + COPYD2H stream
+    # overlap, core_loops.cc:378-443).
     st.engine.submit(
         name=name,
-        tensor=_to_numpy(tensor),
+        tensor=tensor,
         average=average,
         priority=priority,
         version=version,
         handle=handle,
-        original=tensor,
     )
     return handle
 

@@ -21,7 +21,7 @@ from typing import Callable, Dict, List, Optional
 from byteps_tpu.common.config import Config
 from byteps_tpu.common.hashing import assign_server
 from byteps_tpu.common.types import RequestType, get_command_type
-from byteps_tpu.comm.rendezvous import GROUP_ALL, GROUP_WORKERS
+from byteps_tpu.comm.rendezvous import GROUP_ALL, GROUP_WORKERS, RESIZE_SEQ
 from byteps_tpu.comm.transport import (
     Message,
     Op,
@@ -51,6 +51,12 @@ class _ServerConn:
     def pop_cb(self, seq: int) -> Optional[Callable[[Message], None]]:
         with self.cb_lock:
             return self.callbacks.pop(seq, None)
+
+    def pop_all(self):
+        with self.cb_lock:
+            cbs = list(self.callbacks.values())
+            self.callbacks.clear()
+            return cbs
 
 
 class PSClient:
@@ -82,11 +88,25 @@ class PSClient:
             Message(
                 Op.REGISTER,
                 payload=json.dumps(
-                    {"role": "worker", "host": "", "port": 0, "uid": self.node_uid}
+                    {
+                        "role": "worker",
+                        "host": "",
+                        "port": 0,
+                        "uid": self.node_uid,
+                        # a re-register after resume(num_workers=±k) carries
+                        # the NEW expected topology — the scheduler adopts it
+                        # (elastic world-size change, operations.cc:96-119)
+                        "num_workers": self.cfg.num_worker,
+                        "num_servers": self.cfg.num_server,
+                    }
                 ).encode(),
             ),
         )
-        book = json.loads(recv_message(self._sched).payload.decode())
+        resp = recv_message(self._sched)
+        if resp.status != 0:
+            err = json.loads(resp.payload.decode()).get("error", "register refused")
+            raise RuntimeError(f"scheduler refused registration: {err}")
+        book = json.loads(resp.payload.decode())
         self.rank = book["rank"]
         self.num_workers = book["num_workers"]
         self.num_servers = book["num_servers"]
@@ -143,11 +163,10 @@ class PSClient:
 
     def query_cluster(self) -> dict:
         """Heartbeat ages per node from the scheduler (failure detection,
-        SURVEY §5.3).  JSON wire format stringifies rank keys; restore ints
-        so consumers index by rank."""
-        resp = self._sched_request(Message(Op.QUERY))
-        raw = json.loads(resp.payload.decode())
-        return {role: {int(r): age for r, age in d.items()} for role, d in raw.items()}
+        SURVEY §5.3)."""
+        from byteps_tpu.comm.transport import decode_liveness
+
+        return decode_liveness(self._sched_request(Message(Op.QUERY)).payload)
 
     def _heartbeat_loop(self, interval: float) -> None:
         while not self._stop.is_set():
@@ -165,6 +184,13 @@ class PSClient:
                     msg = recv_message(self._sched)
                 except (ConnectionError, OSError):
                     return
+                if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
+                    # another worker resized the cluster: adopt the counts
+                    # (averaging and key→server routing read them live)
+                    book = json.loads(msg.payload.decode())
+                    self.num_workers = book["num_workers"]
+                    self.num_servers = book["num_servers"]
+                    continue
                 with self._sched_cb_lock:
                     entry = self._sched_cbs.pop(msg.seq, None)
                 if entry is not None:
@@ -181,14 +207,23 @@ class PSClient:
                 ev.set()
 
     def _recv_loop(self, sc: _ServerConn) -> None:
-        while not self._stop.is_set():
-            try:
-                msg = recv_message(sc.sock)
-            except (ConnectionError, OSError):
-                return
-            cb = sc.pop_cb(msg.seq)
-            if cb is not None:
-                cb(msg)
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_message(sc.sock)
+                except (ConnectionError, OSError):
+                    return
+                cb = sc.pop_cb(msg.seq)
+                if cb is not None:
+                    cb(msg)
+        finally:
+            # a dead server connection must FAIL every pending request
+            # (cb(None)), not leave its callers blocked in synchronize()
+            for cb in sc.pop_all():
+                try:
+                    cb(None)
+                except Exception:  # noqa: BLE001
+                    pass
 
     # --- key routing -----------------------------------------------------
 
@@ -236,11 +271,16 @@ class PSClient:
         version: int,
         cb: Callable[[], None],
         request_type: RequestType = RequestType.DEFAULT_PUSH_PULL,
+        on_error: Optional[Callable[[], None]] = None,
     ) -> None:
         """Async push; ``cb`` fires on server ack (ZPush,
-        core_loops.cc:538-582)."""
+        core_loops.cc:538-582); ``on_error`` fires if the server connection
+        dies before the ack."""
         sc = self._servers[self.server_for(key)]
-        seq = sc.alloc_seq(lambda msg: cb())
+        seq = sc.alloc_seq(
+            lambda msg: cb() if msg is not None
+            else (on_error() if on_error is not None else None)
+        )
         send_message(
             sc.sock,
             Message(
@@ -261,11 +301,16 @@ class PSClient:
         cb: Callable[[bytes], None],
         dtype_id: int = 0,
         request_type: RequestType = RequestType.DEFAULT_PUSH_PULL,
+        on_error: Optional[Callable[[], None]] = None,
     ) -> None:
         """Async pull; ``cb`` receives the aggregated payload (ZPull,
-        core_loops.cc:584-618)."""
+        core_loops.cc:584-618); ``on_error`` fires if the server connection
+        dies before the response."""
         sc = self._servers[self.server_for(key)]
-        seq = sc.alloc_seq(lambda msg: cb(msg.payload))
+        seq = sc.alloc_seq(
+            lambda msg: cb(msg.payload) if msg is not None
+            else (on_error() if on_error is not None else None)
+        )
         send_message(
             sc.sock,
             Message(

@@ -38,6 +38,7 @@ from byteps_tpu.common.types import (
     TensorTableEntry,
     to_datatype,
 )
+from byteps_tpu.core.ready_table import ReadyTable
 from byteps_tpu.core.scheduler import ScheduledQueue
 
 
@@ -104,10 +105,26 @@ class PipelineEngine:
         self._stop = threading.Event()
         credit = cfg.scheduling_credit
         pool = max(1, cfg.threadpool_size)
+        # PUSH round-order gate (the ReadyTable rendezvous of
+        # scheduled_queue.cc:48-79, re-purposed for the single-process TPU
+        # worker): counts[key] = highest round allowed to leave the PUSH
+        # queue.  Concurrent jobs on one name carry caller-chosen
+        # priorities, so without the gate a later round could overtake an
+        # earlier round of the same key — the server aggregates per round
+        # of arrivals, so cross-round interleaving corrupts sums (and a
+        # reordered pair can deadlock: the later round's pull waits on a
+        # round the earlier push never gets to start).  Completions advance
+        # the allowance.
+        self._push_ready = ReadyTable(ready_count=1, name="push")
         self.queues: Dict[QueueType, Any] = {
             QueueType.COPYD2H: ScheduledQueue(QueueType.COPYD2H),
             QueueType.COMPRESS: _StripedStage(QueueType.COMPRESS, pool),
-            QueueType.PUSH: ScheduledQueue(QueueType.PUSH, credit_bytes=credit),
+            QueueType.PUSH: ScheduledQueue(
+                QueueType.PUSH,
+                credit_bytes=credit,
+                ready_table=self._push_ready,
+                version_gated=True,
+            ),
             QueueType.PULL: ScheduledQueue(QueueType.PULL),
             QueueType.DECOMPRESS: _StripedStage(QueueType.DECOMPRESS, pool),
             QueueType.COPYH2D: ScheduledQueue(QueueType.COPYH2D),
@@ -167,6 +184,11 @@ class PipelineEngine:
                 fn(task)
             except Exception as e:  # surface errors on the handle
                 q.report_finish(task)  # return scheduling credits
+                # a failed round never completes, so it can never advance
+                # the key's version allowance itself — advance it here (at
+                # ANY stage) or every later round of the key blocks forever
+                self._push_ready.add_ready_count(task.key)
+                self.queues[QueueType.PUSH].notify()
                 job: _Job = task.context
                 job_status = Status.Aborted(f"{q.queue_type.name}: {e!r}")
                 self._fail_job(job, job_status)
@@ -176,42 +198,54 @@ class PipelineEngine:
     def submit(
         self,
         name: str,
-        tensor: np.ndarray,
+        tensor: Any,
         average: bool,
         priority: int,
         version: int,
         handle: int,
-        original: Any = None,
     ) -> None:
         """EnqueueTensor equivalent (operations.cc:182-281): lazily init the
         tensor (key range + server-side allocation barrier), partition, and
-        drop every partition into the first stage queue."""
-        from byteps_tpu.core.state import get_state
+        drop every partition into the first stage queue.
+
+        ``tensor`` may be a live jax Array: it is NOT materialized here —
+        shape/dtype metadata is enough to partition, and the actual
+        device→host transfer happens per partition on the COPYD2H stage
+        thread (the reference's async COPYD2H stream, core_loops.cc:378-443),
+        so the caller returns while the device is still computing.
+        """
+        import jax
 
         registry = get_registry()
         ctx = registry.declare(name)
-        flat = np.ascontiguousarray(tensor).reshape(-1)
-        dtype_id = int(to_datatype(flat.dtype))
+        is_jax = isinstance(tensor, jax.Array)
+        if is_jax:
+            flat = tensor.reshape(-1)  # device-side metadata op, async
+            np_dtype = np.dtype(flat.dtype)
+        else:
+            flat = np.ascontiguousarray(np.asarray(tensor)).reshape(-1)
+            np_dtype = flat.dtype
+        dtype_id = int(to_datatype(np_dtype))
 
         with self._init_lock:
             if not ctx.initialized:
                 partition_tensor(
-                    ctx, flat.size, flat.itemsize, self.cfg.partition_bytes
+                    ctx, flat.size, np_dtype.itemsize, self.cfg.partition_bytes
                 )
                 for part in ctx.partitions:
                     # blocking init-push doubles as the cross-worker barrier
                     # for the key (operations.cc:283-414)
                     self.client.init_tensor(part.key, part.length, dtype_id)
-                self._maybe_setup_compression(ctx, flat)
+                    self._push_ready.set_ready_count(part.key, 1)  # round 1 free
+                self._maybe_setup_compression(ctx, np_dtype, flat.size * np_dtype.itemsize)
                 ctx.initialized = True
 
         ctx.version += 1
-        result = np.empty_like(flat)
-        is_jax = original is not None and not isinstance(original, np.ndarray)
+        result = np.empty(flat.shape, dtype=np_dtype)
         job = _Job(
             name, ctx, flat, result, dtype_id, average, handle,
             pending=len(ctx.partitions), shape=np.shape(tensor),
-            np_dtype=flat.dtype, is_jax=is_jax, version=ctx.version,
+            np_dtype=np_dtype, is_jax=is_jax, version=ctx.version,
         )
         compressed = ctx.partitions and ctx.partitions[0].key in self._compressors
         stages = self.STAGES_COMPRESSED if compressed else self.STAGES
@@ -229,7 +263,7 @@ class PipelineEngine:
             )
             self.queues[QueueType.COPYD2H].add_task(task)
 
-    def _maybe_setup_compression(self, ctx, flat: np.ndarray) -> None:
+    def _maybe_setup_compression(self, ctx, np_dtype: np.dtype, nbytes: int) -> None:
         """Instantiate per-partition codec chains and ship the config to the
         owning servers (InitTensor's kCompressedPushPull push,
         operations.cc:396-408).  Engages only for fp32 tensors at least
@@ -240,9 +274,9 @@ class PipelineEngine:
             k in ctx.kwargs
             for k in ("byteps_compressor_type", "compressor")
         )
-        if not has_cfg or flat.dtype != np.float32:
+        if not has_cfg or np_dtype != np.float32:
             return
-        if flat.nbytes < self.cfg.min_compress_bytes:
+        if nbytes < self.cfg.min_compress_bytes:
             return
         for part in ctx.partitions:
             codec = create_compressor(ctx.kwargs, part.length, server=False)
@@ -289,6 +323,13 @@ class PipelineEngine:
         if task.queue_list:
             self.queues[task.queue_list[0]].add_task(task)
             return
+        # partition fully round-tripped (push ACKed AND pull answered):
+        # re-arm the key's PUSH gate so the next round may leave.  Re-arming
+        # any earlier would let the server publish round N+1 before this
+        # round's pull was served — the server hands pulls the LATEST
+        # completed round (version <= store_version, server.cc:376-409)
+        self._push_ready.add_ready_count(task.key)
+        self.queues[QueueType.PUSH].notify()
         with job.lock:
             job.pending -= 1
             done = job.pending == 0
@@ -300,6 +341,16 @@ class PipelineEngine:
 
         get_state().handles.mark_done(job.handle, None, status)
 
+    def _abort_task(self, task: TensorTableEntry, stage: QueueType, reason: str) -> None:
+        """Fail a task whose async completion can never arrive (dead server
+        connection): return credits, advance the key's round allowance, and
+        surface the error on the handle — callers must never hang in
+        synchronize() on a dead cluster."""
+        self.queues[stage].report_finish(task)
+        self._push_ready.add_ready_count(task.key)
+        self.queues[QueueType.PUSH].notify()
+        self._fail_job(task.context, Status.Aborted(f"{stage.name}: {reason}"))
+
     def _finalize(self, job: _Job) -> None:
         """All partitions done: average (the plugin-side div by size,
         torch/ops.cc:78-91), reshape, hand back."""
@@ -310,17 +361,25 @@ class PipelineEngine:
             out = out / self.client.num_workers
         out = out.reshape(job.shape)
         if job.is_jax:
-            import jax.numpy as jnp
+            import jax
 
-            out = jnp.asarray(out)
+            # async H2D: device_put returns immediately with the transfer
+            # in flight (the COPYH2D stream, core_loops.cc:650-753); the
+            # caller's next jitted step consumes the Array when ready
+            out = jax.device_put(out)
         get_state().handles.mark_done(job.handle, out)
 
     def _copy_d2h_once(self, task: TensorTableEntry) -> None:
-        """Stage the partition's bytes for the wire (COPYD2H,
-        core_loops.cc:378-443).  Input tensors are already host numpy (the
-        API materializes device arrays); this slices the partition view."""
+        """Per-partition device→host DMA (COPYD2H, core_loops.cc:378-443).
+
+        For jax inputs this is where the transfer actually happens — on
+        THIS stage thread, one partition at a time, so the PUSH thread is
+        already sending early partitions over DCN while later partitions
+        are still coming off the device (and while the caller's next jitted
+        step runs).  numpy inputs take a zero-copy slice view."""
         job: _Job = task.context
-        task.cpubuff = job.flat[task.offset : task.offset + task.length]
+        sl = job.flat[task.offset : task.offset + task.length]
+        task.cpubuff = sl if isinstance(sl, np.ndarray) else np.asarray(sl)
         self._proceed(task)
 
     def _compress_once(self, task: TensorTableEntry) -> None:
@@ -348,6 +407,9 @@ class PipelineEngine:
             task.key, payload, job.dtype_id, task.version,
             cb=lambda: self._proceed(task),
             request_type=rtype,
+            on_error=lambda: self._abort_task(
+                task, QueueType.PUSH, "server connection lost"
+            ),
         )
 
     def _pull_once(self, task: TensorTableEntry) -> None:
@@ -370,6 +432,9 @@ class PipelineEngine:
             task.key, task.version, on_pull, dtype_id=job.dtype_id,
             request_type=RequestType.COMPRESSED_PUSH_PULL
             if compressed else RequestType.DEFAULT_PUSH_PULL,
+            on_error=lambda: self._abort_task(
+                task, QueueType.PULL, "server connection lost"
+            ),
         )
 
     def _decompress_once(self, task: TensorTableEntry) -> None:

@@ -26,6 +26,10 @@ class ReadyTable:
         with self._lock:
             return self._counts.get(key, 0) >= self.ready_count
 
+    def get_count(self, key: int) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
     def add_ready_count(self, key: int, n: int = 1) -> int:
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + n

@@ -31,11 +31,19 @@ class ScheduledQueue:
         credit_bytes: int = 0,
         ready_table: Optional[ReadyTable] = None,
         itemsize: int = 4,
+        version_gated: bool = False,
     ) -> None:
         self.queue_type = queue_type
         self.credit_enabled = credit_bytes > 0
         self._credits = credit_bytes
         self._ready_table = ready_table
+        # version-gated mode: a task is eligible iff its round number is at
+        # or below the table's per-key allowance (counts[key] = highest
+        # version allowed).  Enforces per-key ROUND ORDER, so a later
+        # high-priority round can never overtake an earlier round of the
+        # same key — priority still reorders across keys (the scheduling
+        # win), never within one.
+        self._version_gated = version_gated
         self._itemsize = itemsize
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -57,8 +65,12 @@ class ScheduledQueue:
     def _eligible(self, task: TensorTableEntry) -> bool:
         if self.credit_enabled and task.length * self._itemsize > self._credits:
             return False
-        if self._ready_table is not None and not self._ready_table.is_ready(task.key):
-            return False
+        if self._ready_table is not None:
+            if self._version_gated:
+                if task.version > self._ready_table.get_count(task.key):
+                    return False
+            elif not self._ready_table.is_ready(task.key):
+                return False
         return True
 
     def get_task(self, timeout: Optional[float] = None) -> Optional[TensorTableEntry]:
@@ -76,7 +88,10 @@ class ScheduledQueue:
                 self._tasks.pop(i)
                 if self.credit_enabled:
                     self._credits -= t.length * self._itemsize
-                if self._ready_table is not None:
+                if self._ready_table is not None and not self._version_gated:
+                    # classic rendezvous consumes the accumulated signals
+                    # (scheduled_queue.cc:125-163); the version gate keeps
+                    # its allowance — completions advance it instead
                     self._ready_table.clear_ready_count(t.key)
                 return t
         return None

@@ -44,7 +44,7 @@ def get_state() -> RuntimeState:
     return _state
 
 
-def init_state(fresh_env: bool = False) -> RuntimeState:
+def init_state(fresh_env: bool = True) -> RuntimeState:
     """Bring the process up (global.cc:105-297 + operations.cc:41-88)."""
     import jax
 
@@ -56,6 +56,8 @@ def init_state(fresh_env: bool = False) -> RuntimeState:
     with st._lock:
         if st.initialized:
             return st
+        # byteps_init re-reads env on every (re-)init — elastic resume
+        # rewrites DMLC_* then re-initializes (operations.cc:96-112)
         cfg = reset_config() if fresh_env else get_config()
         st.config = cfg
         st.registry = get_registry()
