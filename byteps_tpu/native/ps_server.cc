@@ -21,8 +21,11 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -31,6 +34,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <tuple>
 #include <vector>
 
 // from reducer.cc / compressor.cc (same shared object)
@@ -226,11 +230,85 @@ std::unique_ptr<Codec> make_codec(const std::map<std::string, std::string>& kw,
 // key state + server
 // ---------------------------------------------------------------------------
 
+// Refcounted connection: the fd is closed only when the LAST holder
+// releases it (serve thread, queued engine tasks, pending pulls, init
+// waiters).  Without this, a disconnect closes the fd while tasks for it
+// are still queued, the kernel recycles the number for the next client,
+// and the engine writes one client's bytes onto another's stream.
+struct Conn {
+  int fd;
+  std::mutex write_mu;
+  explicit Conn(int f) : fd(f) {}
+  ~Conn() { ::close(fd); }
+  Conn(const Conn&) = delete;
+  Conn& operator=(const Conn&) = delete;
+};
+using ConnPtr = std::shared_ptr<Conn>;
+
 struct PendingPull {
   uint32_t version;
-  int fd;
+  ConnPtr conn;
   uint32_t seq;
   bool wants_compressed;
+};
+
+// ---------------------------------------------------------------------------
+// engine queue plane (server.cc:82-202, queue.h:49-97): N engine threads,
+// each owning a priority queue; with BYTEPS_SERVER_ENABLE_SCHEDULE=1 the
+// queue pops the key with the fewest accumulated pushes first
+// (anti-starvation), else FIFO.  Keys pin to one thread (least-loaded
+// cached assignment, server.h:154-178) so per-key processing stays ordered.
+// ---------------------------------------------------------------------------
+
+struct EngineTask {
+  uint8_t op = 0;
+  ConnPtr conn;
+  uint32_t seq = 0;
+  uint64_t key = 0;
+  uint32_t cmd = 0;
+  uint32_t version = 0;
+  std::vector<uint8_t> payload;
+};
+
+class EngineQueue {
+ public:
+  explicit EngineQueue(bool schedule) : schedule_(schedule) {}
+
+  void put(EngineTask&& t, uint64_t prio) {
+    std::lock_guard<std::mutex> g(mu_);
+    items_.push_back({schedule_ ? prio : 0, counter_++, std::move(t)});
+    std::push_heap(items_.begin(), items_.end(), cmp);
+    cv_.notify_one();
+  }
+
+  bool pop(EngineTask* out, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (items_.empty())
+      cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+    if (items_.empty()) return false;
+    std::pop_heap(items_.begin(), items_.end(), cmp);
+    *out = std::move(items_.back().task);
+    items_.pop_back();
+    return true;
+  }
+
+ private:
+  struct Item {
+    uint64_t prio;
+    uint64_t order;
+    EngineTask task;
+  };
+  // comparator "greater" turns std::*_heap into a min-heap: the key with
+  // the FEWEST accumulated pushes is served first (queue.h:49-97); the
+  // order counter keeps same-priority items FIFO
+  static bool cmp(const Item& a, const Item& b) {
+    return std::tie(a.prio, a.order) > std::tie(b.prio, b.order);
+  }
+  bool schedule_;
+  uint64_t counter_ = 0;
+  std::vector<Item> items_;
+  std::mutex mu_;
+  std::condition_variable cv_;
 };
 
 struct KeyState {
@@ -241,18 +319,67 @@ struct KeyState {
   int recv_count = 0;
   uint32_t store_version = 0;
   std::vector<PendingPull> pending;
-  std::vector<std::pair<int, uint32_t>> init_waiters;  // (fd, seq)
+  std::vector<std::pair<ConnPtr, uint32_t>> init_waiters;  // (conn, seq)
   std::unique_ptr<Codec> codec;
   std::vector<uint8_t> pull_payload;
 };
 
 class NativeServer {
  public:
-  void set_num_workers(int n) { num_workers_.store(n); }
+  void set_num_workers(int n) {
+    num_workers_.store(n);
+    if (async_ || n <= 0) return;
+    // elastic scale-down: a round that already holds >= n pushes will
+    // never see the departed workers' contributions — publish it now and
+    // flush its buffered pulls (mirrors the Python server)
+    std::vector<KeyState*> all;
+    {
+      std::lock_guard<std::mutex> g(keys_mu_);
+      for (auto& [k, ks] : keys_) all.push_back(ks.get());
+    }
+    std::map<KeyState*, uint64_t> key_of;
+    {
+      std::lock_guard<std::mutex> g(keys_mu_);
+      for (auto& [k, ks] : keys_) key_of[ks.get()] = k;
+    }
+    for (KeyState* ks : all) {
+      std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>
+          flush;
+      {
+        std::lock_guard<std::mutex> g(ks->mu);
+        if (ks->store.empty() || ks->recv_count < n) continue;
+        ks->store.swap(ks->accum);
+        ks->store_version++;
+        ks->recv_count = 0;
+        if (ks->codec)
+          ks->pull_payload = ks->codec->compress((const float*)ks->store.data());
+        std::vector<PendingPull> still;
+        for (auto& p : ks->pending) {
+          if (p.version <= ks->store_version) {
+            flush.emplace_back(p.conn, p.seq,
+                               wire_payload_locked(*ks, p.wants_compressed),
+                               ks->store_version);
+          } else {
+            still.push_back(p);
+          }
+        }
+        ks->pending.swap(still);
+      }
+      for (auto& [pconn, pseq, data, ver] : flush)
+        send_msg(pconn, kPull, pseq, key_of[ks], ver, data.data(), data.size());
+    }
+  }
 
   int start(int port, int num_workers, bool enable_async) {
     num_workers_.store(num_workers);
     async_ = enable_async;
+    const char* et = getenv("BYTEPS_SERVER_ENGINE_THREAD");
+    n_engine_ = et ? std::max(1, atoi(et)) : 4;
+    const char* sch = getenv("BYTEPS_SERVER_ENABLE_SCHEDULE");
+    schedule_ = sch && atoi(sch) != 0;
+    tid_load_.assign(n_engine_, 0);
+    for (int i = 0; i < n_engine_; ++i)
+      queues_.emplace_back(new EngineQueue(schedule_));
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return -1;
     int one = 1;
@@ -265,6 +392,8 @@ class NativeServer {
     if (listen(listen_fd_, 128) < 0) return -1;
     socklen_t len = sizeof(addr);
     getsockname(listen_fd_, (sockaddr*)&addr, &len);
+    for (int i = 0; i < n_engine_; ++i)
+      engine_threads_.emplace_back([this, i] { engine_loop(i); });
     accept_thread_ = std::thread([this] { accept_loop(); });
     return ntohs(addr.sin_port);
   }
@@ -273,13 +402,16 @@ class NativeServer {
     stop_.store(true);
     if (listen_fd_ >= 0) { shutdown(listen_fd_, SHUT_RDWR); close(listen_fd_); }
     if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : engine_threads_)
+      if (t.joinable()) t.join();
+    engine_threads_.clear();
     std::vector<std::thread> threads;
     {
-      // shutdown (not close) live fds so blocked recv()s return; the
-      // serve() epilogue closes and prunes.  Join OUTSIDE the lock —
-      // exiting serve threads take conn_mu_ to prune themselves.
+      // shutdown (not close) live fds so blocked recv()s return; the fd
+      // itself closes when the last ConnPtr holder releases it.  Join
+      // OUTSIDE the lock — exiting serve threads take conn_mu_ to prune.
       std::lock_guard<std::mutex> g(conn_mu_);
-      for (int fd : conns_) shutdown(fd, SHUT_RDWR);
+      for (auto& c : conns_) shutdown(c->fd, SHUT_RDWR);
       threads.swap(threads_);
     }
     for (auto& t : threads)
@@ -303,9 +435,10 @@ class NativeServer {
       }
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto conn = std::make_shared<Conn>(fd);
       std::lock_guard<std::mutex> g(conn_mu_);
-      conns_.push_back(fd);
-      threads_.emplace_back([this, fd] { serve(fd); });
+      conns_.push_back(conn);
+      threads_.emplace_back([this, conn] { serve(conn); });
     }
   }
 
@@ -334,8 +467,8 @@ class NativeServer {
     return true;
   }
 
-  void send_msg(int fd, uint8_t op, uint32_t seq, uint64_t key, uint32_t version,
-                const uint8_t* payload, uint64_t len) {
+  void send_msg(const ConnPtr& conn, uint8_t op, uint32_t seq, uint64_t key,
+                uint32_t version, const uint8_t* payload, uint64_t len) {
     Header h{};
     h.magic = kMagic;
     h.op = op;
@@ -344,16 +477,11 @@ class NativeServer {
     h.cmd = 0;
     h.version = htonl(version);
     h.length = htobe64(len);
-    std::shared_ptr<std::mutex> mu;
-    {
-      std::lock_guard<std::mutex> g(wm_mu_);
-      auto& slot = write_mu_[fd];
-      if (!slot) slot = std::make_shared<std::mutex>();
-      mu = slot;  // shared_ptr keeps the mutex alive across conn pruning
-    }
-    std::lock_guard<std::mutex> g(*mu);
-    if (!send_all(fd, &h, sizeof(h))) return;
-    if (len) send_all(fd, payload, len);
+    // per-connection write mutex lives IN the Conn, so concurrent engine
+    // threads serialize against each other for exactly this stream
+    std::lock_guard<std::mutex> g(conn->write_mu);
+    if (!send_all(conn->fd, &h, sizeof(h))) return;
+    if (len) send_all(conn->fd, payload, len);
   }
 
   KeyState& key_state(uint64_t key) {
@@ -363,23 +491,52 @@ class NativeServer {
     return *slot;
   }
 
-  void serve(int fd) {
-    serve_inner(fd);
-    // reclaim per-connection state (long-lived servers see many
-    // reconnects; leaking fds eventually EMFILEs the acceptor)
-    {
-      std::lock_guard<std::mutex> g(wm_mu_);
-      write_mu_.erase(fd);
+  // key→engine-thread least-loaded cached assignment (server.h:154-178)
+  int thread_for(uint64_t key, uint64_t length) {
+    std::lock_guard<std::mutex> g(tid_mu_);
+    auto it = tid_cache_.find(key);
+    int tid;
+    if (it != tid_cache_.end()) {
+      tid = it->second;
+    } else {
+      tid = 0;
+      for (int i = 1; i < n_engine_; ++i)
+        if (tid_load_[i] < tid_load_[tid]) tid = i;
+      tid_cache_[key] = tid;
     }
-    {
-      std::lock_guard<std::mutex> g(conn_mu_);
-      for (auto it = conns_.begin(); it != conns_.end(); ++it)
-        if (*it == fd) { conns_.erase(it); break; }
-    }
-    ::close(fd);
+    tid_load_[tid] += length;
+    return tid;
   }
 
-  void serve_inner(int fd) {
+  void engine_loop(int tid) {
+    EngineTask t;
+    while (!stop_.load()) {
+      if (!queues_[tid]->pop(&t, 200)) continue;
+      bool ok = true;
+      if (t.op == kPush)
+        ok = handle_push(t.conn, t.seq, t.key, t.cmd, t.version, t.payload);
+      else if (t.op == kPull)
+        ok = handle_pull(t.conn, t.seq, t.key, t.cmd, t.version);
+      if (!ok) {
+        // malformed request → drop the connection: shutdown wakes the
+        // serve thread's recv; the fd closes when the last holder releases
+        shutdown(t.conn->fd, SHUT_RDWR);
+      }
+      t.conn.reset();  // release promptly; last holder closes the fd
+    }
+  }
+
+  void serve(const ConnPtr& conn) {
+    serve_inner(conn);
+    // prune our registry entry; the Conn destructor closes the fd once
+    // queued tasks / pending pulls / init waiters release their refs
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (auto it = conns_.begin(); it != conns_.end(); ++it)
+      if (*it == conn) { conns_.erase(it); break; }
+  }
+
+  void serve_inner(const ConnPtr& conn) {
+    const int fd = conn->fd;
     std::vector<uint8_t> payload;
     while (!stop_.load()) {
       Header h;
@@ -393,30 +550,47 @@ class NativeServer {
       if (len && !recv_exact(fd, payload.data(), len)) break;
       switch (h.op) {
         case kPing:
-          send_msg(fd, kPing, seq, 0, 0, nullptr, 0);
+          send_msg(conn, kPing, seq, 0, 0, nullptr, 0);
           break;
         case kShutdown:
-          send_msg(fd, kShutdown, seq, 0, 0, nullptr, 0);
+          send_msg(conn, kShutdown, seq, 0, 0, nullptr, 0);
           return;
         case kInit:
-          if (!handle_init(fd, seq, key, payload)) return;  // malformed → drop conn
+          if (!handle_init(conn, seq, key, payload)) return;  // malformed → drop conn
           break;
         case kRegisterCompressor:
-          handle_register(fd, seq, key, payload);
+          handle_register(conn, seq, key, payload);
           break;
         case kPush:
-          if (!handle_push(fd, seq, key, cmd, version, payload)) return;
+        case kPull: {
+          // data plane rides the engine queues; the anti-starvation prio
+          // is the key's accumulated push count (queue.h:49-97), snapshot
+          // at enqueue like the reference's cached priority
+          uint64_t prio;
+          {
+            std::lock_guard<std::mutex> g(tid_mu_);
+            if (h.op == kPush) pushed_total_[key]++;
+            prio = pushed_total_[key];
+          }
+          EngineTask t;
+          t.op = h.op;
+          t.conn = conn;
+          t.seq = seq;
+          t.key = key;
+          t.cmd = cmd;
+          t.version = version;
+          t.payload = std::move(payload);
+          payload.clear();
+          queues_[thread_for(key, t.payload.size())]->put(std::move(t), prio);
           break;
-        case kPull:
-          if (!handle_pull(fd, seq, key, cmd, version)) return;
-          break;
+        }
         default:
           break;
       }
     }
   }
 
-  bool handle_init(int fd, uint32_t seq, uint64_t key,
+  bool handle_init(const ConnPtr& conn, uint32_t seq, uint64_t key,
                    const std::vector<uint8_t>& payload) {
     // malformed init must not silently strand the barrier: drop the
     // connection so the worker sees EOF instead of hanging forever
@@ -428,7 +602,7 @@ class NativeServer {
     n = be64toh(n);
     dt = ntohl(dt);
     auto& ks = key_state(key);
-    std::vector<std::pair<int, uint32_t>> waiters;
+    std::vector<std::pair<ConnPtr, uint32_t>> waiters;
     {
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) {
@@ -438,17 +612,17 @@ class NativeServer {
         ks.store.assign(bytes, 0);
         ks.accum.assign(bytes, 0);
       }
-      ks.init_waiters.emplace_back(fd, seq);
+      ks.init_waiters.emplace_back(conn, seq);
       if ((int)ks.init_waiters.size() >= num_workers_.load()) {
         waiters.swap(ks.init_waiters);
       }
     }
-    for (auto& [wfd, wseq] : waiters)
-      send_msg(wfd, kInit, wseq, key, 0, nullptr, 0);
+    for (auto& [wconn, wseq] : waiters)
+      send_msg(wconn, kInit, wseq, key, 0, nullptr, 0);
     return true;
   }
 
-  void handle_register(int fd, uint32_t seq, uint64_t key,
+  void handle_register(const ConnPtr& conn, uint32_t seq, uint64_t key,
                        const std::vector<uint8_t>& payload) {
     std::map<std::string, std::string> kw;
     std::string text((const char*)payload.data(), payload.size());
@@ -467,15 +641,15 @@ class NativeServer {
       std::lock_guard<std::mutex> g(ks.mu);
       ks.codec = make_codec(kw, ks.nelems);
     }
-    send_msg(fd, kRegisterCompressor, seq, key, 0, nullptr, 0);
+    send_msg(conn, kRegisterCompressor, seq, key, 0, nullptr, 0);
   }
 
-  bool handle_push(int fd, uint32_t seq, uint64_t key, uint32_t cmd,
+  bool handle_push(const ConnPtr& conn, uint32_t seq, uint64_t key, uint32_t cmd,
                    uint32_t version, const std::vector<uint8_t>& payload) {
     int32_t rtype, dtype;
     decode_cantor(cmd, &rtype, &dtype);
     auto& ks = key_state(key);
-    std::vector<std::tuple<int, uint32_t, std::vector<uint8_t>, uint32_t>> flush;
+    std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>> flush;
     {
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) return false;  // push before init → drop conn
@@ -521,7 +695,7 @@ class NativeServer {
           std::vector<PendingPull> still;
           for (auto& p : ks.pending) {
             if (p.version <= ks.store_version) {
-              flush.emplace_back(p.fd, p.seq,
+              flush.emplace_back(p.conn, p.seq,
                                  wire_payload_locked(ks, p.wants_compressed),
                                  ks.store_version);
             } else {
@@ -532,9 +706,9 @@ class NativeServer {
         }
       }
     }
-    send_msg(fd, kPush, seq, key, version, nullptr, 0);
-    for (auto& [pfd, pseq, data, ver] : flush)
-      send_msg(pfd, kPull, pseq, key, ver, data.data(), data.size());
+    send_msg(conn, kPush, seq, key, version, nullptr, 0);
+    for (auto& [pconn, pseq, data, ver] : flush)
+      send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
     return true;
   }
 
@@ -547,7 +721,7 @@ class NativeServer {
     return ks.store;
   }
 
-  bool handle_pull(int fd, uint32_t seq, uint64_t key, uint32_t cmd,
+  bool handle_pull(const ConnPtr& conn, uint32_t seq, uint64_t key, uint32_t cmd,
                    uint32_t version) {
     int32_t rtype, dtype;
     decode_cantor(cmd, &rtype, &dtype);
@@ -559,13 +733,13 @@ class NativeServer {
       if (ks.store.empty()) return false;  // pull before init → drop conn
       bool ready = async_ || version <= ks.store_version;
       if (!ready) {
-        ks.pending.push_back({version, fd, seq, rtype == 2});
+        ks.pending.push_back({version, conn, seq, rtype == 2});
         return true;
       }
       data = wire_payload_locked(ks, rtype == 2);
       ver = ks.store_version;
     }
-    send_msg(fd, kPull, seq, key, ver, data.data(), data.size());
+    send_msg(conn, kPull, seq, key, ver, data.data(), data.size());
     return true;
   }
 
@@ -575,12 +749,19 @@ class NativeServer {
   std::atomic<bool> stop_{false};
   std::thread accept_thread_;
   std::mutex conn_mu_;
-  std::vector<int> conns_;
+  std::vector<ConnPtr> conns_;
   std::vector<std::thread> threads_;
   std::mutex keys_mu_;
   std::map<uint64_t, std::unique_ptr<KeyState>> keys_;
-  std::mutex wm_mu_;
-  std::map<int, std::shared_ptr<std::mutex>> write_mu_;
+  // engine queue plane
+  int n_engine_ = 4;
+  bool schedule_ = false;
+  std::vector<std::unique_ptr<EngineQueue>> queues_;
+  std::vector<std::thread> engine_threads_;
+  std::mutex tid_mu_;
+  std::map<uint64_t, int> tid_cache_;
+  std::vector<uint64_t> tid_load_;
+  std::map<uint64_t, uint64_t> pushed_total_;
 };
 
 NativeServer* g_server = nullptr;

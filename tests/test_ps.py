@@ -312,10 +312,18 @@ _WORKER_SCRIPT = textwrap.dedent(
 
 
 class TestMultiWorker:
-    def test_two_workers_sum(self, tmp_path):
+    @pytest.mark.parametrize("server_kind", ["python", "native"])
+    def test_two_workers_sum(self, tmp_path, server_kind):
         """True cross-worker aggregation: 2 worker subprocesses push
         different values; both must receive the sum (the PS's whole job,
-        server.cc:296-375)."""
+        server.cc:296-375).  Runs against BOTH engines — the native
+        ALL_RECV round + pending-pull flush (ps_server.cc) is the
+        trickiest concurrency in the repo and needs real 2-worker load."""
+        if server_kind == "native":
+            from byteps_tpu.native import HAVE_NATIVE
+
+            if not HAVE_NATIVE:
+                pytest.skip("native lib not built")
         sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
         sched.start()
         env_common = {
@@ -332,7 +340,7 @@ class TestMultiWorker:
         scfg.num_server = 1
         scfg.ps_root_uri = "127.0.0.1"
         scfg.ps_root_port = sched.port
-        srv = PSServer(scfg)
+        srv = NativePSServer(scfg) if server_kind == "native" else PSServer(scfg)
         threading.Thread(target=srv.start, daemon=True).start()
 
         script = tmp_path / "worker.py"
@@ -355,3 +363,44 @@ class TestMultiWorker:
             assert p.returncode == 0, f"worker {i} failed:\n{out}"
         combined = "".join(outs)
         assert "WORKER_0_OK" in combined and "WORKER_1_OK" in combined
+
+
+class TestServerScheduling:
+    """BYTEPS_SERVER_ENABLE_SCHEDULE (queue.h:49-97) must be honored by
+    BOTH engines: with scheduling on and multiple engine threads, traffic
+    still aggregates correctly (the knob reorders service, never results)."""
+
+    @pytest.mark.parametrize("server_kind", ["python", "native"])
+    def test_schedule_knob_correct_sums(self, tmp_path, server_kind, monkeypatch):
+        if server_kind == "native":
+            from byteps_tpu.native import HAVE_NATIVE
+
+            if not HAVE_NATIVE:
+                pytest.skip("native lib not built")
+        monkeypatch.setenv("BYTEPS_SERVER_ENABLE_SCHEDULE", "1")
+        monkeypatch.setenv("BYTEPS_SERVER_ENGINE_THREAD", "2")
+        monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "512")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        scfg = Config.from_env()
+        srv = NativePSServer(scfg) if server_kind == "native" else PSServer(scfg)
+        threading.Thread(target=srv.start, daemon=True).start()
+        try:
+            import byteps_tpu as bps
+
+            bps.init()
+            rng = np.random.default_rng(11)
+            for step in range(4):
+                for name in ("sched.a", "sched.b", "sched.c"):
+                    x = rng.normal(size=700).astype(np.float32)
+                    out = bps.push_pull(x, name=name, average=False)
+                    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+            bps.shutdown()
+        finally:
+            srv.stop()
+            sched.stop()
