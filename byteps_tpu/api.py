@@ -96,10 +96,6 @@ def declare_tensor(name: str, **kwargs: str) -> int:
     return ctx.declared_key
 
 
-def _to_numpy(tensor: Any) -> np.ndarray:
-    return np.asarray(tensor)
-
-
 def push_pull_async(
     tensor: Any,
     name: str,

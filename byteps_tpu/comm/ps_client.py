@@ -185,11 +185,12 @@ class PSClient:
                 except (ConnectionError, OSError):
                     return
                 if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
-                    # another worker resized the cluster: adopt the counts
-                    # (averaging and key→server routing read them live)
+                    # another worker resized the cluster: adopt the worker
+                    # count (averaging reads it live).  num_servers never
+                    # changes in a resize — the scheduler refuses those, as
+                    # self._servers' connections couldn't follow.
                     book = json.loads(msg.payload.decode())
                     self.num_workers = book["num_workers"]
-                    self.num_servers = book["num_servers"]
                     continue
                 with self._sched_cb_lock:
                     entry = self._sched_cbs.pop(msg.seq, None)

@@ -48,6 +48,10 @@ GROUP_WORKERS = 1
 GROUP_SERVERS = 2
 GROUP_ALL = 3
 
+#: seq used for unsolicited ADDRBOOK broadcasts after an elastic resize —
+#: distinguishes them from request/response pairs on the control conn
+RESIZE_SEQ = 0xFFFFFFFF
+
 
 @dataclass
 class _Node:
@@ -131,7 +135,19 @@ class Scheduler:
                     return
         except (ConnectionError, OSError):
             return
+        except Exception as e:  # noqa: BLE001
+            # malformed payload on the attacker-reachable port (bad JSON,
+            # bad UTF-8, missing fields) must not kill the serve thread
+            # or leak the fd — and the operator needs a trace of it
+            from byteps_tpu.common import logging as bpslog
+
+            bpslog.warning("scheduler dropped connection on bad request: %r", e)
+            return
         finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
             with self._lock:
                 self._conn_ids.pop(conn, None)
                 self._recovered_conns.discard(conn)
@@ -161,7 +177,50 @@ class Scheduler:
         # uid fall back to their (stable) listen address.
         uid = info.get("uid") or f"{info['host']}:{info['port']}"
         recovery = False
+        resized = False
         with self._lock:
+            # Elastic world-size change (ReDeclareTensor + resume(num_workers,
+            # num_servers), operations.cc:96-119): a worker re-registering
+            # with a DIFFERENT expected topology updates the cluster's
+            # expectation.  Dead entries are pruned so their ranks free up;
+            # live nodes keep their ranks (stable keys depend on it).
+            nw = info.get("num_workers")
+            ns = info.get("num_servers")
+            if (
+                self._addrbook_sent
+                and role == "worker"
+                and ns
+                and int(ns) != self.num_servers
+            ):
+                # server elasticity is NOT live-resizable: every live
+                # worker's key→server routing and open connections assume
+                # the server list; refuse rather than desync the cluster
+                err = {
+                    "error": f"num_servers change ({self.num_servers}→{ns}) "
+                    "requires a cluster restart"
+                }
+                try:
+                    send_message(
+                        conn,
+                        Message(Op.ADDRBOOK, status=1, seq=msg.seq,
+                                payload=json.dumps(err).encode()),
+                        send_lock,
+                    )
+                except (ConnectionError, OSError):
+                    pass
+                return
+            if (
+                self._addrbook_sent
+                and role == "worker"
+                and nw
+                and int(nw) != self.num_workers
+            ):
+                self.num_workers = int(nw)
+                for r in ("worker", "server"):
+                    self._nodes[r] = [
+                        n for n in self._nodes[r] if n.conn in self._conn_ids
+                    ]
+                resized = True
             nodes = self._nodes[role]
             existing = [n for n in nodes if n.uid == uid]
             if existing and self._addrbook_sent:
@@ -177,24 +236,48 @@ class Scheduler:
                 self._recovered_conns.add(conn)
             elif self._addrbook_sent:
                 # Unknown uid joining a full cluster: a process-level restart
-                # lost its uuid (BYTEPS_NODE_UID unset).  Adopt a dead
-                # member's slot when one exists; otherwise append a fresh
-                # rank.  Either way reply immediately — a registrant must
-                # never be left hanging with no ADDRBOOK.
+                # lost its uuid (BYTEPS_NODE_UID unset), or a scale-up added
+                # room.  Adopt a dead member's slot when one exists; join at
+                # the lowest free rank when the (possibly just-resized)
+                # population has room; otherwise REFUSE with an error reply
+                # — appending an extra rank would skew barrier group sizes
+                # and per-key push counts for the whole cluster, and
+                # silence would leave the registrant hanging.
                 dead = [n for n in nodes if n.conn not in self._conn_ids]
+                expected = self.num_workers if role == "worker" else self.num_servers
                 if dead:
                     node = dead[0]
                     rank = node.rank
                     nodes[nodes.index(node)] = _Node(
                         rank, info["host"], info["port"], conn, send_lock, uid
                     )
-                else:
-                    rank = len(nodes)
+                elif len(nodes) < expected:
+                    used = {n.rank for n in nodes}
+                    rank = next(r for r in range(expected) if r not in used)
                     nodes.append(
                         _Node(rank, info["host"], info["port"], conn, send_lock, uid)
                     )
-                recovery = True
-                self._recovered_conns.add(conn)
+                else:
+                    err = {
+                        "error": f"cluster full: no dead {role} slot to adopt; "
+                        "set BYTEPS_NODE_UID to rejoin as a known member"
+                    }
+                    try:
+                        send_message(
+                            conn,
+                            Message(
+                                Op.ADDRBOOK,
+                                status=1,
+                                seq=msg.seq,
+                                payload=json.dumps(err).encode(),
+                            ),
+                            send_lock,
+                        )
+                    except (ConnectionError, OSError):
+                        pass
+                    return
+                recovery = True  # mid-training join: immediate book +
+                self._recovered_conns.add(conn)  # barrier bypass
             else:
                 rank = len(nodes)
                 nodes.append(
@@ -208,6 +291,16 @@ class Scheduler:
             )
             if recovery:
                 self._send_addrbook_to(conn, send_lock, role, rank, msg.seq, recovery=True)
+                if resized:
+                    # every OTHER live node adopts the new topology from an
+                    # unsolicited RESIZE_SEQ book on its control connection
+                    for r in ("worker", "server"):
+                        for node in self._nodes[r]:
+                            if node.conn is not conn:
+                                self._send_addrbook_to(
+                                    node.conn, node.send_lock, r, node.rank,
+                                    RESIZE_SEQ,
+                                )
                 return
             if full and not self._addrbook_sent:
                 self._addrbook_sent = True

@@ -130,6 +130,15 @@ def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:
     return sock
 
 
+def decode_liveness(payload: bytes) -> dict:
+    """Decode an Op.QUERY liveness reply: JSON stringifies rank keys;
+    restore ints so consumers index by rank."""
+    import json
+
+    raw = json.loads(payload.decode())
+    return {role: {int(r): age for r, age in d.items()} for role, d in raw.items()}
+
+
 def close_socket(sock: Optional[socket.socket]) -> None:
     """shutdown(SHUT_RDWR) then close.
 

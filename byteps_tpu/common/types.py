@@ -221,6 +221,9 @@ class TensorTableEntry:
     compressed: Optional[bytes] = None
     callback: Optional[Callable[[Status], None]] = None
     context: Any = None
+    # once-guard: a task may be failed from two racing paths (stage-thread
+    # exception AND dead-connection callback); only the first wins
+    failed: bool = False
 
     def current_stage(self) -> Optional[QueueType]:
         return self.queue_list[0] if self.queue_list else None

@@ -116,6 +116,7 @@ class PipelineEngine:
         # round the earlier push never gets to start).  Completions advance
         # the allowance.
         self._push_ready = ReadyTable(ready_count=1, name="push")
+        self._seeded: set = set()  # keys whose gate this engine has seeded
         self.queues: Dict[QueueType, Any] = {
             QueueType.COPYD2H: ScheduledQueue(QueueType.COPYD2H),
             QueueType.COMPRESS: _StripedStage(QueueType.COMPRESS, pool),
@@ -183,15 +184,7 @@ class PipelineEngine:
             try:
                 fn(task)
             except Exception as e:  # surface errors on the handle
-                q.report_finish(task)  # return scheduling credits
-                # a failed round never completes, so it can never advance
-                # the key's version allowance itself — advance it here (at
-                # ANY stage) or every later round of the key blocks forever
-                self._push_ready.add_ready_count(task.key)
-                self.queues[QueueType.PUSH].notify()
-                job: _Job = task.context
-                job_status = Status.Aborted(f"{q.queue_type.name}: {e!r}")
-                self._fail_job(job, job_status)
+                self._fail_task(task, q.queue_type, repr(e))
 
     # --- submission ------------------------------------------------------
 
@@ -236,11 +229,18 @@ class PipelineEngine:
                     # blocking init-push doubles as the cross-worker barrier
                     # for the key (operations.cc:283-414)
                     self.client.init_tensor(part.key, part.length, dtype_id)
-                    self._push_ready.set_ready_count(part.key, 1)  # round 1 free
                 self._maybe_setup_compression(ctx, np_dtype, flat.size * np_dtype.itemsize)
                 ctx.initialized = True
-
-        ctx.version += 1
+            ctx.version += 1
+            # Seed the round-order gate per ENGINE, not per ctx-init: the
+            # registry (and its version counters) outlive shutdown()/init()
+            # cycles, while each engine starts with a fresh ReadyTable — a
+            # reused tensor name must start from its CURRENT version, not 1,
+            # or its tasks would never become eligible.
+            for part in ctx.partitions:
+                if part.key not in self._seeded:
+                    self._seeded.add(part.key)
+                    self._push_ready.set_ready_count(part.key, ctx.version)
         result = np.empty(flat.shape, dtype=np_dtype)
         job = _Job(
             name, ctx, flat, result, dtype_id, average, handle,
@@ -341,15 +341,25 @@ class PipelineEngine:
 
         get_state().handles.mark_done(job.handle, None, status)
 
-    def _abort_task(self, task: TensorTableEntry, stage: QueueType, reason: str) -> None:
-        """Fail a task whose async completion can never arrive (dead server
-        connection): return credits, advance the key's round allowance, and
-        surface the error on the handle — callers must never hang in
-        synchronize() on a dead cluster."""
+    def _fail_task(self, task: TensorTableEntry, stage: QueueType, reason: str) -> None:
+        """Fail a task exactly once: return credits, advance the key's
+        round allowance (a failed round can never advance it by completing),
+        and surface the error on the handle — callers must never hang in
+        synchronize() on a dead cluster.
+
+        Two paths can race here for one task — a stage-thread exception and
+        the dead-connection error callback — so the job lock + task.failed
+        guard makes the second a no-op (credits and the version allowance
+        must not be double-counted)."""
+        job: _Job = task.context
+        with job.lock:
+            if task.failed:
+                return
+            task.failed = True
         self.queues[stage].report_finish(task)
         self._push_ready.add_ready_count(task.key)
         self.queues[QueueType.PUSH].notify()
-        self._fail_job(task.context, Status.Aborted(f"{stage.name}: {reason}"))
+        self._fail_job(job, Status.Aborted(f"{stage.name}: {reason}"))
 
     def _finalize(self, job: _Job) -> None:
         """All partitions done: average (the plugin-side div by size,
@@ -407,7 +417,7 @@ class PipelineEngine:
             task.key, payload, job.dtype_id, task.version,
             cb=lambda: self._proceed(task),
             request_type=rtype,
-            on_error=lambda: self._abort_task(
+            on_error=lambda: self._fail_task(
                 task, QueueType.PUSH, "server connection lost"
             ),
         )
@@ -432,7 +442,7 @@ class PipelineEngine:
             task.key, task.version, on_pull, dtype_id=job.dtype_id,
             request_type=RequestType.COMPRESSED_PUSH_PULL
             if compressed else RequestType.DEFAULT_PUSH_PULL,
-            on_error=lambda: self._abort_task(
+            on_error=lambda: self._fail_task(
                 task, QueueType.PULL, "server connection lost"
             ),
         )

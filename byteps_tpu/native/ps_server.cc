@@ -332,17 +332,12 @@ class NativeServer {
     // elastic scale-down: a round that already holds >= n pushes will
     // never see the departed workers' contributions — publish it now and
     // flush its buffered pulls (mirrors the Python server)
-    std::vector<KeyState*> all;
+    std::vector<std::pair<uint64_t, KeyState*>> all;
     {
       std::lock_guard<std::mutex> g(keys_mu_);
-      for (auto& [k, ks] : keys_) all.push_back(ks.get());
+      for (auto& [k, ks] : keys_) all.emplace_back(k, ks.get());
     }
-    std::map<KeyState*, uint64_t> key_of;
-    {
-      std::lock_guard<std::mutex> g(keys_mu_);
-      for (auto& [k, ks] : keys_) key_of[ks.get()] = k;
-    }
-    for (KeyState* ks : all) {
+    for (auto& [key, ks] : all) {
       std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>
           flush;
       {
@@ -366,7 +361,7 @@ class NativeServer {
         ks->pending.swap(still);
       }
       for (auto& [pconn, pseq, data, ver] : flush)
-        send_msg(pconn, kPull, pseq, key_of[ks], ver, data.data(), data.size());
+        send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
     }
   }
 

@@ -194,7 +194,11 @@ class PSServer:
                 ).encode(),
             ),
         )
-        book = json.loads(recv_message(conn).payload.decode())
+        resp = recv_message(conn)
+        if resp.status != 0:
+            err = json.loads(resp.payload.decode()).get("error", "register refused")
+            raise RuntimeError(f"scheduler refused registration: {err}")
+        book = json.loads(resp.payload.decode())
         self.rank = book["rank"]
         self.num_workers = book["num_workers"]
         # global barrier before serving (server.cc:506)
@@ -205,11 +209,22 @@ class PSServer:
         # the scheduler connection from here on (synchronous ping/pong)
         hb = self.cfg.heartbeat_interval
         if hb > 0:
+            from byteps_tpu.comm.rendezvous import RESIZE_SEQ
+
             def beat() -> None:
                 while not self._stop.wait(hb):
                     try:
                         send_message(conn, Message(Op.PING))
-                        recv_message(conn)
+                        # drain until the PING response: unsolicited
+                        # RESIZE_SEQ address books (elastic world-size
+                        # change) arrive interleaved on this conn
+                        while True:
+                            msg = recv_message(conn)
+                            if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
+                                book = json.loads(msg.payload.decode())
+                                self.update_num_workers(book["num_workers"])
+                                continue
+                            break
                     except (ConnectionError, OSError):
                         return
 
@@ -310,10 +325,7 @@ class PSServer:
                     "dropping connection after malformed request key=%d op=%d: %r",
                     msg.key, int(msg.op), e,
                 )
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                close_socket(conn)  # FIN even while the serve thread recvs
                 continue
 
     def _handle_init(self, msg: Message, conn, send_lock) -> None:
@@ -357,7 +369,11 @@ class PSServer:
         flush: List = []
         with ks.lock:
             if ks.store is None:
-                raise ConnectionError(f"push for uninitialized key {msg.key}")
+                # RuntimeError (not ConnectionError): the engine loop's
+                # generic handler DROPS the connection so the worker errors
+                # out instead of waiting forever for an ack (matches the
+                # native server's return-false-drop)
+                raise RuntimeError(f"push for uninitialized key {msg.key}")
             if self.cfg.enable_async:
                 # async mode: parameter store, sum deltas in place
                 # (server.cc:315-319)
@@ -381,24 +397,7 @@ class PSServer:
                 ks.recv_count += 1
                 ks.pushed_total += 1
                 if ks.recv_count >= self.num_workers:
-                    # ALL_RECV: publish round, flush buffered pulls
-                    # (server.cc:348-375)
-                    ks.store, ks.accum = ks.accum, ks.store
-                    ks.store_version += 1
-                    ks.recv_count = 0
-                    if compressed:
-                        # compress the merged result once per round for
-                        # pull responses (server.cc:348-370)
-                        ks.pull_payload = ks.compressor.compress(ks.store)
-                    still_pending = []
-                    for version, pconn, plock, pseq, pcomp in ks.pending_pulls:
-                        if version <= ks.store_version:
-                            flush.append(
-                                (pconn, plock, pseq, ks.wire_payload(pcomp), ks.store_version)
-                            )
-                        else:
-                            still_pending.append((version, pconn, plock, pseq, pcomp))
-                    ks.pending_pulls = still_pending
+                    flush.extend(self._publish_round_locked(ks, compressed))
         send_message(conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version), send_lock)
         for pconn, plock, pseq, payload, ver in flush:
             send_message(
@@ -407,13 +406,57 @@ class PSServer:
                 plock,
             )
 
+    def _publish_round_locked(self, ks: "_KeyState", compressed: bool) -> List:
+        """ALL_RECV: publish the round, flush buffered pulls
+        (server.cc:348-375).  Caller holds ks.lock; returns the flush list."""
+        ks.store, ks.accum = ks.accum, ks.store
+        ks.store_version += 1
+        ks.recv_count = 0
+        if compressed:
+            # compress the merged result once per round for pull responses
+            # (server.cc:348-370)
+            ks.pull_payload = ks.compressor.compress(ks.store)
+        flush: List = []
+        still_pending = []
+        for version, pconn, plock, pseq, pcomp in ks.pending_pulls:
+            if version <= ks.store_version:
+                flush.append(
+                    (pconn, plock, pseq, ks.wire_payload(pcomp), ks.store_version)
+                )
+            else:
+                still_pending.append((version, pconn, plock, pseq, pcomp))
+        ks.pending_pulls = still_pending
+        return flush
+
+    def update_num_workers(self, n: int) -> None:
+        """Adopt a resized worker population (elastic scale-up/down).  A
+        round that already has >= n pushes completes immediately — on
+        scale-down the departed workers' contributions will never arrive."""
+        self.num_workers = n
+        if self.cfg.enable_async:
+            return
+        for key, ks in list(self._keys.items()):
+            flush: List = []
+            with ks.lock:
+                if ks.store is not None and 0 < n <= ks.recv_count:
+                    flush = self._publish_round_locked(ks, ks.compressor is not None)
+            for pconn, plock, pseq, payload, ver in flush:
+                try:
+                    send_message(
+                        pconn,
+                        Message(Op.PULL, key=key, payload=payload, seq=pseq, version=ver),
+                        plock,
+                    )
+                except (ConnectionError, OSError):
+                    pass
+
     def _handle_pull(self, msg: Message, conn, send_lock) -> None:
         ks = self._key_state(msg.key)
         rtype, _ = decode_command_type(msg.cmd)
         wants_compressed = rtype == RequestType.COMPRESSED_PUSH_PULL
         with ks.lock:
             if ks.store is None:
-                raise ConnectionError(f"pull for uninitialized key {msg.key}")
+                raise RuntimeError(f"pull for uninitialized key {msg.key}")
             ready = self.cfg.enable_async or msg.version <= ks.store_version
             if ready:
                 payload = ks.wire_payload(wants_compressed, self.cfg.enable_async)
@@ -459,6 +502,12 @@ class NativePSServer:
         from byteps_tpu.common.config import resolve_node_uid
 
         self.node_uid = resolve_node_uid()
+
+    def update_num_workers(self, n: int) -> None:
+        """Adopt a resized worker population in the C++ engine (the beat
+        thread calls this on RESIZE_SEQ books, as for the Python server)."""
+        self.num_workers = n
+        self._lib.bps_native_server_set_num_workers(n)
 
     def start(self, register: bool = True) -> None:
         if register:

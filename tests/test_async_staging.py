@@ -175,3 +175,23 @@ class TestPushRoundOrdering:
             assert versions == sorted(versions), (
                 f"key {key} rounds reordered on the wire: {versions}"
             )
+
+
+class TestReinitKeyReuse:
+    def test_shutdown_init_reuse_name(self, small_partition_cluster):
+        """shutdown() then init() with the same tensor name: the registry
+        (and its version counters) persist, the new engine's round gate
+        must seed from the CURRENT version — regression for a deadlock
+        where reused names were never eligible in the fresh PUSH queue."""
+        import byteps_tpu as bps
+
+        bps.init()
+        x = np.ones(2048, np.float32)
+        out = bps.push_pull(x, name="reinit.g", average=False)
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+        bps.shutdown()
+
+        bps.init()  # fresh engine, same registry
+        out2 = bps.push_pull(x * 4, name="reinit.g", average=False)
+        np.testing.assert_allclose(np.asarray(out2), 4.0)
+        bps.shutdown()

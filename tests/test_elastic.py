@@ -193,3 +193,130 @@ class TestMultiWorkerRejoinIdentity:
                 else:
                     os.environ[k] = v
         sched.stop()
+
+
+class TestElasticWorldSizeChange:
+    def test_scale_down_then_up(self):
+        """2→1→2 workers across resume with a LIVE scheduler (VERDICT #5):
+        stable keys, scheduler address book actually changes, servers adopt
+        the new worker count, and traffic continues at every size."""
+        import os
+        import time
+
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.server.server import PSServer
+
+        sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
+        sched.start()
+        env = {
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "1",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.1",
+        }
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            cfg2 = Config.from_env()
+            srv = PSServer(cfg2)
+            threading.Thread(target=srv.start, daemon=True).start()
+
+            w0 = PSClient(cfg2, node_uid="w0")
+            w1 = PSClient(cfg2, node_uid="w1")
+            t0 = threading.Thread(target=w0.connect, daemon=True)
+            t0.start()
+            w1.connect()
+            t0.join(10)
+            for _ in range(50):
+                if srv.num_workers == 2:
+                    break
+                time.sleep(0.1)
+            assert srv.num_workers == 2
+
+            # traffic at size 2: both push, both get the sum
+            import struct as _s
+
+            def roundtrip(client, key, value, version, n=64):
+                done = threading.Event()
+                box = []
+                payload = np.full(n, value, np.float32).tobytes()
+                client.push(key, payload, 0, version, cb=lambda: done.set())
+                assert done.wait(10)
+                got = threading.Event()
+                client.pull(key, version, lambda p: (box.append(p), got.set()))
+                assert got.wait(10)
+                return np.frombuffer(box[0], np.float32)
+
+            _ti = threading.Thread(
+                target=lambda: w0.init_tensor(101, 64, 0), daemon=True
+            )
+            _ti.start()
+            w1.init_tensor(101, 64, 0)
+            _ti.join(10)
+            r = []
+            tA = threading.Thread(
+                target=lambda: r.append(roundtrip(w0, 101, 1.0, 1)), daemon=True
+            )
+            tA.start()
+            out1 = roundtrip(w1, 101, 2.0, 1)
+            tA.join(10)
+            np.testing.assert_allclose(out1, 3.0)
+
+            # ---- scale DOWN to 1 worker: w1 leaves, w0 resumes with nw=1
+            w1.close()
+            w0.close()
+            time.sleep(0.3)
+            os.environ["DMLC_NUM_WORKER"] = "1"
+            cfg1 = Config.from_env()
+            w0b = PSClient(cfg1, node_uid="w0")
+            w0b.connect()
+            assert w0b.is_recovery and w0b.rank == 0
+            assert sched.num_workers == 1  # address book actually changed
+            for _ in range(50):
+                if srv.num_workers == 1:
+                    break
+                time.sleep(0.1)
+            assert srv.num_workers == 1  # server adopted the resize
+            # solo traffic completes (a 2-worker round would hang forever)
+            out2 = roundtrip(w0b, 101, 5.0, 2)
+            np.testing.assert_allclose(out2, 5.0)
+
+            # ---- scale UP back to 2: w0 resumes with nw=2, new worker joins
+            w0b.close()
+            time.sleep(0.3)
+            os.environ["DMLC_NUM_WORKER"] = "2"
+            cfg2b = Config.from_env()
+            w0c = PSClient(cfg2b, node_uid="w0")
+            w0c.connect()
+            assert w0c.rank == 0
+            assert sched.num_workers == 2
+            w2 = PSClient(cfg2b, node_uid="w2-new")  # brand-new member
+            w2.connect()
+            assert w2.rank == 1  # lowest free rank, not a stolen one
+            for _ in range(50):
+                if srv.num_workers == 2:
+                    break
+                time.sleep(0.1)
+            assert srv.num_workers == 2
+            # traffic at size 2 again, same key (stable across generations)
+            r2 = []
+            tB = threading.Thread(
+                target=lambda: r2.append(roundtrip(w0c, 101, 10.0, 3)), daemon=True
+            )
+            tB.start()
+            out3 = roundtrip(w2, 101, 20.0, 3)
+            tB.join(10)
+            np.testing.assert_allclose(out3, 30.0)
+
+            w0c.close()
+            w2.close()
+            srv.stop()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        sched.stop()

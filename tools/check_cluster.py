@@ -9,13 +9,19 @@ watchdog/orchestrator (the failure-detection policy layer, SURVEY §5.3).
 """
 
 import argparse
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-from byteps_tpu.comm.transport import Message, Op, connect, recv_message, send_message
+from byteps_tpu.comm.transport import (
+    Message,
+    Op,
+    connect,
+    decode_liveness,
+    recv_message,
+    send_message,
+)
 
 
 def main() -> int:
@@ -32,8 +38,7 @@ def main() -> int:
         print(f"scheduler unreachable at {args.uri}:{args.port}: {e}")
         return 2
     send_message(sock, Message(Op.QUERY, seq=1))
-    raw = json.loads(recv_message(sock).payload.decode())
-    live = {role: {int(r): age for r, age in d.items()} for role, d in raw.items()}
+    live = decode_liveness(recv_message(sock).payload)
     sock.close()
 
     rc = 0
