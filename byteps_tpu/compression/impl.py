@@ -146,37 +146,38 @@ class DitheringCompressor(Compressor):
                 self.s0, self.s1, _ptr(out),
             )
             return out[:ln].tobytes()
-        # numpy reference (scalar loop on the shared RNG for bit parity)
+        # numpy reference, vectorized: only the RNG stream is inherently
+        # sequential (xorshift128+ recurrence, bit-matched with the C++
+        # codec); all quantization math runs as float64 array ops that are
+        # bit-identical to the former scalar loop
         norm = float(np.sqrt((grad.astype(np.float64) ** 2).sum())) if self.l2 \
             else float(np.abs(grad.astype(np.float64)).max(initial=0.0))
         if norm == 0.0:
             norm = 1.0
         rng = XorShift128Plus(self.s0, self.s1)
-        levels = np.zeros(n, dtype=np.int8)
+        u = np.fromiter((rng.uniform() for _ in range(n)), dtype=np.float64, count=n)
         s = self.s
-        for i in range(n):
-            p = abs(float(grad[i])) / norm
-            u = rng.uniform()
-            if self.natural:
-                if p <= 0.0:
-                    level = 0
-                else:
-                    j = int(np.floor(np.log2(p)))
-                    if j >= 0:
-                        level = s
-                    elif j < -s:
-                        lo, hi = 0.0, 2.0 ** (-s)
-                        level = 1 if (p - lo) / (hi - lo) > u else 0
-                    else:
-                        lo, hi = 2.0 ** j, 2.0 ** (j + 1)
-                        jl = s + j
-                        level = jl + 1 if (p - lo) / (hi - lo) > u else jl
-            else:
-                scaled = p * s
-                fl = int(np.floor(scaled))
-                level = fl + (1 if scaled - fl > u else 0)
-                level = min(level, s)
-            levels[i] = -level if np.signbit(grad[i]) else level
+        p = np.abs(grad.astype(np.float64)) / norm
+        if self.natural:
+            level = np.zeros(n, dtype=np.int64)
+            pos = p > 0.0
+            j = np.zeros(n, dtype=np.float64)
+            j[pos] = np.floor(np.log2(p[pos]))
+            hi_case = pos & (j >= 0)
+            lo_case = pos & (j < -s)
+            mid = pos & ~hi_case & ~lo_case
+            level[hi_case] = s
+            level[lo_case] = (p[lo_case] / (2.0 ** (-s)) > u[lo_case]).astype(np.int64)
+            jm = j[mid]
+            lo_b = 2.0 ** jm
+            frac = (p[mid] - lo_b) / (2.0 ** (jm + 1) - lo_b)
+            level[mid] = (s + jm).astype(np.int64) + (frac > u[mid])
+        else:
+            scaled = p * s
+            fl = np.floor(scaled)
+            level = (fl + ((scaled - fl) > u)).astype(np.int64)
+            np.minimum(level, s, out=level)
+        levels = np.where(np.signbit(grad), -level, level).astype(np.int8)
         return np.float32(norm).tobytes() + levels.tobytes()
 
     def decompress(self, payload: bytes, n: int) -> np.ndarray:
