@@ -124,10 +124,11 @@ def send_message(sock: socket.socket, msg: Message, lock: Optional[threading.Loc
 
 
 def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:
-    sock = socket.create_connection((host, port), timeout=timeout)
-    sock.settimeout(None)
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    return sock
+    """Dial an address from the scheduler book; the van scheme is encoded
+    in the host string (``unix://...`` → UDS, else TCP)."""
+    from byteps_tpu.comm.van import van_for_address
+
+    return van_for_address(host).connect(host, port, timeout=timeout)
 
 
 def decode_liveness(payload: bytes) -> dict:

@@ -1,0 +1,96 @@
+"""Pluggable transport "vans" for the PS data plane.
+
+ps-lite ships three vans — ZeroMQ-TCP, RDMA verbs, UCX (SURVEY §2.4,
+setup.py:312-330) — selected by env (``DMLC_ENABLE_RDMA``).  The TPU
+build keeps the same seam: a Van owns listening/connecting for one
+transport scheme while the 32-byte framing (transport.py) stays shared,
+so an RDMA-class backend can slot in without touching the KV logic.
+
+Vans:
+
+- ``tcp``  — framed TCP (the ZMQ-class default).
+- ``uds``  — Unix-domain stream sockets for same-host worker↔server
+  traffic (the shm-class local path; honors ``BYTEPS_SOCKET_PATH`` like
+  the reference's local plane, communicator.cc:99-107).
+
+Selection: ``BYTEPS_VAN=tcp|uds`` (server side — the address it
+publishes in the scheduler book encodes the scheme, so clients need no
+config).  Addresses stay ``(host, port)`` shaped for the control plane:
+a UDS address is ``("unix://<path>", 0)``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import tempfile
+import uuid
+from typing import Tuple
+
+UNIX_PREFIX = "unix://"
+
+
+class Van:
+    """One transport scheme.  Framing/recv/send stay in transport.py."""
+
+    name = "base"
+
+    def listen(self, host: str) -> Tuple[socket.socket, str, int]:
+        """Bind + listen; returns (socket, published_host, published_port)."""
+        raise NotImplementedError
+
+    def connect(self, host: str, port: int, timeout: float = 30.0) -> socket.socket:
+        raise NotImplementedError
+
+
+class TcpVan(Van):
+    name = "tcp"
+
+    def listen(self, host: str) -> Tuple[socket.socket, str, int]:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, 0))
+        srv.listen(128)
+        return srv, host, srv.getsockname()[1]
+
+    def connect(self, host: str, port: int, timeout: float = 30.0) -> socket.socket:
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+
+class UdsVan(Van):
+    name = "uds"
+
+    def listen(self, host: str) -> Tuple[socket.socket, str, int]:
+        base = os.environ.get("BYTEPS_SOCKET_PATH", tempfile.gettempdir())
+        path = os.path.join(base, f"byteps_uds_{os.getpid()}_{uuid.uuid4().hex[:8]}.sock")
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(128)
+        return srv, UNIX_PREFIX + path, 0
+
+    def connect(self, host: str, port: int, timeout: float = 30.0) -> socket.socket:
+        path = host[len(UNIX_PREFIX):]
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(path)
+        sock.settimeout(None)
+        return sock
+
+
+_VANS = {v.name: v for v in (TcpVan(), UdsVan())}
+
+
+def get_van(name: str = "") -> Van:
+    """Server-side van selection (``BYTEPS_VAN``, default tcp)."""
+    name = name or os.environ.get("BYTEPS_VAN", "tcp")
+    if name not in _VANS:
+        raise ValueError(f"unknown van {name!r}; available: {sorted(_VANS)}")
+    return _VANS[name]
+
+
+def van_for_address(host: str) -> Van:
+    """Client-side dispatch: the scheme is encoded in the address."""
+    return _VANS["uds"] if host.startswith(UNIX_PREFIX) else _VANS["tcp"]

@@ -77,9 +77,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     if hasattr(lib, "bps_native_server_start"):
         lib.bps_native_server_start.argtypes = [c.c_int32, c.c_int32, c.c_int32]
         lib.bps_native_server_start.restype = c.c_int32
-        lib.bps_native_server_set_num_workers.argtypes = [c.c_int32]
+        lib.bps_native_server_set_num_workers.argtypes = [c.c_int32, c.c_int32]
         lib.bps_native_server_set_num_workers.restype = None
-        lib.bps_native_server_stop.argtypes = []
+        lib.bps_native_server_stop.argtypes = [c.c_int32]
         lib.bps_native_server_stop.restype = None
     return lib
 

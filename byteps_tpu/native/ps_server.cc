@@ -759,40 +759,60 @@ class NativeServer {
   std::map<uint64_t, uint64_t> pushed_total_;
 };
 
-NativeServer* g_server = nullptr;
+// several server instances may coexist in one process (multi-server
+// tests, the scaling harness); the bound port is the instance id
+std::map<int32_t, NativeServer*> g_servers;
 std::mutex g_server_mu;
 
 }  // namespace
 
 extern "C" {
 
-// start the native data plane; returns the bound port (or -1)
+// start a native data-plane instance; returns the bound port (id), or -1
 int32_t bps_native_server_start(int32_t port, int32_t num_workers,
                                 int32_t enable_async) {
-  std::lock_guard<std::mutex> g(g_server_mu);
-  if (g_server) return -1;
-  g_server = new NativeServer();
-  int p = g_server->start(port, num_workers, enable_async != 0);
+  auto* srv = new NativeServer();
+  int p = srv->start(port, num_workers, enable_async != 0);
   if (p < 0) {
-    delete g_server;
-    g_server = nullptr;
+    delete srv;
+    return -1;
   }
+  std::lock_guard<std::mutex> g(g_server_mu);
+  g_servers[p] = srv;
   return p;
 }
 
-// update the engine's expected worker count (scheduler address book wins
-// over the launch-time env, matching the Python server)
-void bps_native_server_set_num_workers(int32_t n) {
+// update an instance's expected worker count (scheduler address book wins
+// over the launch-time env, matching the Python server); port<0 = all
+void bps_native_server_set_num_workers(int32_t port, int32_t n) {
   std::lock_guard<std::mutex> g(g_server_mu);
-  if (g_server) g_server->set_num_workers(n);
+  if (port < 0) {
+    for (auto& [p, srv] : g_servers) srv->set_num_workers(n);
+    return;
+  }
+  auto it = g_servers.find(port);
+  if (it != g_servers.end()) it->second->set_num_workers(n);
 }
 
-void bps_native_server_stop() {
-  std::lock_guard<std::mutex> g(g_server_mu);
-  if (!g_server) return;
-  g_server->stop();
-  delete g_server;
-  g_server = nullptr;
+// stop one instance by port, or all when port < 0
+void bps_native_server_stop(int32_t port) {
+  std::vector<NativeServer*> doomed;
+  {
+    std::lock_guard<std::mutex> g(g_server_mu);
+    if (port < 0) {
+      for (auto& [p, srv] : g_servers) doomed.push_back(srv);
+      g_servers.clear();
+    } else {
+      auto it = g_servers.find(port);
+      if (it == g_servers.end()) return;
+      doomed.push_back(it->second);
+      g_servers.erase(it);
+    }
+  }
+  for (auto* srv : doomed) {
+    srv->stop();
+    delete srv;
+  }
 }
 
 }  // extern "C"

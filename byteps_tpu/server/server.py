@@ -66,6 +66,9 @@ class _KeyState:
         "compressor_kwargs",
         "compressor",
         "pull_payload",
+        "pull_version",
+        "raw_payload",
+        "raw_version",
         "lock",
     )
 
@@ -81,6 +84,9 @@ class _KeyState:
         self.compressor_kwargs: Dict[str, str] = {}
         self.compressor = None  # server-side chain (no momentum)
         self.pull_payload: Optional[bytes] = None  # compressed merged result
+        self.pull_version = -1
+        self.raw_payload: Optional[bytes] = None   # round-cached raw bytes
+        self.raw_version = -1
         self.lock = threading.Lock()
 
     def wire_payload(self, compressed: bool, async_mode: bool = False) -> bytes:
@@ -88,12 +94,28 @@ class _KeyState:
         compressed pulls get the codec-compressed merged result
         (server.cc:92-118), default pulls get raw bytes — mixed-config
         workers on one key stay correct.  In async mode the store mutates
-        every push, so compressed pulls encode on demand."""
+        every push, so both formats encode on demand.
+
+        Raw bytes are serialized ONCE per round and served to every
+        puller from the cache — the reference caches response KVPairs for
+        the same reason (avoid per-request copies / re-registration,
+        server.cc:39-80)."""
         if compressed and self.compressor is not None:
-            if async_mode or self.pull_payload is None:
+            if async_mode:
                 return self.compressor.compress(self.store)
+            # version-gated like the raw cache: a round whose LAST push was
+            # uncompressed skips the publish-time compression, so a stale
+            # pull_payload must never be served for the new round
+            if self.pull_version != self.store_version:
+                self.pull_payload = self.compressor.compress(self.store)
+                self.pull_version = self.store_version
             return self.pull_payload
-        return self.store.tobytes()
+        if async_mode:
+            return self.store.tobytes()
+        if self.raw_version != self.store_version:
+            self.raw_payload = self.store.tobytes()
+            self.raw_version = self.store_version
+        return self.raw_payload
 
 
 class _EngineQueue:
@@ -125,9 +147,14 @@ class _EngineQueue:
 
 class PSServer:
     def __init__(self, cfg: Config, host: str = "127.0.0.1") -> None:
+        from byteps_tpu.comm.van import get_van
+
         self.cfg = cfg
-        self.host = host
-        self._sock, self.port = listen(host, 0)
+        # worker-facing listener rides the selected van (BYTEPS_VAN:
+        # tcp | uds); the published address encodes the scheme, so clients
+        # dial the right transport with no configuration
+        self._van = get_van()
+        self._sock, self.host, self.port = self._van.listen(host)
         self._keys: Dict[int, _KeyState] = {}
         self._keys_lock = threading.Lock()
         self._stop = threading.Event()
@@ -174,6 +201,15 @@ class PSServer:
             self._sock.close()  # listener: no peer to FIN
         except OSError:
             pass
+        from byteps_tpu.comm.van import UNIX_PREFIX
+
+        if self.host.startswith(UNIX_PREFIX):
+            import os
+
+            try:
+                os.unlink(self.host[len(UNIX_PREFIX):])
+            except OSError:
+                pass
         close_socket(self._sched_conn)
 
     def _register_with_scheduler(self) -> None:
@@ -238,7 +274,8 @@ class PSServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if conn.family == socket.AF_INET:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -416,6 +453,7 @@ class PSServer:
             # compress the merged result once per round for pull responses
             # (server.cc:348-370)
             ks.pull_payload = ks.compressor.compress(ks.store)
+            ks.pull_version = ks.store_version
         flush: List = []
         still_pending = []
         for version, pconn, plock, pseq, pcomp in ks.pending_pulls:
@@ -507,7 +545,7 @@ class NativePSServer:
         """Adopt a resized worker population in the C++ engine (the beat
         thread calls this on RESIZE_SEQ books, as for the Python server)."""
         self.num_workers = n
-        self._lib.bps_native_server_set_num_workers(n)
+        self._lib.bps_native_server_set_num_workers(self.port, n)
 
     def start(self, register: bool = True) -> None:
         if register:
@@ -515,11 +553,11 @@ class NativePSServer:
             PSServer._register_with_scheduler(self)  # type: ignore[arg-type]
             # the scheduler's address book wins over launch-time env
             # (PSServer adopts book["num_workers"]; mirror it in the engine)
-            self._lib.bps_native_server_set_num_workers(self.num_workers)
+            self._lib.bps_native_server_set_num_workers(self.port, self.num_workers)
 
     def stop(self) -> None:
         self._stop.set()
-        self._lib.bps_native_server_stop()
+        self._lib.bps_native_server_stop(self.port)
         close_socket(self._sched_conn)
 
 

@@ -22,16 +22,19 @@ from byteps_tpu.comm.rendezvous import Scheduler
 from byteps_tpu.server.server import NativePSServer, PSServer
 
 
-@pytest.fixture(params=["python", "native"])
+@pytest.fixture(params=["python", "native", "python-uds"])
 def fake_cluster(request, monkeypatch):
     """Scheduler + 1 server in-process; this process becomes the worker.
-    Parametrized over the Python server and the C++ native data plane —
-    every PS test runs against both engines."""
+    Parametrized over the Python server, the C++ native data plane, and
+    the Python server behind the UDS van — every PS test runs against all
+    engine/transport combinations."""
     if request.param == "native":
         from byteps_tpu.native import HAVE_NATIVE
 
         if not HAVE_NATIVE:
             pytest.skip("native lib not built")
+    if request.param == "python-uds":
+        monkeypatch.setenv("BYTEPS_VAN", "uds")
     sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
     sched.start()
     monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
