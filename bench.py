@@ -31,6 +31,10 @@ def _probe_devices(timeout_s: float = 180.0):
         try:
             import jax
 
+            if os.environ.get("JAX_PLATFORMS"):
+                # the env var alone does not stick when a plugin
+                # preregisters another platform; pin it explicitly
+                jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
             result["devices"] = jax.devices()
         except Exception as e:  # noqa: BLE001
             result["error"] = repr(e)
@@ -56,8 +60,9 @@ def _probe_devices(timeout_s: float = 180.0):
     raise SystemExit(0)
 
 
-def main() -> None:
-    _probe_devices()
+def _run_config(batch: int, seq: int, steps: int, remat: bool):
+    """Compile + time one train-step config; returns (samples/s, loss) or
+    None if it does not fit (OOM)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -70,44 +75,68 @@ def main() -> None:
     )
     from byteps_tpu.parallel.mesh_utils import make_training_mesh
 
-    # 32/chip fits v5e 16GB HBM without remat (64 like the reference's
-    # per-GPU batch needs rematerialization — TODO: jax.checkpoint path)
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    try:
+        cfg = bert_large(max_seq=seq, compute_dtype=jnp.bfloat16, remat=remat)
+        mesh = make_training_mesh(1, {"dp": 1, "pp": 1, "sp": 1, "tp": 1})
+        params = shard_params(init_params(cfg, seed=0, pp_size=1), cfg, mesh)
+        tx = optax.adamw(1e-4)
+        opt_state = jax.jit(tx.init)(params)
+        step = build_train_step(cfg, mesh, tx, donate=True)
+
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+        )
+        targets = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1))
+
+        for _ in range(3):  # warmup / compile
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        jax.block_until_ready(loss)
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        return batch * steps / dt, float(loss), cfg
+    except Exception as e:  # noqa: BLE001  (XlaRuntimeError / RESOURCE_EXHAUSTED)
+        if "RESOURCE_EXHAUSTED" in repr(e) or "out of memory" in repr(e).lower():
+            return None
+        raise
+
+
+def main() -> None:
+    _probe_devices()
+
     seq = int(os.environ.get("BENCH_SEQ", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    # measured config: batch 32 fits HBM without remat at 44.5% MFU;
-    # BENCH_REMAT=1 + BENCH_BATCH=64 trades recompute for batch (validate
-    # on hardware before making it the default)
-    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    if os.environ.get("BENCH_BATCH"):
+        configs = [
+            (int(os.environ["BENCH_BATCH"]), os.environ.get("BENCH_REMAT", "0") == "1")
+        ]
+    else:
+        # try the measured-good config AND the remat+batch-64 candidate
+        # (reference per-GPU batch); report whichever is faster
+        configs = [(32, False), (64, True)]
 
-    cfg = bert_large(max_seq=seq, compute_dtype=jnp.bfloat16, remat=remat)
-    mesh = make_training_mesh(1, {"dp": 1, "pp": 1, "sp": 1, "tp": 1})
-    params = shard_params(init_params(cfg, seed=0, pp_size=1), cfg, mesh)
-    tx = optax.adamw(1e-4)
-    opt_state = jax.jit(tx.init)(params)
-    step = build_train_step(cfg, mesh, tx, donate=True)
-
-    rng = np.random.default_rng(0)
-    tokens = jnp.asarray(
-        rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
-    )
-    targets = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1))
-
-    # warmup / compile
-    for _ in range(3):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    samples_per_sec = batch * steps / dt
+    tried = {}
+    best = None
+    for batch, remat in configs:
+        res = _run_config(batch, seq, steps, remat)
+        key = f"b{batch}_remat{int(remat)}"
+        if res is None:
+            tried[key] = "OOM"
+            continue
+        sps, loss, mcfg = res
+        tried[key] = round(sps, 2)
+        if best is None or sps > best[0]:
+            best = (sps, loss, batch, remat, mcfg)
+    if best is None:
+        raise SystemExit("no benchmark config fit in memory")
+    samples_per_sec, loss, batch, remat, mcfg = best
 
     # model FLOPs per sample (fwd+bwd = 3x fwd): matmul params + attention
-    D, L, V, S = cfg.d_model, cfg.n_layers, cfg.vocab_size, seq
+    D, L, V, S = mcfg.d_model, mcfg.n_layers, mcfg.vocab_size, seq
     flops_per_sample = 6 * S * (12 * L * D * D + D * V) + 12 * L * S * S * D
     peak_bf16 = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e chip
     mfu = samples_per_sec * flops_per_sample / peak_bf16
@@ -123,9 +152,18 @@ def main() -> None:
                 "extra": {
                     "mfu": round(mfu, 4),
                     "batch": batch,
+                    "remat": remat,
                     "seq": seq,
                     "steps": steps,
                     "loss": float(loss),
+                    "configs_tried": tried,
+                    "vs_baseline_definition": (
+                        "fraction of a 40%-MFU target on this chip's peak "
+                        "bf16 FLOPs (single-chip; self-chosen target). The "
+                        "reference's own headline metric is multi-worker "
+                        "scaling efficiency — see tools/scaling_bench.py "
+                        "for that harness (>=85% north star)."
+                    ),
                 },
             }
         )

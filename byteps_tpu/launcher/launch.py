@@ -23,6 +23,7 @@ Usage:  python -m byteps_tpu.launcher.launch [--] CMD [ARGS...]
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -31,6 +32,104 @@ from typing import Dict, List, Optional
 
 REQUIRED_ENV = ["DMLC_ROLE"]
 WORKER_REQUIRED_ENV = ["DMLC_NUM_WORKER", "DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT"]
+NUMA_PATH = "/sys/devices/system/node"
+
+
+def get_numa_nodes(
+    cpu_mt: bool = True, numa_path: str = NUMA_PATH
+) -> List[List[int]]:
+    """Per-NUMA-node cpu id lists, e.g. [[0..15], [16..31]].
+
+    With ``cpu_mt`` (BYTEPS_MULTITHREADED_CPU, default on) only the first
+    half of each node — the physical cores — is planned; hyperthread
+    siblings are re-added per allocation (launch.py:50-72)."""
+    nodes: List[List[int]] = []
+    if not os.path.isdir(numa_path):
+        return nodes
+    for entry in sorted(os.listdir(numa_path)):
+        if not re.fullmatch(r"node\d+", entry):
+            continue
+        cpu_ids = sorted(
+            int(m.group(1))
+            for item in os.listdir(os.path.join(numa_path, entry))
+            if (m := re.fullmatch(r"cpu(\d+)", item))
+        )
+        if not cpu_ids:
+            continue
+        if cpu_mt:
+            cpu_ids = cpu_ids[: len(cpu_ids) // 2]
+        nodes.append(cpu_ids)
+    return nodes
+
+
+def allocate_cpu(
+    local_size: int,
+    env: Optional[Dict[str, str]] = None,
+    nodes: Optional[List[List[int]]] = None,
+) -> Optional[List[List[int]]]:
+    """Automatic per-process core quotas (allocate_cpu, launch.py:49-141).
+
+    The LAST local process is the root (it runs the aggregation/PS-facing
+    threads) and gets every core the others left — the reference gives the
+    root more cpu for the same reason.  Knobs honored:
+    ``BYTEPS_NUMA_DEFAULT_QUOTA``, ``BYTEPS_NUMA_ROOT_QUOTA``,
+    ``BYTEPS_CPU_BLACKLIST``, ``BYTEPS_MULTITHREADED_CPU``.
+
+    Returns one core list per local rank (hyperthread siblings included
+    when cpu_mt), or None when no NUMA information exists.
+    """
+    env = env if env is not None else dict(os.environ)
+    cpu_mt = env.get("BYTEPS_MULTITHREADED_CPU", "1").lower() in ("1", "true")
+    if nodes is None:
+        nodes = get_numa_nodes(cpu_mt)
+    if not nodes or local_size < 1:
+        return None
+    nodes = [list(n) for n in nodes]
+    cpu_num = sum(len(n) for n in nodes)
+
+    default_quota = int(env.get("BYTEPS_NUMA_DEFAULT_QUOTA", cpu_num // local_size))
+    while default_quota >= 1 and default_quota * local_size > cpu_num:
+        default_quota -= 1
+    root_quota = cpu_num - default_quota * (local_size - 1)
+    if int(env.get("BYTEPS_NUMA_ROOT_QUOTA", "0")):
+        root_quota = int(env["BYTEPS_NUMA_ROOT_QUOTA"])  # explicit wins, unclamped
+    elif local_size > 1:
+        # sharing the host: keep the root NUMA-local like the reference;
+        # a SINGLE process per host (the TPU default) gets every core
+        node_size = len(nodes[0])
+        while root_quota > node_size >= 1:
+            root_quota -= 1
+
+    blacklist = {
+        int(c) for c in env.get("BYTEPS_CPU_BLACKLIST", "-1").split(",") if c
+    }
+    # hyperthread sibling offset: cpu i pairs with i + physical-core count
+    sibling_off = cpu_num
+
+    out: List[List[int]] = []
+    for quota in [default_quota] * (local_size - 1) + [root_quota]:
+        taken: List[int] = []
+        q = max(1, quota)
+        while q > 0:
+            # prefer one NUMA node that satisfies the remaining quota
+            # whole; otherwise drain the largest node and keep filling
+            # from the next (multi-socket quotas span nodes)
+            node = next((n for n in nodes if len(n) >= q), None)
+            if node is None:
+                node = max(nodes, key=len, default=None)
+                if not node:
+                    break
+            grab = min(q, len(node))
+            taken.extend(node[:grab])
+            node[:] = node[grab:]
+            q -= grab
+        alloc = [c for c in taken if c not in blacklist]
+        if cpu_mt:
+            alloc.extend(
+                c + sibling_off for c in taken if c + sibling_off not in blacklist
+            )
+        out.append(alloc)
+    return out
 
 
 def discover_tpu_topology(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
@@ -67,12 +166,20 @@ def check_env(env: Dict[str, str]) -> None:
 
 
 def numa_prefix(env: Dict[str, str]) -> List[str]:
-    """numactl binding for the worker's host threads
-    (allocate_cpu, launch.py:49-141).  Explicit core list only — the
-    per-GPU automatic quota logic has no TPU analogue since there is one
-    process per host."""
+    """numactl binding for the worker's host threads (allocate_cpu,
+    launch.py:49-141): explicit ``BYTEPS_VISIBLE_CPU_CORES`` wins; with
+    ``BYTEPS_NUMA_ON`` (default 1) and NUMA info present, the automatic
+    quota plan binds this local rank's share."""
+    if not shutil.which("numactl"):
+        return []
     cores = env.get("BYTEPS_VISIBLE_CPU_CORES", "")
-    if not cores or not shutil.which("numactl"):
+    if not cores and env.get("BYTEPS_NUMA_ON", "1") == "1":
+        local_size = int(env.get("BYTEPS_LOCAL_SIZE", "1"))
+        local_rank = int(env.get("BYTEPS_LOCAL_RANK", "0"))
+        plan = allocate_cpu(local_size, env)
+        if plan and local_rank < len(plan) and plan[local_rank]:
+            cores = ",".join(str(c) for c in plan[local_rank])
+    if not cores:
         return []
     return ["numactl", f"--physcpubind={cores}"]
 

@@ -130,3 +130,93 @@ class TestLauncher:
         )
         assert out.returncode == 0, out.stderr
         assert out.stdout.strip().endswith("0 worker")
+
+
+class TestNumaAutoQuota:
+    """allocate_cpu (launch.py:49-141 parity): per-process core quotas
+    from NUMA topology, root gets the remainder, knobs honored."""
+
+    def _nodes(self):
+        return [[0, 1, 2, 3], [4, 5, 6, 7]]  # 8 physical cores, 2 nodes
+
+    def test_default_split_root_gets_rest(self):
+        from byteps_tpu.launcher.launch import allocate_cpu
+
+        plan = allocate_cpu(2, env={"BYTEPS_MULTITHREADED_CPU": "0"}, nodes=self._nodes())
+        assert len(plan) == 2
+        # default quota 8//2=4; root gets 8-4=4 (clamped to node size 4)
+        assert plan[0] == [0, 1, 2, 3]
+        assert plan[1] == [4, 5, 6, 7]
+
+    def test_quota_env_override_and_blacklist(self):
+        from byteps_tpu.launcher.launch import allocate_cpu
+
+        plan = allocate_cpu(
+            2,
+            env={
+                "BYTEPS_MULTITHREADED_CPU": "0",
+                "BYTEPS_NUMA_DEFAULT_QUOTA": "2",
+                "BYTEPS_NUMA_ROOT_QUOTA": "3",
+                "BYTEPS_CPU_BLACKLIST": "0",
+            },
+            nodes=self._nodes(),
+        )
+        assert plan[0] == [1]  # quota 2 from node0 minus blacklisted core 0
+        # root quota 3: node0 has only [2,3] left, node1 satisfies it whole
+        assert plan[1] == [4, 5, 6]
+
+    def test_hyperthread_siblings_added(self):
+        from byteps_tpu.launcher.launch import allocate_cpu
+
+        plan = allocate_cpu(1, env={"BYTEPS_MULTITHREADED_CPU": "1"}, nodes=self._nodes())
+        # root gets all 8 physical + 8 sibling ids (offset by core count)
+        assert plan[0][:4] == [0, 1, 2, 3]
+        assert 0 + 8 in plan[0]
+
+    def test_no_numa_info_returns_none(self):
+        from byteps_tpu.launcher.launch import allocate_cpu
+
+        assert allocate_cpu(2, env={}, nodes=[]) is None
+
+    def test_numa_prefix_uses_plan(self, monkeypatch):
+        import byteps_tpu.launcher.launch as launch
+
+        monkeypatch.setattr(launch.shutil, "which", lambda _: "/usr/bin/numactl")
+        monkeypatch.setattr(
+            launch, "get_numa_nodes", lambda cpu_mt=True, numa_path="": [[0, 1], [2, 3]]
+        )
+        env = {"BYTEPS_MULTITHREADED_CPU": "0", "BYTEPS_LOCAL_SIZE": "2",
+               "BYTEPS_LOCAL_RANK": "1"}
+        prefix = launch.numa_prefix(env)
+        assert prefix and prefix[0] == "numactl"
+        assert prefix[1] == "--physcpubind=2,3"
+
+    def test_explicit_cores_win(self, monkeypatch):
+        import byteps_tpu.launcher.launch as launch
+
+        monkeypatch.setattr(launch.shutil, "which", lambda _: "/usr/bin/numactl")
+        env = {"BYTEPS_VISIBLE_CPU_CORES": "5,6"}
+        assert launch.numa_prefix(env) == ["numactl", "--physcpubind=5,6"]
+
+    def test_single_process_gets_all_nodes(self):
+        """local_size=1 (the TPU default: one process per host) must span
+        every NUMA node, not be confined to node 0."""
+        from byteps_tpu.launcher.launch import allocate_cpu
+
+        plan = allocate_cpu(1, env={"BYTEPS_MULTITHREADED_CPU": "0"}, nodes=self._nodes())
+        assert plan[0] == [0, 1, 2, 3, 4, 5, 6, 7]
+
+    def test_quota_spans_nodes_when_needed(self):
+        """A quota larger than any single node fills from multiple nodes."""
+        from byteps_tpu.launcher.launch import allocate_cpu
+
+        plan = allocate_cpu(
+            2,
+            env={"BYTEPS_MULTITHREADED_CPU": "0"},
+            nodes=[[0, 1], [2, 3], [4, 5], [6, 7]],
+        )
+        # non-root quota 8//2=4 > any node's 2 → spans two nodes; the
+        # shared-host root stays NUMA-local (clamped to one node's size,
+        # reference launch.py:119-124)
+        assert plan[0] == [0, 1, 2, 3]
+        assert len(plan[1]) == 2

@@ -1,0 +1,26 @@
+"""Scaling-efficiency harness machinery test (BASELINE.md north-star
+metric exists and measures something sane even on shared-CPU loopback)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+class TestScalingHarness:
+    def test_harness_runs_and_reports(self):
+        out = subprocess.run(
+            [sys.executable, "tools/scaling_bench.py",
+             "--workers", "1,2", "--mbytes", "0.5", "--rounds", "3",
+             "--keys", "8"],
+            capture_output=True, text=True, timeout=240, cwd="/root/repo",
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        line = out.stdout.strip().splitlines()[-1]
+        rec = json.loads(line)
+        assert rec["metric"] == "pushpull_throughput_retention_2w"
+        assert rec["unit"] == "ratio"
+        assert 0.1 < rec["value"] < 3.0
+        assert "round_time_s" in rec["extra"]
+        assert rec["extra"]["round_time_s"]["1"] > 0
