@@ -240,6 +240,46 @@ def _ln(x, s, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * s + b
 
 
+def _qkv_proj(cfg: TransformerConfig, h, lp):
+    """Shared QKV projection (tp-local heads: wq (D, H_local, dh)) —
+    used by the training stage fn AND the cached decoder so the layer
+    math can never diverge between paths."""
+    cdt = cfg.compute_dtype
+    q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(cdt))
+    k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(cdt))
+    v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(cdt))
+    if cfg.attn_bias:
+        q = q + lp["wq_b"].astype(cdt)[None, :, None, :]
+        k = k + lp["wk_b"].astype(cdt)[None, :, None, :]
+        v = v + lp["wv_b"].astype(cdt)[None, :, None, :]
+    return q, k, v
+
+
+def _attn_out(cfg: TransformerConfig, attn, lp, x):
+    """Shared attention output projection + tp row-parallel combine +
+    residual."""
+    cdt = cfg.compute_dtype
+    o = jnp.einsum("bhsk,hkd->bsd", attn, lp["wo"].astype(cdt))
+    o = lax.psum(o, "tp")  # row-parallel combine (free at tp=1)
+    if cfg.attn_bias:
+        o = o + lp["wo_b"].astype(cdt)
+    return x + o.astype(x.dtype)
+
+
+def _dense_mlp(cfg: TransformerConfig, x, lp):
+    """Shared dense MLP block (LN → gelu MLP with tp row-parallel combine
+    → residual)."""
+    cdt = cfg.compute_dtype
+    g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)
+    hmid = jax.nn.gelu(
+        jnp.einsum("bsd,df->bsf", g, lp["w1"].astype(cdt)) + lp["b1"].astype(cdt)
+    )
+    y = jnp.einsum("bsf,fd->bsd", hmid, lp["w2"].astype(cdt))
+    y = lax.psum(y, "tp")  # row-parallel combine
+    y = y + lp["b2"].astype(cdt)
+    return x + y.astype(x.dtype)
+
+
 def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
     sp = mesh.shape.get("sp", 1)
     tp = mesh.shape.get("tp", 1)
@@ -248,14 +288,7 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
     def layer_fn(x, lp):
         # x: (B, S_local, D)
         h = _ln(x, lp["ln1_s"], lp["ln1_b"]).astype(cdt)
-        # tp-local heads: wq (D, H_local, dh)
-        q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(cdt))
-        k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(cdt))
-        v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"].astype(cdt))
-        if cfg.attn_bias:
-            q = q + lp["wq_b"].astype(cdt)[None, :, None, :]
-            k = k + lp["wk_b"].astype(cdt)[None, :, None, :]
-            v = v + lp["wv_b"].astype(cdt)[None, :, None, :]
+        q, k, v = _qkv_proj(cfg, h, lp)
         if sp == 1 and cfg.use_flash:
             from byteps_tpu.ops.flash_attention import flash_attention
 
@@ -265,14 +298,10 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
                 q, k, v, axis_name="sp" if sp > 1 else None, axis_size=sp,
                 causal=cfg.causal,
             )
-        o = jnp.einsum("bhsk,hkd->bsd", attn, lp["wo"].astype(cdt))
-        o = lax.psum(o, "tp")  # row-parallel combine (free at tp=1)
-        if cfg.attn_bias:
-            o = o + lp["wo_b"].astype(cdt)
-        x = x + o.astype(x.dtype)
+        x = _attn_out(cfg, attn, lp, x)
 
-        g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)
         if cfg.moe:
+            g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)
             b_, s_, d_ = g.shape
             flat = g.reshape(b_ * s_, d_)
             y = moe_mlp(
@@ -287,13 +316,10 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
             aux = moe_aux_loss(
                 flat, lp["router"].astype(cdt), sp, lp["ew1"].shape[0]
             )
+            x = x + y.astype(x.dtype)
         else:
-            hmid = jax.nn.gelu(jnp.einsum("bsd,df->bsf", g, lp["w1"].astype(cdt)) + lp["b1"].astype(cdt))
-            y = jnp.einsum("bsf,fd->bsd", hmid, lp["w2"].astype(cdt))
-            y = lax.psum(y, "tp")  # row-parallel combine
-            y = y + lp["b2"].astype(cdt)
+            x = _dense_mlp(cfg, x, lp)
             aux = jnp.zeros((), cdt)
-        x = x + y.astype(x.dtype)
         return x, aux
 
     def stage_fn(stage_params: Dict[str, jax.Array], x: jax.Array):
@@ -504,6 +530,130 @@ def build_generate(cfg: TransformerConfig, mesh: Mesh) -> Callable:
             )
             buf[:, i] = step_logits[:, i - 1, :].argmax(-1)
         return buf[:, : s0 + n_new]
+
+    return generate
+
+
+def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
+    """KV-cached greedy decoding — the TPU-first generation path.
+
+    Unlike :func:`build_generate` (recompute per token), this keeps per-
+    layer K/V caches in HBM and runs the WHOLE decode as one compiled
+    ``lax.scan``: prefill writes the prompt's K/V in a single batched
+    pass, then each scan step embeds one token, attends against the cache
+    (static ``max_seq`` shapes — XLA-friendly), appends its K/V, and emits
+    the argmax.  O(S) attention per new token instead of O(S²) recompute.
+
+    Supported mesh axes: dp (batch) and tp (heads); requires causal
+    config, pp == sp == 1, dense MLP.
+    """
+    if not cfg.causal:
+        raise ValueError("generation requires a causal config")
+    if mesh.shape.get("pp", 1) != 1 or mesh.shape.get("sp", 1) != 1:
+        raise ValueError("cached decoding supports dp/tp meshes (pp=sp=1)")
+    if cfg.moe:
+        raise ValueError("cached decoding does not support MoE yet")
+
+    cdt = cfg.compute_dtype
+    S_max = cfg.max_seq
+
+    def cached_layer(x, lp, kc, vc, offset):
+        """x: (B, s, D); kc/vc: (B, H_local, S_max, dh); returns updated
+        residual stream and caches with positions [offset, offset+s).
+        Projections and MLP are the SAME helpers the training stage uses —
+        only the attention core (cache append + masked full-cache attend)
+        differs."""
+        s = x.shape[1]
+        h = _ln(x, lp["ln1_s"], lp["ln1_b"]).astype(cdt)
+        q, k, v = _qkv_proj(cfg, h, lp)
+        kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), offset, axis=2)
+        vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), offset, axis=2)
+        scores = jnp.einsum("bhsk,bhtk->bhst", q, kc.astype(cdt))
+        scores = scores / np.sqrt(cfg.d_head).astype(cdt)
+        # query i (absolute offset+i) may see cache positions t <= offset+i
+        t_idx = jnp.arange(S_max)
+        i_idx = offset + jnp.arange(s)
+        mask = t_idx[None, :] <= i_idx[:, None]  # (s, S_max)
+        scores = jnp.where(mask[None, None], scores, jnp.asarray(-1e30, cdt))
+        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cdt)
+        ctx = jnp.einsum("bhst,bhtk->bhsk", attn, vc.astype(cdt))
+        x = _attn_out(cfg, ctx, lp, x)
+        return _dense_mlp(cfg, x, lp), kc, vc
+
+    def run_layers(stage_params, x, kcs, vcs, offset):
+        """scan the layer stack; kcs/vcs leading dim = layers."""
+
+        def body(carry, inp):
+            xc = carry
+            lp, kc, vc = inp
+            xc, kc, vc = cached_layer(xc, lp, kc, vc, offset)
+            return xc, (kc, vc)
+
+        x, (kcs, vcs) = lax.scan(body, x, (stage_params, kcs, vcs))
+        return x, kcs, vcs
+
+    def logits_of(params, x):
+        h = _ln(x, params["ln_f_s"], params["ln_f_b"]).astype(cdt)
+        return jnp.einsum("bsd,dv->bsv", h, params["head"].astype(cdt))
+
+    def gen_fn(params, tokens, n_new: int):
+        """tokens: (B_local, s0) EQUAL-LENGTH prompts (no padding support:
+        prefill reads the last column's logits and the cache mask is
+        position-only); returns (B_local, n_new)."""
+        stage_params = {k: v[0] for k, v in params.items() if _is_layer_param(k)}
+        b, s0 = tokens.shape
+        L = cfg.n_layers
+        h_local = stage_params["wq"].shape[2]  # tp-local head count
+        kcs = jnp.zeros((L, b, h_local, S_max, cfg.d_head), cdt)
+        vcs = jnp.zeros_like(kcs)
+
+        # prefill: one batched pass over the prompt
+        positions = jnp.arange(s0)
+        x = params["embed"][tokens] + params["pos"][positions]
+        x, kcs, vcs = run_layers(stage_params, x.astype(cdt), kcs, vcs, 0)
+        last = jnp.argmax(logits_of(params, x)[:, -1, :], axis=-1).astype(jnp.int32)
+
+        def step(carry, _):
+            kcs, vcs, tok, pos = carry
+            x = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cdt)
+            x, kcs, vcs = run_layers(stage_params, x, kcs, vcs, pos)
+            nxt = jnp.argmax(logits_of(params, x)[:, -1, :], axis=-1).astype(jnp.int32)
+            return (kcs, vcs, nxt, pos + 1), tok
+
+        # step k consumes g_k and computes g_{k+1}; emitting the consumed
+        # token makes toks exactly [g_1 .. g_n] (the final compute is spare)
+        _, toks = lax.scan(
+            step, (kcs, vcs, last, jnp.asarray(s0, jnp.int32)), None,
+            length=n_new,
+        )
+        return toks.T  # (B_local, n_new)
+
+    specs = param_specs(cfg)
+
+    import functools
+
+    @functools.lru_cache(maxsize=16)
+    def _compiled(n_new: int):
+        # jit handles prompt-shape (s0) caching; only n_new (a Python loop
+        # bound) needs a distinct traced program
+        return jax.jit(
+            jax.shard_map(
+                lambda p, t: gen_fn(p, t, n_new),
+                mesh=mesh,
+                in_specs=(specs, P("dp")),
+                out_specs=P("dp"),
+                check_vma=False,
+            )
+        )
+
+    def generate(params, prompt: np.ndarray, n_new: int) -> np.ndarray:
+        """prompt: (B, s0) EQUAL-LENGTH prompts, B divisible by dp."""
+        prompt = np.asarray(prompt, dtype=np.int32)
+        b, s0 = prompt.shape
+        if s0 + n_new > S_max:
+            raise ValueError(f"{s0}+{n_new} exceeds max_seq {S_max}")
+        new = np.asarray(_compiled(n_new)(params, jnp.asarray(prompt)))
+        return np.concatenate([prompt, new], axis=1)
 
     return generate
 
