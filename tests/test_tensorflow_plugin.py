@@ -144,3 +144,149 @@ class TestTFDistributedOptimizer:
         with pytest.raises(ValueError, match="keras optimizer"):
             bps.DistributedOptimizer(object())
         bps.shutdown()
+
+
+class TestTFAsyncMode:
+    def test_async_parameter_store_training(self, monkeypatch):
+        """BYTEPS_ENABLE_ASYNC: apply_gradients applies locally, then
+        pushes weight DELTAS to the parameter store and adopts the pulled
+        values (tensorflow/__init__.py:244-268 semantics). Single worker:
+        store = sum of deltas = current weights, so training must proceed
+        exactly like the bare optimizer."""
+        import threading
+
+        from byteps_tpu.common.config import Config
+        from byteps_tpu.comm.rendezvous import Scheduler
+        from byteps_tpu.server.server import PSServer
+
+        monkeypatch.setenv("BYTEPS_ENABLE_ASYNC", "1")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+        try:
+            bps.init()
+            # the async sync is gated on size() > 1 (single workers have
+            # nothing to exchange); force the gate open so the delta-push/
+            # pull path actually runs — with ONE real worker the store is
+            # exactly the sum of its deltas, so training must match the
+            # bare optimizer step for step
+            monkeypatch.setattr(bps, "size", lambda: 2)
+            x, y = _data(5)
+            m = _model(seed=5)
+            m_ref = _model(seed=5)
+            m.build((None, 8))
+            m_ref.build((None, 8))
+            for v, vr in zip(m.weights, m_ref.weights):
+                v.assign(vr)
+            opt = bps.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+            opt_ref = tf.keras.optimizers.SGD(0.05)
+            for _ in range(4):
+                with tf.GradientTape() as t:
+                    loss = tf.reduce_mean((m(x) - y) ** 2)
+                opt.apply_gradients(
+                    zip(t.gradient(loss, m.trainable_variables),
+                        m.trainable_variables)
+                )
+                with tf.GradientTape() as tr:
+                    loss_r = tf.reduce_mean((m_ref(x) - y) ** 2)
+                opt_ref.apply_gradients(
+                    zip(tr.gradient(loss_r, m_ref.trainable_variables),
+                        m_ref.trainable_variables)
+                )
+            for v, vr in zip(m.weights, m_ref.weights):
+                np.testing.assert_allclose(
+                    np.asarray(v), np.asarray(vr), rtol=1e-5, atol=1e-6
+                )
+            bps.shutdown()
+        finally:
+            srv.stop()
+            sched.stop()
+
+
+_TF_WORKER_SCRIPT = '''
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import tensorflow as tf
+import byteps_tpu.tensorflow as bps
+
+bps.init()
+r = int(os.environ["BYTEPS_GLOBAL_RANK"])
+# cross-worker average through the TF custom-gradient op
+out = bps.push_pull(tf.constant([float(r + 1)] * 8), name="tfmw.g")
+assert np.allclose(np.asarray(out), 1.5), out  # (1+2)/2
+# and through the optimizer wrap: both workers step by the AVERAGED grad
+v = tf.Variable(tf.zeros(4))
+opt = bps.DistributedOptimizer(tf.keras.optimizers.SGD(1.0), scope=f"mw")
+grad = tf.constant([float(r + 1)] * 4)
+opt.apply_gradients([(grad, v)])
+assert np.allclose(np.asarray(v), -1.5), np.asarray(v)
+bps.shutdown()
+print(f"TF_WORKER_{r}_OK")
+'''
+
+
+class TestTFMultiWorker:
+    def test_two_workers_average(self, tmp_path):
+        """2 TF workers push different gradients; both must apply the
+        cross-worker average — the whole plugin stack over the real PS."""
+        import os
+        import subprocess
+        import sys
+        import threading
+
+        from byteps_tpu.common.config import Config
+        from byteps_tpu.comm.rendezvous import Scheduler
+        from byteps_tpu.server.server import PSServer
+
+        sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
+        sched.start()
+        env_common = {
+            **os.environ,
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "1",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": "/root/repo",
+        }
+        scfg = Config.from_env()
+        scfg.num_worker = 2
+        scfg.num_server = 1
+        scfg.ps_root_uri = "127.0.0.1"
+        scfg.ps_root_port = sched.port
+        srv = PSServer(scfg)
+        threading.Thread(target=srv.start, daemon=True).start()
+        script = tmp_path / "tf_worker.py"
+        script.write_text(_TF_WORKER_SCRIPT)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env={**env_common, "BYTEPS_GLOBAL_RANK": str(i)},
+                cwd="/root/repo",
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        try:
+            outs = [p.communicate(timeout=300)[0] for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            srv.stop()
+            sched.stop()
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"tf worker {i} failed:\n{out}"
+        combined = "".join(outs)
+        assert "TF_WORKER_0_OK" in combined and "TF_WORKER_1_OK" in combined
