@@ -2,23 +2,40 @@
 
 The reference ships one thin plugin per framework (torch/tensorflow/
 mxnet/keras, SURVEY §2.5); JAX-side the native API already covers flax
-and raw-jax users, and this module gives haiku users the same one-liner
-surface:
+and raw-jax users, and this module gives haiku users the same surface:
 
-    params = hk.transform(net).init(rng, x)
+    net = hk.transform_with_state(forward)
+    params, state = net.init(rng, x)
     params = byteps_tpu.haiku_plugin.broadcast_parameters(params)
-    step = byteps_tpu.haiku_plugin.build_train_step(loss_fn, optax.adam(1e-3))
+    step = byteps_tpu.haiku_plugin.build_stateful_train_step(
+        net.apply, loss_from_out, optax.adam(1e-3))
+    (params, state), opt_state, loss = step((params, state), opt_state,
+                                            rng, batch)
+
+``build_stateful_train_step`` handles ``hk.transform_with_state``
+networks (BatchNorm / moving averages): gradients AND the updated haiku
+state are pmean'd over the dp axis — cross-replica statistics, the same
+semantics as the flax variant in :mod:`byteps_tpu.optim`.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable, Optional, Tuple
 
+import jax
 import optax
+from jax import lax
+from jax.sharding import Mesh
 
 from byteps_tpu.api import broadcast_parameters  # noqa: F401 (re-export)
 from byteps_tpu.comm.mesh import DP_AXIS
-from byteps_tpu.optim import build_data_parallel_step, distributed_optimizer
+from byteps_tpu.optim import (
+    _compile_spmd_step,
+    _ddp_apply,
+    _pmean_float_leaves,
+    build_data_parallel_step,
+    distributed_optimizer,
+)
 
 
 def DistributedOptimizer(
@@ -37,5 +54,49 @@ def build_train_step(
     mesh=None,
     donate: bool = True,
 ) -> Callable:
-    """DDP step for a haiku apply-based ``loss_fn(params, batch)``."""
+    """DDP step for a stateless ``hk.transform`` model:
+    ``loss_fn(params, batch)`` scalar loss."""
     return build_data_parallel_step(loss_fn, optimizer, mesh=mesh, donate=donate)
+
+
+def build_stateful_train_step(
+    apply_fn: Callable,
+    loss_from_out: Callable[[Any, Any], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = DP_AXIS,
+    donate: bool = True,
+) -> Callable:
+    """DDP step for ``hk.transform_with_state`` models (BatchNorm-class
+    mutable state).
+
+    ``step((params, state), opt_state, rng, batch)`` →
+    ``((params, state), opt_state, loss)``.  ``apply_fn`` is
+    ``net.apply(params, state, rng, x) -> (out, new_state)``; gradients
+    and the new state are pmean'd over the dp axis so every replica holds
+    identical cross-replica statistics.
+    """
+
+    def local_step(bundle: Tuple[Any, Any], opt_state, rng, batch):
+        params, state = bundle
+        x, y = batch
+        # per-replica rng: each dp shard must draw INDEPENDENT dropout/
+        # noise masks for its examples, not replicate one mask pattern
+        rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+
+        def loss_fn(p):
+            out, new_state = apply_fn(p, state, rng, x)
+            return loss_from_out(out, y), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # cross-replica statistics: float leaves pmean'd, integer leaves
+        # (EMA counters) pass through with their dtype intact
+        new_state = _pmean_float_leaves(new_state, axis_name)
+        params, opt_state, loss = _ddp_apply(
+            grads, loss, params, opt_state, optimizer, axis_name
+        )
+        return (params, new_state), opt_state, loss
+
+    return _compile_spmd_step(
+        local_step, mesh, axis_name, donate, extra_replicated_args=1
+    )

@@ -116,21 +116,48 @@ class DistributedOptimizer:
         return self._tx
 
 
+def _pmean_float_leaves(tree, axis_name: str):
+    """pmean floating-point leaves; integer leaves (EMA counters, step
+    counts) pass through unchanged — pmean's division would silently
+    promote them to float and force a retrace on the next step."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda s: lax.pmean(s, axis_name)
+        if jnp.issubdtype(jnp.asarray(s).dtype, jnp.inexact)
+        else s,
+        tree,
+    )
+
+
+def _ddp_apply(grads, loss, params, opt_state, optimizer, axis_name: str):
+    """The shared DDP update tail: all-reduce grads + loss over the data
+    axis, update, apply — one copy for every step builder."""
+    grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)
+    loss = lax.pmean(loss, axis_name)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
 def _compile_spmd_step(
     local_step: Callable,
     mesh: Optional[Mesh],
     axis_name: str,
     donate: bool,
+    extra_replicated_args: int = 0,
 ) -> Callable:
     """Shared tail for the DDP step builders: shard_map over (replicated
-    state, replicated opt_state, dp-sharded batch) then jit with donation."""
+    state, replicated opt_state, [extra replicated args,] dp-sharded batch)
+    then jit with donation."""
     mesh = mesh or get_global_mesh()
     if mesh is None:
         raise RuntimeError("no global mesh; call byteps_tpu.init() or pass mesh=")
+    extra = tuple(P() for _ in range(extra_replicated_args))
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name)),
+        in_specs=(P(), P(), *extra, P(axis_name)),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
@@ -155,13 +182,7 @@ def build_data_parallel_step(
 
     def local_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        grads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, axis_name), grads
-        )
-        loss = lax.pmean(loss, axis_name)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return _ddp_apply(grads, loss, params, opt_state, optimizer, axis_name)
 
     return _compile_spmd_step(local_step, mesh, axis_name, donate)
 
@@ -291,13 +312,10 @@ def build_flax_data_parallel_step(
             return loss_from_logits(out, y), mutated
 
         (loss, mutated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)
-        loss = lax.pmean(loss, axis_name)
-        new_stats = jax.tree_util.tree_map(
-            lambda s: lax.pmean(s, axis_name), mutated.get("batch_stats", {})
+        new_stats = _pmean_float_leaves(mutated.get("batch_stats", {}), axis_name)
+        params, opt_state, loss = _ddp_apply(
+            grads, loss, params, opt_state, optimizer, axis_name
         )
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
         variables = {"params": params, **rest}
         if new_stats:
             variables["batch_stats"] = new_stats

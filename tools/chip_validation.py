@@ -159,6 +159,12 @@ def main() -> int:
     import jax
 
     print("devices:", jax.devices())
+    if jax.devices()[0].platform != "tpu":
+        # off-TPU both Pallas paths silently take their fallbacks — a
+        # passing run here would validate nothing
+        print("ERROR: not on TPU; the kernels under validation would "
+              "silently fall back. Aborting.", file=sys.stderr)
+        return 2
     check_flash_forward()
     check_flash_backward()
     check_onebit_device()
