@@ -64,6 +64,17 @@ class TransformerConfig:
     # use the Pallas flash-attention kernel for the per-device attention
     # when sequence parallelism is off (ring attention otherwise)
     use_flash: bool = True
+    # sequence-parallel strategy when sp > 1: "ring" (ppermute KV blocks,
+    # any head count) or "ulysses" (all-to-all head/seq reshard, needs
+    # tp-local heads divisible by sp)
+    seq_parallel_impl: str = "ring"
+
+    def __post_init__(self):
+        if self.seq_parallel_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown seq_parallel_impl {self.seq_parallel_impl!r}; "
+                "expected 'ring' or 'ulysses'"
+            )
     # qkv/proj bias terms (GPT-2-style checkpoints have them; BERT too)
     attn_bias: bool = False
 
@@ -293,6 +304,12 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
             from byteps_tpu.ops.flash_attention import flash_attention
 
             attn = flash_attention(q, k, v, causal=cfg.causal)
+        elif sp > 1 and cfg.seq_parallel_impl == "ulysses":
+            from byteps_tpu.parallel.ulysses import ulysses_attention
+
+            attn = ulysses_attention(
+                q, k, v, axis_name="sp", axis_size=sp, causal=cfg.causal
+            )
         else:
             attn = ring_attention(
                 q, k, v, axis_name="sp" if sp > 1 else None, axis_size=sp,

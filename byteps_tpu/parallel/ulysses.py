@@ -1,0 +1,76 @@
+"""DeepSpeed-Ulysses-style all-to-all sequence parallelism.
+
+The second first-class long-context strategy next to ring attention
+(SURVEY §5.7 is new scope; the task charter names both).  Where ring
+attention rotates KV blocks around the ``sp`` axis (P2P ppermute, O(axis)
+steps), Ulysses re-shards ONCE per attention call with all-to-all
+collectives:
+
+    (B, H, S/a, dh)  --all_to_all-->  (B, H/a, S, dh)
+        heads sharded, sequence gathered → each device runs FULL-sequence
+        attention over its head slice (dense or flash — any kernel works
+        unchanged, including causal masking, with no cross-block merge)
+    (B, H/a, S, dh)  --all_to_all-->  (B, H, S/a, dh)
+
+Trade-off vs ring: two all-to-alls of the whole activation per call
+instead of axis_size ppermutes of KV — fewer, larger ICI transfers and
+no online-softmax merge state, but it requires n_heads % axis_size == 0
+and peak memory holds the full sequence per device.  On TPU both ride
+ICI; which wins depends on S, H and the slice topology, so the
+transformer exposes ``seq_parallel_impl`` to pick per model.
+
+Differentiable for free: ``lax.all_to_all`` has a transpose rule, so
+jax.grad runs the mirrored all-to-alls in backward.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from byteps_tpu.ops.flash_attention import flash_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str] = "sp",
+    axis_size: int = 1,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """All-to-all sequence-parallel attention.
+
+    q/k/v: (B, H_local, S_local, dh) with the sequence sharded over
+    ``axis_name``; returns the same layout.  Requires
+    ``H_local % axis_size == 0``.
+
+    The gathered slice is a plain full-sequence attention call, so the
+    per-device kernel is :func:`flash_attention` — Pallas blocks on TPU,
+    the float32-softmax dense reference elsewhere; no Ulysses-specific
+    attention math to keep in sync.
+    """
+    if axis_size == 1 or axis_name is None:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    h_local = q.shape[1]
+    if h_local % axis_size:
+        raise ValueError(
+            f"ulysses needs heads ({h_local}) divisible by the sp axis "
+            f"({axis_size}); use ring attention for this shape"
+        )
+
+    def seq_gather(x):
+        # (B, H, S/a, dh) → (B, H/a, S, dh): scatter heads, gather sequence
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def seq_scatter(x):
+        # (B, H/a, S, dh) → (B, H, S/a, dh)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qg, kg, vg = seq_gather(q), seq_gather(k), seq_gather(v)
+    out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+    return seq_scatter(out)
