@@ -170,6 +170,7 @@ def build_data_parallel_step(
     mesh: Optional[Mesh] = None,
     axis_name: str = DP_AXIS,
     donate: bool = True,
+    accumulate_steps: int = 1,
 ) -> Callable:
     """DistributedDataParallel equivalent (parallel/distributed.py:13-287).
 
@@ -178,13 +179,38 @@ def build_data_parallel_step(
     params replicated, grads all-reduced over ICI, optimizer applied
     redundantly per member (cheap, keeps params replicated without a
     broadcast).
-    """
 
-    def local_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        return _ddp_apply(grads, loss, params, opt_state, optimizer, axis_name)
+    ``accumulate_steps > 1`` is the reference's ``backward_passes_per_step``
+    (torch/__init__.py:108-124): gradients accumulate LOCALLY for N calls
+    and the cross-replica all-reduce + optimizer apply happen only on the
+    Nth (the allreduce rides INSIDE optax.MultiSteps, so N−1 of every N
+    gradient volumes never touch ICI — the whole point of delayed sync).
+    opt_state must then be built from the returned step's ``optimizer``
+    attribute (``step.optimizer.init(params)``)."""
+    if accumulate_steps > 1:
+        optimizer = optax.MultiSteps(
+            distributed_optimizer(optimizer, (axis_name,), average=True),
+            every_k_schedule=accumulate_steps,
+        )
 
-    return _compile_spmd_step(local_step, mesh, axis_name, donate)
+        def local_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss = lax.pmean(loss, axis_name)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+    else:
+
+        def local_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            return _ddp_apply(grads, loss, params, opt_state, optimizer, axis_name)
+
+    step = _compile_spmd_step(local_step, mesh, axis_name, donate)
+    # the (possibly MultiSteps-wrapped) transformation whose .init builds
+    # a matching opt_state
+    step.optimizer = optimizer
+    return step
 
 
 def build_zero1_step(

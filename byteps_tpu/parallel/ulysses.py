@@ -63,14 +63,15 @@ def ulysses_attention(
             f"({axis_size}); use ring attention for this shape"
         )
 
-    def seq_gather(x):
-        # (B, H, S/a, dh) → (B, H/a, S, dh): scatter heads, gather sequence
-        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    import jax.numpy as jnp
 
-    def seq_scatter(x):
-        # (B, H/a, S, dh) → (B, H, S/a, dh)
-        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
-
-    qg, kg, vg = seq_gather(q), seq_gather(k), seq_gather(v)
+    # ONE gather collective for q/k/v (stacked) + one scatter for the
+    # output — the "two all-to-alls per call" cost model the strategy is
+    # chosen for.  Stacked layout: (3, B, H, S/a, dh); head/seq axes shift
+    # by one.
+    qkv = jnp.stack((q, k, v))
+    qkv = lax.all_to_all(qkv, axis_name, split_axis=2, concat_axis=3, tiled=True)
+    qg, kg, vg = qkv[0], qkv[1], qkv[2]
     out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
-    return seq_scatter(out)
+    # (B, H/a, S, dh) → (B, H, S/a, dh)
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)

@@ -93,3 +93,40 @@ class TestDDPStep:
             params, opt_state, loss = step(params, opt_state, (x, y))
         assert float(loss) < 1e-2
         np.testing.assert_allclose(np.asarray(params["w"]), w_true, atol=0.1)
+
+
+class TestGradientAccumulation:
+    def test_accumulate_steps_matches_mean_grad(self, mesh8):
+        """accumulate_steps=2 (backward_passes_per_step parity): params
+        move only on the 2nd call, by the MEAN of both micro-batch grads."""
+        import optax
+
+        from byteps_tpu.optim import build_data_parallel_step
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        rng = np.random.default_rng(0)
+        w0 = jnp.asarray(rng.normal(size=(8, 1)).astype(np.float32))
+        params = {"w": w0}
+        b1 = (jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32)),
+              jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32)))
+        b2 = (jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32)),
+              jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32)))
+
+        step = build_data_parallel_step(
+            loss_fn, optax.sgd(0.1), mesh=mesh8, donate=False,
+            accumulate_steps=2,
+        )
+        opt_state = jax.jit(step.optimizer.init)(params)
+        p1, opt_state, _ = step(params, opt_state, b1)
+        np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(w0))  # no move
+        p2, opt_state, _ = step(p1, opt_state, b2)
+
+        g1 = jax.grad(loss_fn)({"w": w0}, b1)["w"]
+        g2 = jax.grad(loss_fn)({"w": w0}, b2)["w"]
+        expected = w0 - 0.1 * (g1 + g2) / 2
+        np.testing.assert_allclose(
+            np.asarray(p2["w"]), np.asarray(expected), rtol=1e-5, atol=1e-6
+        )
