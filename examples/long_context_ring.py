@@ -38,6 +38,9 @@ def main():
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--dh", type=int, default=64)
     ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--impl", choices=["ring", "ulysses"], default="ring",
+                    help="sequence-parallel strategy (ulysses needs "
+                    "heads divisible by the device count)")
     args = ap.parse_args()
 
     devices = jax.devices()
@@ -46,7 +49,7 @@ def main():
         raise SystemExit(f"--seq must divide the {sp}-device ring")
     mesh = Mesh(np.array(devices), ("sp",))
     s_local = args.seq // sp
-    print(f"ring of {sp} devices, {args.seq} total tokens, {s_local}/device")
+    print(f"{args.impl} over {sp} devices, {args.seq} total tokens, {s_local}/device")
 
     rng = np.random.default_rng(0)
     shape = (args.batch, args.heads, args.seq, args.dh)
@@ -54,9 +57,15 @@ def main():
     k = jnp.asarray(rng.normal(size=shape).astype(np.float32))
     v = jnp.asarray(rng.normal(size=shape).astype(np.float32))
 
+    if args.impl == "ulysses":
+        from byteps_tpu.parallel.ulysses import ulysses_attention
+
+        attend = lambda q, k, v: ulysses_attention(q, k, v, "sp", sp, causal=True)  # noqa: E731
+    else:
+        attend = lambda q, k, v: ring_attention(q, k, v, "sp", sp, causal=True)  # noqa: E731
     fn = jax.jit(
         jax.shard_map(
-            lambda q, k, v: ring_attention(q, k, v, "sp", sp, causal=True),
+            attend,
             mesh=mesh,
             in_specs=(P(None, None, "sp"),) * 3,
             out_specs=P(None, None, "sp"),
@@ -69,7 +78,7 @@ def main():
     out = fn(q, k, v)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
-    print(f"ring attention: {dt * 1e3:.1f} ms/step, output {out.shape}")
+    print(f"{args.impl} attention: {dt * 1e3:.1f} ms/step, output {out.shape}")
 
     # spot-check against dense attention on the gathered sequence
     scores = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) / np.sqrt(args.dh)
