@@ -111,7 +111,9 @@ class RandomKCompressor(Compressor):
             ln = lib.bps_randomk_compress(_ptr(grad), n, k, self.s0, self.s1, _ptr(out))
             return out[:ln].tobytes()
         rng = XorShift128Plus(self.s0, self.s1)
-        idx = np.array([rng.next() % n for _ in range(k)], dtype=np.int32)
+        idx = np.fromiter(
+            (rng.next() % n for _ in range(k)), dtype=np.int64, count=k
+        ).astype(np.int32)
         rec = np.empty(k, dtype=[("i", "<i4"), ("v", "<f4")])
         rec["i"] = idx
         rec["v"] = grad[idx]
