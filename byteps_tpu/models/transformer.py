@@ -529,9 +529,11 @@ def build_generate(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         b, s0 = prompt.shape
         if s0 + n_new > cfg.max_seq:
             raise ValueError(f"{s0}+{n_new} exceeds max_seq {cfg.max_seq}")
+        dp = mesh.shape.get("dp", 1)
+        if b % dp:
+            raise ValueError(f"batch {b} not divisible by dp={dp}")
         buf = np.zeros((b, cfg.max_seq), dtype=np.int32)
         buf[:, :s0] = prompt
-        dp = mesh.shape.get("dp", 1)
         for i in range(s0, s0 + n_new):
             logits = fwd(params, jnp.asarray(buf))  # (M, dp*Bmb, S, V)
             arr = np.asarray(logits)
@@ -669,6 +671,9 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         b, s0 = prompt.shape
         if s0 + n_new > S_max:
             raise ValueError(f"{s0}+{n_new} exceeds max_seq {S_max}")
+        dp = mesh.shape.get("dp", 1)
+        if b % dp:
+            raise ValueError(f"batch {b} not divisible by dp={dp}")
         new = np.asarray(_compiled(n_new)(params, jnp.asarray(prompt)))
         return np.concatenate([prompt, new], axis=1)
 
