@@ -615,10 +615,16 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         h = _ln(x, params["ln_f_s"], params["ln_f_b"]).astype(cdt)
         return jnp.einsum("bsd,dv->bsv", h, params["head"].astype(cdt))
 
-    def gen_fn(params, tokens, n_new: int):
+    def gen_fn(params, tokens, temperature, key, n_new: int,
+               sampling: bool = False, top_k: int = 0):
         """tokens: (B_local, s0) EQUAL-LENGTH prompts (no padding support:
         prefill reads the last column's logits and the cache mask is
-        position-only); returns (B_local, n_new)."""
+        position-only); returns (B_local, n_new).
+
+        ``sampling``/``top_k`` are trace-static (they change the program
+        structure); ``temperature`` and the PRNG ``key`` are RUNTIME values
+        so new seeds/temperatures reuse the compiled program.  Keys fold
+        per step AND per dp shard so every row draws independently."""
         stage_params = {k: v[0] for k, v in params.items() if _is_layer_param(k)}
         b, s0 = tokens.shape
         L = cfg.n_layers
@@ -627,23 +633,38 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         vcs = jnp.zeros_like(kcs)
 
         # prefill: one batched pass over the prompt
+        base_key = jax.random.fold_in(key, lax.axis_index("dp"))
+
+        def pick(step_logits, step_idx):
+            """(B, V) logits → (B,) next tokens."""
+            if not sampling:
+                return jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+            scaled = step_logits.astype(jnp.float32) / temperature
+            if top_k > 0:
+                # k-th largest as threshold via partial selection — a full
+                # vocab sort per decoded token would dominate the hot path
+                kth = lax.top_k(scaled, top_k)[0][:, -1:]
+                scaled = jnp.where(scaled >= kth, scaled, -1e30)
+            step_key = jax.random.fold_in(base_key, step_idx)
+            return jax.random.categorical(step_key, scaled, axis=-1).astype(jnp.int32)
+
         positions = jnp.arange(s0)
         x = params["embed"][tokens] + params["pos"][positions]
         x, kcs, vcs = run_layers(stage_params, x.astype(cdt), kcs, vcs, 0)
-        last = jnp.argmax(logits_of(params, x)[:, -1, :], axis=-1).astype(jnp.int32)
+        last = pick(logits_of(params, x)[:, -1, :], 0)
 
-        def step(carry, _):
+        def step(carry, i):
             kcs, vcs, tok, pos = carry
             x = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cdt)
             x, kcs, vcs = run_layers(stage_params, x, kcs, vcs, pos)
-            nxt = jnp.argmax(logits_of(params, x)[:, -1, :], axis=-1).astype(jnp.int32)
+            nxt = pick(logits_of(params, x)[:, -1, :], i + 1)
             return (kcs, vcs, nxt, pos + 1), tok
 
         # step k consumes g_k and computes g_{k+1}; emitting the consumed
         # token makes toks exactly [g_1 .. g_n] (the final compute is spare)
         _, toks = lax.scan(
-            step, (kcs, vcs, last, jnp.asarray(s0, jnp.int32)), None,
-            length=n_new,
+            step, (kcs, vcs, last, jnp.asarray(s0, jnp.int32)),
+            jnp.arange(n_new),
         )
         return toks.T  # (B_local, n_new)
 
@@ -652,21 +673,36 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
     import functools
 
     @functools.lru_cache(maxsize=16)
-    def _compiled(n_new: int):
-        # jit handles prompt-shape (s0) caching; only n_new (a Python loop
-        # bound) needs a distinct traced program
+    def _compiled(n_new: int, sampling: bool, top_k: int):
+        # jit handles prompt-shape (s0) caching; only program STRUCTURE
+        # (n_new, greedy-vs-sampling, top_k width) keys distinct compiles —
+        # seed and temperature are runtime inputs
         return jax.jit(
             jax.shard_map(
-                lambda p, t: gen_fn(p, t, n_new),
+                lambda p, t, temp, key: gen_fn(
+                    p, t, temp, key, n_new, sampling, top_k
+                ),
                 mesh=mesh,
-                in_specs=(specs, P("dp")),
+                in_specs=(specs, P("dp"), P(), P()),
                 out_specs=P("dp"),
                 check_vma=False,
             )
         )
 
-    def generate(params, prompt: np.ndarray, n_new: int) -> np.ndarray:
-        """prompt: (B, s0) EQUAL-LENGTH prompts, B divisible by dp."""
+    def generate(
+        params,
+        prompt: np.ndarray,
+        n_new: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """prompt: (B, s0) EQUAL-LENGTH prompts, B divisible by dp.
+
+        ``temperature == 0`` (default) decodes greedily; ``temperature > 0``
+        samples, optionally truncated to the ``top_k`` most likely tokens,
+        deterministically for a given ``seed``.  Changing seed or
+        temperature reuses the compiled program."""
         prompt = np.asarray(prompt, dtype=np.int32)
         b, s0 = prompt.shape
         if s0 + n_new > S_max:
@@ -674,7 +710,17 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         dp = mesh.shape.get("dp", 1)
         if b % dp:
             raise ValueError(f"batch {b} not divisible by dp={dp}")
-        new = np.asarray(_compiled(n_new)(params, jnp.asarray(prompt)))
+        if top_k > cfg.vocab_size:
+            raise ValueError(f"top_k={top_k} exceeds vocab_size {cfg.vocab_size}")
+        sampling = temperature > 0.0
+        new = np.asarray(
+            _compiled(n_new, sampling, int(top_k) if sampling else 0)(
+                params,
+                jnp.asarray(prompt),
+                jnp.asarray(max(float(temperature), 1e-9), jnp.float32),
+                jax.random.PRNGKey(int(seed)),
+            )
+        )
         return np.concatenate([prompt, new], axis=1)
 
     return generate

@@ -141,3 +141,27 @@ class TestGeneration:
             shard_params(params_np, cfg, mesh4), prompt, n_new=6
         )
         np.testing.assert_array_equal(g1, g4)
+
+    def test_kv_cached_sampling(self, gpt2_small):
+        """temperature=0 equals greedy; temperature>0 is deterministic per
+        seed, varies across seeds, and top_k=1 collapses back to greedy."""
+        from byteps_tpu.models.transformer import build_generate_cached
+
+        cfg, params_np = load_gpt2_weights(gpt2_small)
+        mesh = make_training_mesh(1, {"dp": 1, "pp": 1, "sp": 1, "tp": 1})
+        params = shard_params(params_np, cfg, mesh)
+        gen = build_generate_cached(cfg, mesh)
+        prompt = np.array([[5, 17, 42, 7], [9, 3, 88, 21]], dtype=np.int32)
+
+        greedy = gen(params, prompt, 8)
+        np.testing.assert_array_equal(gen(params, prompt, 8, temperature=0.0), greedy)
+        # top_k=1 at any temperature keeps only the argmax token
+        np.testing.assert_array_equal(
+            gen(params, prompt, 8, temperature=1.5, top_k=1, seed=3), greedy
+        )
+        s1 = gen(params, prompt, 8, temperature=1.0, seed=1)
+        s1b = gen(params, prompt, 8, temperature=1.0, seed=1)
+        s2 = gen(params, prompt, 8, temperature=1.0, seed=2)
+        np.testing.assert_array_equal(s1, s1b)  # deterministic per seed
+        assert not np.array_equal(s1, s2)  # seeds differ
+        assert s1.max() < cfg.vocab_size and s1.min() >= 0
