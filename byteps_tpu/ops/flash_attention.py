@@ -33,6 +33,13 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# TPU vector lanes. Per-row softmax state (m, l, lse, delta) is carried
+# broadcast across a trailing LANES dim so every block-mapped ref keeps its
+# last two dims (8, 128)-tileable — a (bh, s) residual with (1, bq) blocks
+# fails Mosaic's block-mapping check (the same layout jax's bundled TPU
+# flash kernel uses for its l/m residuals).
+LANES = 128
+
 
 def _dense_reference(q, k, v, causal, scale):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -86,23 +93,23 @@ def _fwd_kernel_factory(dh, bq, bk, nk, causal, scale):
             )
             if causal:
                 s = jnp.where(_causal_keep(qi, j, bq, bk), s, NEG_INF)
-            m = m_scr[:, 0]
-            l = l_scr[:, 0]
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            m = m_scr[:]  # (bq, LANES), value broadcast across lanes
+            l = l_scr[:]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new[:, None])
-            m_scr[:, 0] = m_new
-            l_scr[:, 0] = l * alpha + jnp.sum(p, axis=-1)
-            acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p = jnp.exp(s - m_new[:, 0:1])
+            m_scr[:] = m_new
+            l_scr[:] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[:] = acc_scr[:] * alpha[:, 0:1] + jax.lax.dot_general(
                 p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
             )
 
         @pl.when(j == nk - 1)
         def _emit():
-            l = l_scr[:, 0]
+            l = l_scr[:]
             l = jnp.where(l == 0, 1.0, l)
-            o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
-            lse_ref[0] = m_scr[:, 0] + jnp.log(l)
+            o_ref[0] = (acc_scr[:] / l[:, 0:1]).astype(o_ref.dtype)
+            lse_ref[0] = m_scr[:] + jnp.log(l)
 
     return kernel
 
@@ -121,7 +128,7 @@ def _flash_forward(q, k, v, causal, scale, bq, bk, interpret):
         _fwd_kernel_factory(dh, bq, bk, nk, causal, scale),
         out_shape=(
             jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, LANES), jnp.float32),
         ),
         grid=(bh, s // bq, nk),
         in_specs=[
@@ -131,11 +138,11 @@ def _flash_forward(q, k, v, causal, scale, bq, bk, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
-            pl.BlockSpec((1, bq), lambda i, qi, j: (i, qi)),
+            pl.BlockSpec((1, bq, LANES), lambda i, qi, j: (i, qi, 0)),
         ),
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, dh), jnp.float32),
         ],
         interpret=interpret,
@@ -165,18 +172,18 @@ def _bwd_dq_kernel_factory(dh, bq, bk, nk, causal, scale):
             k = k_ref[0].astype(jnp.float32)
             v = v_ref[0].astype(jnp.float32)
             do = do_ref[0].astype(jnp.float32)
-            lse = lse_ref[0]
-            delta = delta_ref[0]
+            lse = lse_ref[0][:, 0:1]      # (bq, 1) from lane-broadcast state
+            delta = delta_ref[0][:, 0:1]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             ) * scale
-            p = jnp.exp(s - lse[:, None])
+            p = jnp.exp(s - lse)
             if causal:
                 p = jnp.where(_causal_keep(qi, j, bq, bk), p, 0.0)
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )
-            ds = p * (dp - delta[:, None])
+            ds = p * (dp - delta)
             dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
                 ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
             )
@@ -207,12 +214,12 @@ def _bwd_dkv_kernel_factory(dh, bq, bk, nq, causal, scale):
             k = k_ref[0].astype(jnp.float32)
             v = v_ref[0].astype(jnp.float32)
             do = do_ref[0].astype(jnp.float32)
-            lse = lse_ref[0]
-            delta = delta_ref[0]
+            lse = lse_ref[0][:, 0:1]      # (bq, 1) from lane-broadcast state
+            delta = delta_ref[0][:, 0:1]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             ) * scale  # (bq, bk)
-            p = jnp.exp(s - lse[:, None])
+            p = jnp.exp(s - lse)
             if causal:
                 p = jnp.where(_causal_keep(qi, j, bq, bk), p, 0.0)
             dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
@@ -221,7 +228,7 @@ def _bwd_dkv_kernel_factory(dh, bq, bk, nq, causal, scale):
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )
-            ds = p * (dp - delta[:, None])
+            ds = p * (dp - delta)
             dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
                 ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
             )
@@ -245,7 +252,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
     dof = do.reshape(bh, s, dh)
     delta = jnp.sum(
         dof.astype(jnp.float32) * o.reshape(bh, s, dh).astype(jnp.float32), axis=-1
-    )  # (bh, s)
+    )  # (bh, s) → lane-broadcast like lse so its blocks stay tileable
+    delta = jnp.broadcast_to(delta[..., None], (bh, s, LANES))
 
     dq = pl.pallas_call(
         _bwd_dq_kernel_factory(dh, bq, bk, nk, causal, scale),
@@ -256,8 +264,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             pl.BlockSpec((1, bk, dh), lambda i, qi, j: (i, j, 0)),
             pl.BlockSpec((1, bk, dh), lambda i, qi, j: (i, j, 0)),
             pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
-            pl.BlockSpec((1, bq), lambda i, qi, j: (i, qi)),
-            pl.BlockSpec((1, bq), lambda i, qi, j: (i, qi)),
+            pl.BlockSpec((1, bq, LANES), lambda i, qi, j: (i, qi, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda i, qi, j: (i, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
@@ -276,8 +284,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             pl.BlockSpec((1, bk, dh), lambda i, j, qi: (i, j, 0)),
             pl.BlockSpec((1, bk, dh), lambda i, j, qi: (i, j, 0)),
             pl.BlockSpec((1, bq, dh), lambda i, j, qi: (i, qi, 0)),
-            pl.BlockSpec((1, bq), lambda i, j, qi: (i, qi)),
-            pl.BlockSpec((1, bq), lambda i, j, qi: (i, qi)),
+            pl.BlockSpec((1, bq, LANES), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda i, j, qi: (i, qi, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, bk, dh), lambda i, j, qi: (i, j, 0)),
