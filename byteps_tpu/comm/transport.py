@@ -72,8 +72,8 @@ class Message:
         self.version = version
         self.payload = payload
 
-    def encode(self) -> bytes:
-        hdr = struct.pack(
+    def encode_header(self) -> bytes:
+        return struct.pack(
             HEADER_FMT,
             MAGIC,
             int(self.op),
@@ -85,7 +85,9 @@ class Message:
             self.version,
             len(self.payload),
         )
-        return hdr + self.payload
+
+    def encode(self) -> bytes:
+        return self.encode_header() + self.payload
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -114,13 +116,27 @@ def recv_message(sock: socket.socket) -> Message:
     )
 
 
+_SPLIT_SEND_BYTES = 64 * 1024
+
+
+def _send(sock: socket.socket, msg: Message) -> None:
+    if len(msg.payload) >= _SPLIT_SEND_BYTES:
+        # two sends instead of one header+payload concat: for multi-MB
+        # gradient partitions the concat would copy the whole tensor an
+        # extra time on every push/pull
+        hdr = msg.encode_header()
+        sock.sendall(hdr)
+        sock.sendall(msg.payload)
+    else:
+        sock.sendall(msg.encode())
+
+
 def send_message(sock: socket.socket, msg: Message, lock: Optional[threading.Lock] = None) -> None:
-    data = msg.encode()
     if lock is not None:
         with lock:
-            sock.sendall(data)
+            _send(sock, msg)
     else:
-        sock.sendall(data)
+        _send(sock, msg)
 
 
 def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:

@@ -10,24 +10,34 @@ Vans:
 
 - ``tcp``  — framed TCP (the ZMQ-class default).
 - ``uds``  — Unix-domain stream sockets for same-host worker↔server
-  traffic (the shm-class local path; honors ``BYTEPS_SOCKET_PATH`` like
-  the reference's local plane, communicator.cc:99-107).
+  traffic (honors ``BYTEPS_SOCKET_PATH`` like the reference's local
+  plane, communicator.cc:99-107).
+- ``shm``  — headers ride a UDS control socket, payload bytes move
+  through mmap'd shared-memory rings (shm_ring.py): the bulk path makes
+  no syscalls and touches no kernel socket buffers, the RDMA-class
+  zero-copy seam (reference: ps-lite ZPush/ZPull zero-copy SArrays +
+  BytePS_ShM staging, core_loops.cc:538-618, shared_memory.cc:28-50).
+  Python server only (the native C++ engine speaks fd streams).
 
-Selection: ``BYTEPS_VAN=tcp|uds`` (server side — the address it
+Selection: ``BYTEPS_VAN=tcp|uds|shm`` (server side — the address it
 publishes in the scheduler book encodes the scheme, so clients need no
 config).  Addresses stay ``(host, port)`` shaped for the control plane:
-a UDS address is ``("unix://<path>", 0)``.
+a UDS address is ``("unix://<path>", 0)``, an shm address is
+``("shm+unix://<path>", 0)``.
 """
 
 from __future__ import annotations
 
 import os
 import socket
+import struct
 import tempfile
+import threading
 import uuid
 from typing import Tuple
 
 UNIX_PREFIX = "unix://"
+SHM_PREFIX = "shm+unix://"
 
 
 class Van:
@@ -80,7 +90,223 @@ class UdsVan(Van):
         return sock
 
 
-_VANS = {v.name: v for v in (TcpVan(), UdsVan())}
+class ShmConnection:
+    """Socket-shaped duplex connection whose payload path is a pair of
+    shared-memory rings.  The UDS socket carries only the handshake and
+    afterwards serves as the liveness backstop: a SIGKILLed peer never
+    sets the ring's closed flag, but the kernel closes its fds, so an
+    EOF on the control socket unblocks ring waits."""
+
+    family = socket.AF_UNIX  # accept loops branch on family for TCP opts
+
+    def __init__(self, sock: socket.socket, tx, rx, server_side: bool = False) -> None:
+        self._sock = sock
+        self._tx = tx
+        self._rx = rx
+        self._hs_lock = threading.Lock()
+        if server_side:
+            # handshake completes lazily on first use, in the server's
+            # per-connection thread — doing it inside accept() would let
+            # one stalled client head-of-line-block every other worker
+            assert tx is None and rx is None
+        else:
+            sock.setblocking(False)
+
+    def _ensure_handshake(self) -> None:
+        if self._rx is not None:
+            return
+        with self._hs_lock:
+            if self._rx is not None:
+                return
+            from byteps_tpu.comm.shm_ring import ShmRing
+            from byteps_tpu.comm.transport import _recv_exact
+
+            try:
+                self._sock.settimeout(10.0)
+                names = []
+                for _ in range(2):
+                    (ln,) = struct.unpack("!H", _recv_exact(self._sock, 2))
+                    names.append(_recv_exact(self._sock, ln).decode())
+                self._sock.settimeout(None)
+                # client's c2s ring is our rx; attach then unlink
+                # immediately — the mappings stay alive and the files
+                # cannot leak whatever happens to either process
+                rx = ShmRing(names[0], "consumer")
+                tx = ShmRing(names[1], "producer")
+            except Exception as e:
+                raise ConnectionError(f"shm handshake failed: {e!r}") from e
+            for name in names:
+                try:
+                    os.unlink(name)
+                except OSError:
+                    pass
+            self._sock.setblocking(False)
+            self._tx, self._rx = tx, rx
+
+    def _peer_gone(self) -> bool:
+        try:
+            return self._sock.recv(1) == b""  # EOF: peer process exited
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            return True
+
+    def _wait(self, timeout: float) -> bool:
+        """Ring stall wait: sleep in select() on the control socket so a
+        dead peer (kernel-closed fd → readable EOF) ends the wait at
+        once instead of on the next poll tick.  Returns False when the
+        peer is gone."""
+        import select
+
+        try:
+            readable, _, _ = select.select([self._sock], [], [], timeout)
+        except (OSError, ValueError):
+            return False
+        if readable:
+            return not self._peer_gone()
+        return True
+
+    # socket surface used by transport.py ---------------------------------
+    def sendall(self, data) -> None:
+        self._ensure_handshake()
+        self._tx.write(data, wait=self._wait)
+
+    def recv_into(self, buf, nbytes: int = 0) -> int:
+        self._ensure_handshake()
+        return self._rx.recv_into(buf, nbytes, wait=self._wait)
+
+    def recv(self, n: int) -> bytes:
+        buf = bytearray(n)
+        got = self.recv_into(buf, n)
+        return bytes(buf[:got])
+
+    def shutdown(self, how: int = socket.SHUT_RDWR) -> None:
+        if self._tx is not None:
+            self._tx.mark_closed()
+        if self._rx is not None:
+            self._rx.mark_closed()
+        try:
+            self._sock.shutdown(how)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self._tx is not None:
+            self._tx.close()
+        if self._rx is not None:
+            self._rx.close()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ShmListener:
+    """Accept wrapper: completes the ring handshake before handing the
+    connection to the server's per-connection thread."""
+
+    def __init__(self, sock: socket.socket, path: str) -> None:
+        self._sock = sock
+        self._path = path
+
+    def accept(self):
+        # return immediately: the ring handshake completes lazily in the
+        # per-connection thread (ShmConnection._ensure_handshake), so a
+        # stalled or malicious client can neither head-of-line-block
+        # other workers' connects nor kill the accept loop — its failure
+        # surfaces as ConnectionError on first use, which server loops
+        # already treat as a dropped connection
+        conn, addr = self._sock.accept()
+        return ShmConnection(conn, tx=None, rx=None, server_side=True), addr
+
+    def shutdown(self, how: int = socket.SHUT_RDWR) -> None:
+        try:
+            self._sock.shutdown(how)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+
+def _check_shm_arch() -> None:
+    """The ring's data-then-counter publication order relies on x86-64's
+    TSO memory model (shm_ring.py docstring); on weaker models (aarch64)
+    a consumer could observe the head before the payload bytes.  Refuse
+    loudly rather than corrupt gradients silently."""
+    import platform
+
+    if platform.machine() not in ("x86_64", "AMD64", "i686"):
+        raise RuntimeError(
+            "BYTEPS_VAN=shm requires an x86-64 host (TSO store ordering); "
+            f"got {platform.machine()!r} — use the uds van instead"
+        )
+
+
+class ShmVan(Van):
+    name = "shm"
+
+    def listen(self, host: str) -> Tuple[object, str, int]:
+        _check_shm_arch()
+        base = os.environ.get("BYTEPS_SOCKET_PATH", tempfile.gettempdir())
+        path = os.path.join(base, f"byteps_shm_{os.getpid()}_{uuid.uuid4().hex[:8]}.sock")
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(128)
+        return ShmListener(srv, path), SHM_PREFIX + path, 0
+
+    def connect(self, host: str, port: int, timeout: float = 30.0):
+        from byteps_tpu.comm.shm_ring import ShmRing, create_ring_file
+
+        _check_shm_arch()
+        path = host[len(SHM_PREFIX):]
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(path)
+        size = int(os.environ.get("BYTEPS_SHM_RING_BYTES", str(16 << 20)))
+        created = []
+        tx = rx = None
+        try:
+            c2s = create_ring_file(size, tag="c2s_")
+            created.append(c2s)
+            s2c = create_ring_file(size, tag="s2c_")
+            created.append(s2c)
+            # map BEFORE announcing the names: the server unlinks the
+            # files the moment it has attached, so announcing first
+            # races our own open() against that unlink.  unlink=True
+            # covers a server that dies before attaching (ENOENT ok).
+            tx = ShmRing(c2s, "producer", unlink=True)
+            rx = ShmRing(s2c, "consumer", unlink=True)
+            for name in (c2s, s2c):
+                b = name.encode()
+                sock.sendall(struct.pack("!H", len(b)) + b)
+            sock.settimeout(None)
+            return ShmConnection(sock, tx=tx, rx=rx)
+        except Exception:
+            # a half-built connection must not orphan 2×16MB in /dev/shm
+            for ring in (tx, rx):
+                if ring is not None:
+                    ring.close()
+            for path in created:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+
+
+_VANS = {v.name: v for v in (TcpVan(), UdsVan(), ShmVan())}
 
 
 def get_van(name: str = "") -> Van:
@@ -93,4 +319,6 @@ def get_van(name: str = "") -> Van:
 
 def van_for_address(host: str) -> Van:
     """Client-side dispatch: the scheme is encoded in the address."""
+    if host.startswith(SHM_PREFIX):
+        return _VANS["shm"]
     return _VANS["uds"] if host.startswith(UNIX_PREFIX) else _VANS["tcp"]

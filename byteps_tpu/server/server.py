@@ -519,6 +519,16 @@ class NativePSServer:
     """
 
     def __init__(self, cfg: Config, host: str = "127.0.0.1") -> None:
+        import os as _os
+
+        van = _os.environ.get("BYTEPS_VAN", "tcp")
+        if van != "tcp":
+            # the C++ engine owns a TCP listener; silently ignoring the
+            # knob would run a different transport than the user asked for
+            raise RuntimeError(
+                f"BYTEPS_VAN={van!r} is Python-server only; the native "
+                "engine (BYTEPS_SERVER_NATIVE=1) speaks framed TCP"
+            )
         from byteps_tpu.native import get_lib
 
         lib = get_lib()

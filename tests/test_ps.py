@@ -22,12 +22,12 @@ from byteps_tpu.comm.rendezvous import Scheduler
 from byteps_tpu.server.server import NativePSServer, PSServer
 
 
-@pytest.fixture(params=["python", "native", "python-uds"])
+@pytest.fixture(params=["python", "native", "python-uds", "python-shm"])
 def fake_cluster(request, monkeypatch):
     """Scheduler + 1 server in-process; this process becomes the worker.
     Parametrized over the Python server, the C++ native data plane, and
-    the Python server behind the UDS van — every PS test runs against all
-    engine/transport combinations."""
+    the Python server behind the UDS and shared-memory vans — every PS
+    test runs against all engine/transport combinations."""
     if request.param == "native":
         from byteps_tpu.native import HAVE_NATIVE
 
@@ -35,6 +35,12 @@ def fake_cluster(request, monkeypatch):
             pytest.skip("native lib not built")
     if request.param == "python-uds":
         monkeypatch.setenv("BYTEPS_VAN", "uds")
+    if request.param == "python-shm":
+        import platform
+
+        if platform.machine() not in ("x86_64", "AMD64", "i686"):
+            pytest.skip("shm van requires x86-64 (TSO store ordering)")
+        monkeypatch.setenv("BYTEPS_VAN", "shm")
     sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
     sched.start()
     monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")

@@ -1,0 +1,222 @@
+"""Unit tests for the shared-memory ring and the shm van plumbing.
+
+The PS-matrix coverage (tests/test_ps.py, "python-shm" param) proves the
+van end to end; these pin the ring's byte-pipe semantics — wrap-around,
+blocking, close/liveness — which the socket tests can't reach directly.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.comm.shm_ring import ShmRing, create_ring_file
+
+
+@pytest.fixture
+def ring_pair():
+    path = create_ring_file(1024, tag="test_")
+    prod = ShmRing(path, "producer")
+    cons = ShmRing(path, "consumer", unlink=True)
+    yield prod, cons
+    prod.close()
+    cons.close()
+    assert not os.path.exists(path)
+
+
+def _read_exact(ring: ShmRing, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = ring.recv_into(view[got:], n - got)
+        assert r > 0
+        got += r
+    return bytes(buf)
+
+
+class TestShmRing:
+    def test_roundtrip(self, ring_pair):
+        prod, cons = ring_pair
+        prod.write(b"hello world")
+        assert _read_exact(cons, 11) == b"hello world"
+
+    def test_wraparound_many_times(self, ring_pair):
+        """Payloads larger than capacity must stream through (byte-pipe
+        semantics); run enough data to wrap the 1KB ring repeatedly."""
+        prod, cons = ring_pair
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, size=10_000, dtype=np.uint8).tobytes()
+        out = {}
+
+        def consume():
+            out["data"] = _read_exact(cons, len(data))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        prod.write(data)
+        t.join(10)
+        assert out["data"] == data
+
+    def test_interleaved_messages(self, ring_pair):
+        prod, cons = ring_pair
+        chunks = [bytes([i]) * (37 * (i + 1)) for i in range(20)]
+
+        def produce():
+            for c in chunks:
+                prod.write(c)
+
+        t = threading.Thread(target=produce)
+        t.start()
+        for c in chunks:
+            assert _read_exact(cons, len(c)) == c
+        t.join(10)
+
+    def test_close_unblocks_reader(self, ring_pair):
+        prod, cons = ring_pair
+        result = {}
+
+        def read():
+            result["n"] = cons.recv_into(bytearray(8))
+
+        t = threading.Thread(target=read)
+        t.start()
+        time.sleep(0.05)
+        prod.mark_closed()
+        t.join(5)
+        assert result["n"] == 0
+
+    def test_write_to_closed_peer_raises(self, ring_pair):
+        prod, cons = ring_pair
+        cons.mark_closed()
+        # ring full + closed → ConnectionError, not a hang
+        with pytest.raises(ConnectionError):
+            prod.write(b"x" * 5000)
+
+    def test_wait_callback_breaks_stall(self, ring_pair):
+        prod, cons = ring_pair
+        # nothing ever arrives and the flag is never set: the wait hook
+        # (the van's SIGKILL backstop) reporting peer-dead must end it
+        assert cons.recv_into(bytearray(4), wait=lambda t: False) == 0
+        with pytest.raises(ConnectionError):
+            prod.write(b"x" * 2000, wait=lambda t: False)
+
+
+class TestShmVanConnection:
+    def test_message_roundtrip_and_kill_detection(self):
+        from byteps_tpu.comm.transport import Message, Op, recv_message, send_message
+        from byteps_tpu.comm.van import get_van
+
+        van = get_van("shm")
+        listener, host, port = van.listen("127.0.0.1")
+        assert host.startswith("shm+unix://")
+        accepted = {}
+
+        def serve():
+            conn, _ = listener.accept()
+            accepted["conn"] = conn
+            msg = recv_message(conn)
+            send_message(conn, Message(Op.PULL, key=msg.key, payload=msg.payload * 2, seq=msg.seq))
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        client = van.connect(host, port)
+        payload = np.arange(100_000, dtype=np.float32).tobytes()  # > ring? no: 400KB < 16MB, but > one sendall chunk
+        send_message(client, Message(Op.PUSH, key=7, payload=payload, seq=3))
+        resp = recv_message(client)
+        assert resp.key == 7 and resp.seq == 3
+        assert resp.payload == payload * 2
+        t.join(10)
+
+        # server side drops the connection: the client's next read must
+        # terminate, not spin (close_socket marks the rings closed)
+        from byteps_tpu.comm.transport import close_socket
+
+        close_socket(accepted["conn"])
+        with pytest.raises(ConnectionError):
+            recv_message(client)
+        close_socket(client)
+        listener.close()
+
+    def test_failed_handshake_does_not_kill_accepts(self):
+        """Clients that die or send garbage mid-handshake must neither
+        kill the accept loop nor block other workers: accept() returns a
+        lazy connection whose handshake failure surfaces per-connection
+        as ConnectionError (the server loops drop such connections)."""
+        from byteps_tpu.comm.transport import Message, Op, close_socket, recv_message, send_message
+        from byteps_tpu.comm.van import get_van
+
+        van = get_van("shm")
+        listener, host, _ = van.listen("127.0.0.1")
+        path = host[len("shm+unix://"):]
+        results = []
+
+        def serve_one():
+            conn, _ = listener.accept()
+            try:
+                msg = recv_message(conn)
+                send_message(conn, Message(Op.PING, seq=msg.seq))
+                results.append("ok")
+            except ConnectionError:
+                results.append("dropped")
+                close_socket(conn)
+
+        threads = [threading.Thread(target=serve_one, daemon=True) for _ in range(3)]
+        for t in threads:
+            t.start()
+
+        # saboteur 1: connects and dies before sending ring names
+        s1 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s1.connect(path)
+        s1.close()
+        # saboteur 2: announces a ring file that doesn't exist
+        s2 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s2.connect(path)
+        bogus = b"/dev/shm/byteps_ring_nonexistent"
+        import struct as _struct
+
+        s2.sendall(_struct.pack("!H", len(bogus)) + bogus)
+        s2.sendall(_struct.pack("!H", len(bogus)) + bogus)
+        s2.close()
+
+        # a healthy client must still get served
+        client = van.connect(host, 0)
+        send_message(client, Message(Op.PING, seq=9))
+        assert recv_message(client).seq == 9
+        for t in threads:
+            t.join(15)
+        assert sorted(results) == ["dropped", "dropped", "ok"]
+        close_socket(client)
+        listener.close()
+
+    def test_ring_files_are_cleaned_up(self):
+        from byteps_tpu.comm.transport import Message, Op, close_socket, recv_message, send_message
+        from byteps_tpu.comm.van import get_van
+        from byteps_tpu.comm.shm_ring import _shm_dir
+
+        before = set(os.listdir(_shm_dir()))
+        van = get_van("shm")
+        listener, host, _ = van.listen("127.0.0.1")
+        got = {}
+
+        def serve():
+            conn, _ = listener.accept()
+            got["c"] = conn
+            got["msg"] = recv_message(conn)  # completes the lazy handshake
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        client = van.connect(host, 0)
+        send_message(client, Message(Op.PING, seq=1))
+        t.join(10)
+        assert got["msg"].seq == 1
+        # once the server has attached (first recv), both backing files
+        # are unlinked — nothing may remain on disk while the
+        # connection is live
+        assert not {f for f in os.listdir(_shm_dir()) if f.startswith("byteps_ring_")} - before
+        close_socket(client)
+        close_socket(got["c"])
+        listener.close()

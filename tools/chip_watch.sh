@@ -1,0 +1,38 @@
+#!/bin/bash
+# Watch for the accelerator tunnel to come back; when it does, run every
+# chip-blocked validation in sequence and log results.  Designed to be
+# left running detached (nohup) while CPU-side work continues:
+#
+#   nohup bash tools/chip_watch.sh >/dev/null 2>&1 &
+#   tail -f /tmp/chip_watch.log
+#
+# The probe is a real tiny computation (device init alone can succeed
+# while the data path hangs).  Each stage gets a generous timeout:
+# through-tunnel compiles are minutes, not seconds.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${CHIP_WATCH_LOG:-/tmp/chip_watch.log}
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
+
+probe() {
+  timeout 90 python -c "import jax.numpy as jnp; float(jnp.sum(jnp.ones(4)))" \
+    >/dev/null 2>&1
+}
+
+while true; do
+  if probe; then
+    echo "$(date -u +%FT%TZ) TUNNEL UP — starting chip runs" >>"$LOG"
+    timeout 1800 python -u tools/chip_validation.py --skip-decode >>"$LOG" 2>&1
+    echo "kernel validation rc=$?" >>"$LOG"
+    timeout 2400 python -u bench.py >/tmp/bench_out.json 2>/tmp/bench_err.log
+    rc=$?
+    echo "bench rc=$rc" >>"$LOG"
+    cat /tmp/bench_out.json >>"$LOG" 2>/dev/null
+    timeout 3000 python -u tools/chip_validation.py >>"$LOG" 2>&1
+    echo "full validation (incl. decode) rc=$?" >>"$LOG"
+    echo "$(date -u +%FT%TZ) chip run sequence complete" >>"$LOG"
+    break
+  fi
+  echo "$(date -u +%FT%TZ) tunnel down" >>"$LOG"
+  sleep 120
+done
