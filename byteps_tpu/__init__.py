@@ -41,6 +41,7 @@ from byteps_tpu.api import (
     broadcast_parameters,
     broadcast_object,
     get_pushpull_speed,
+    set_compression_lr,
 )
 from byteps_tpu.optim import DistributedOptimizer, distributed_optimizer
 
@@ -87,6 +88,7 @@ __all__ = [
     "broadcast_parameters",
     "broadcast_object",
     "get_pushpull_speed",
+    "set_compression_lr",
     "DistributedOptimizer",
     "distributed_optimizer",
 ]

@@ -213,6 +213,16 @@ def broadcast_object(obj: Any, root_rank: int = 0, name: str = "obj") -> Any:
     return pickle.loads(np.asarray(out, dtype=np.uint8).tobytes())
 
 
+def set_compression_lr(lr: float) -> None:
+    """Propagate the optimizer's learning rate into error-feedback
+    compressor chains (the reference's ``lr.s`` shared file,
+    vanilla_error_feedback.h:44-58).  No-op when nothing is compressed
+    or the engine isn't running."""
+    st = require_state()
+    if st.engine is not None:
+        st.engine.set_compression_lr(lr)
+
+
 def get_pushpull_speed() -> float:
     """Windowed push/pull MB/s (common/__init__.py:131-139)."""
     st = require_state()

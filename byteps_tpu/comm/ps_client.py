@@ -340,3 +340,23 @@ class PSClient:
             sc.send_lock,
         )
         done.wait()
+
+    def set_compression_lr(self, lr: float) -> None:
+        """Broadcast the optimizer lr to every server's EF chains (flag
+        bit 0 on REGISTER_COMPRESSOR, payload = big-endian f64 — the
+        wire replacement for the reference's lr.s mmap,
+        vanilla_error_feedback.h:44-58).  Fire-and-forget: EF lr scaling
+        is a numerical refinement, not a correctness barrier."""
+        import struct as _struct
+
+        payload = _struct.pack("!d", float(lr))
+        for sc in self._servers:
+            try:
+                seq = sc.alloc_seq(lambda msg: None)
+                send_message(
+                    sc.sock,
+                    Message(Op.REGISTER_COMPRESSOR, seq=seq, payload=payload, flags=1),
+                    sc.send_lock,
+                )
+            except (ConnectionError, OSError):
+                continue  # dead server already handled by the data path

@@ -135,6 +135,8 @@ class PipelineEngine:
         # per-key stateful codec chains (per-partition compressor
         # instantiation, operations.cc:283-414)
         self._compressors: Dict[int, object] = {}
+        self._compression_lr: float = 1.0
+        self._lr_sent_to_servers: float = 1.0
 
     # --- lifecycle -------------------------------------------------------
 
@@ -284,7 +286,39 @@ class PipelineEngine:
                 return
             self._ensure_compress_threads()
             self._compressors[part.key] = codec
+            # a chain created after set_compression_lr must still honor it
+            self._apply_lr_to_chain(codec, self._compression_lr)
             self.client.register_compressor(part.key, ctx.kwargs)
+        self._maybe_send_lr()
+
+    @staticmethod
+    def _apply_lr_to_chain(codec, lr: float) -> None:
+        c = codec
+        while c is not None:
+            setter = getattr(c, "set_lr", None)
+            if setter is not None:
+                setter(lr)
+            c = getattr(c, "inner", None)
+
+    def set_compression_lr(self, lr: float) -> None:
+        """Feed the current learning rate to every error-feedback stage —
+        the worker-side chains here AND the server-side chains over the
+        wire (replaces the reference's ``lr.s`` mmap,
+        vanilla_error_feedback.h:44-58 — EF residual scaling tracks lr).
+
+        Order-independent: an lr set before any compressor exists is
+        remembered and applied when chains are created (worker side) /
+        sent when the first chain registers (server side); repeat calls
+        with an unchanged lr produce no wire traffic."""
+        self._compression_lr = lr
+        for codec in list(self._compressors.values()):
+            self._apply_lr_to_chain(codec, lr)
+        self._maybe_send_lr()
+
+    def _maybe_send_lr(self) -> None:
+        if self._compressors and self._compression_lr != self._lr_sent_to_servers:
+            self.client.set_compression_lr(self._compression_lr)
+            self._lr_sent_to_servers = self._compression_lr
 
     # --- stage bodies ----------------------------------------------------
 

@@ -1,0 +1,36 @@
+"""MXNet plugin façade.
+
+The mxnet-dependent surface lives in :mod:`byteps_tpu.mxnet.plugin`
+(imported lazily so the pure policy helpers in ``_naming`` stay
+importable — and tested — on hosts without mxnet, while
+``import byteps_tpu.mxnet`` itself stays cheap).  Attribute access
+forwards to the plugin, so the reference usage pattern
+
+    import byteps_tpu.mxnet as bps
+    bps.init(); trainer = bps.DistributedTrainer(...)
+
+works unchanged (byteps/mxnet/__init__.py surface); the first touch
+raises the underlying ImportError when mxnet is missing.
+"""
+
+from __future__ import annotations
+
+_SURFACE = {
+    "init", "shutdown", "suspend", "resume",
+    "rank", "size", "local_rank", "local_size",
+    "byteps_declare_tensor", "byteps_push_pull",
+    "DistributedOptimizer", "DistributedTrainer",
+    "broadcast_parameters", "Compression", "parameter_index",
+}
+
+
+def __getattr__(name: str):
+    if name in _SURFACE:
+        from byteps_tpu.mxnet import plugin
+
+        return getattr(plugin, name)
+    raise AttributeError(f"module 'byteps_tpu.mxnet' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(_SURFACE)

@@ -1,0 +1,66 @@
+"""Pure policy helpers for the MXNet plugin — importable (and tested)
+without mxnet installed.
+
+The reference keys gradients/parameters by index with a fixed priority
+policy (mxnet/__init__.py:52-74: ``gradient_<i>`` at priority ``-i``,
+``parameter_<i>`` at priority 0) so the first layers' gradients — needed
+first by the next step's forward — win the scheduler.  The trainer-side
+compression-params translation (mxnet/__init__.py:236-290) becomes
+declare kwargs here (our declare takes kwargs directly instead of
+stashing ``byteps_*`` attributes on gluon Parameters).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from byteps_tpu.compression.registry import translate_compression_params
+
+
+def gradient_name(index: int) -> str:
+    return f"gradient_{index}"
+
+
+def parameter_name(index: int) -> str:
+    return f"parameter_{index}"
+
+
+def weight_name(index: int) -> str:
+    return f"weight_{index}"
+
+
+def gradient_priority(index: int) -> int:
+    """Earlier parameters sync at higher priority (reference
+    mxnet/__init__.py:56: ``priority=-index``)."""
+    return -index
+
+
+def trainer_compression_kwargs(
+    compression_params: Optional[Dict],
+    optimizer_params: Optional[Dict],
+) -> Tuple[Dict[str, str], Dict, bool]:
+    """(declare kwargs, cleaned optimizer_params, use_fp16_intra).
+
+    Mirrors DistributedTrainer._register_compressor semantics
+    (mxnet/__init__.py:236-321): ``momentum`` compression lifts the
+    optimizer's momentum coefficient into the compressor chain and
+    removes it from the local optimizer (the server-side chain applies
+    it once, pre-error-feedback); ``fp16`` selects level-1 intra-node
+    compression independent of the level-2 codec.
+    """
+    compression_params = dict(compression_params or {})
+    optimizer_params = dict(optimizer_params or {})
+    use_fp16 = bool(compression_params.pop("fp16", False))
+    if "compressor" not in compression_params:
+        return {}, optimizer_params, use_fp16
+    if compression_params.get("momentum"):
+        if "momentum_mu" not in compression_params:
+            if "momentum" not in optimizer_params:
+                raise KeyError(
+                    "momentum compression requires the optimizer's momentum "
+                    "coefficient (optimizer_params['momentum'] or "
+                    "compression_params['momentum_mu'])"
+                )
+            compression_params["momentum_mu"] = optimizer_params.pop("momentum")
+    kwargs = translate_compression_params(compression_params)
+    return kwargs, optimizer_params, use_fp16

@@ -1,0 +1,50 @@
+"""Level-1 (intra-node, framework-side) gradient compression for the
+MXNet plugin — parity with byteps/mxnet/compression.py:
+``Compression.none`` and ``Compression.fp16`` (cast floating grads to
+fp16 for the wire, cast back after aggregation)."""
+
+from __future__ import annotations
+
+import mxnet as mx
+
+
+class Compressor:
+    """Interface: compress(tensor) -> (tensor, ctx); decompress(tensor, ctx)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if dtype in (mx.np.float32 if hasattr(mx, "np") else "float32", "float32", "float64"):
+            return tensor.astype("float16"), dtype
+        return tensor, dtype
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -1,0 +1,266 @@
+"""MXNet plugin — Horovod-compatible BytePS surface for MXNet/Gluon.
+
+Parity surface (reference byteps/mxnet/__init__.py:35-360, ops.py:82-120):
+
+    init / shutdown / suspend / resume / rank / size / local_rank / local_size
+    byteps_declare_tensor(name, **kwargs)
+    byteps_push_pull(tensor, version, priority, name, is_average)
+    DistributedOptimizer (sync grads; async pushes weight deltas)
+    DistributedTrainer (gluon) with ``compression_params``
+    broadcast_parameters(params, root_rank)
+
+TPU-native differences from the reference:
+
+- The reference enqueues push_pull as an async op on the MXNet engine
+  with ``FnProperty::kCPUPrioritized`` (ops.cc:30-80).  Here the byteps
+  engine owns priority scheduling itself, so the NDArray is handed to
+  the engine (D2H staged, partitioned, scheduled by ``priority``) and
+  written back in place; ``wait_to_read()`` semantics hold because the
+  write-back completes before return.
+- Compression config travels as declare kwargs (the engine's registry
+  consumes the same ``byteps_*`` keys the reference serializes to its
+  server, operations.cc:396-408) instead of attribute-stashing on gluon
+  Parameters.
+- No ``lr.s`` mmap file: the vanilla-error-feedback lr scaling is fed
+  through the registry's ``set_lr`` (error_feedback.py), so the trainer
+  just calls that on step.
+"""
+
+from __future__ import annotations
+
+import mxnet as mx
+import numpy as np
+
+from byteps_tpu import api as _api
+from byteps_tpu.api import (
+    init,
+    local_rank,
+    local_size,
+    rank,
+    resume,
+    shutdown,
+    size,
+    suspend,
+)
+from byteps_tpu.mxnet._naming import (
+    gradient_name,
+    gradient_priority,
+    parameter_name,
+    trainer_compression_kwargs,
+    weight_name,
+)
+from byteps_tpu.mxnet.compression import Compression
+
+__all__ = [
+    "init", "shutdown", "suspend", "resume",
+    "rank", "size", "local_rank", "local_size",
+    "byteps_declare_tensor", "byteps_push_pull",
+    "DistributedOptimizer", "DistributedTrainer",
+    "broadcast_parameters", "Compression",
+]
+
+parameter_index = 0
+
+
+def byteps_declare_tensor(name: str, **kwargs) -> int:
+    """Declare ``name`` (stable key assignment; compression kwargs ride
+    along exactly like ops.py:82-120)."""
+    return _api.declare_tensor(name, **{k: str(v) for k, v in kwargs.items()})
+
+
+def byteps_push_pull(
+    tensor,
+    version: int = 0,
+    priority: int = 0,
+    name: str = None,
+    is_average: bool = True,
+):
+    """In-place cross-worker sum (mean when ``is_average``) of an
+    NDArray through the PS engine."""
+    if name is None:
+        raise ValueError("byteps_push_pull requires a name (cross-worker key)")
+    out = _api.push_pull(
+        tensor.asnumpy(), name=name, average=is_average, priority=priority
+    )
+    tensor[:] = mx.nd.array(np.asarray(out), dtype=tensor.dtype, ctx=tensor.context)
+    return tensor
+
+
+class DistributedOptimizer(mx.optimizer.Optimizer):
+    """Wraps an mx.optimizer.Optimizer: sync mode push_pulls gradients
+    before the local update; async mode updates locally then exchanges
+    weight deltas through the parameter store
+    (mxnet/__init__.py:35-121)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        import os
+
+        self._enable_async = int(os.getenv("BYTEPS_ENABLE_ASYNC", "0")) != 0
+        if self._enable_async:
+            assert int(os.getenv("DMLC_NUM_WORKER", "1")) > 1, (
+                "Async is only valid for distributed training"
+            )
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_push_pull(self, index, grad):
+        if isinstance(index, (tuple, list)):
+            for i, idx in enumerate(index):
+                byteps_declare_tensor(gradient_name(idx))
+                byteps_push_pull(
+                    grad[i], priority=gradient_priority(idx),
+                    name=gradient_name(idx), is_average=True,
+                )
+        else:
+            byteps_declare_tensor(gradient_name(index))
+            byteps_push_pull(
+                grad, priority=gradient_priority(index),
+                name=gradient_name(index), is_average=True,
+            )
+
+    def _do_push_pull_param(self, index, delta_weight):
+        if isinstance(index, (tuple, list)):
+            for i, idx in enumerate(index):
+                byteps_declare_tensor(weight_name(idx))
+                byteps_push_pull(
+                    delta_weight[i], priority=gradient_priority(idx),
+                    name=weight_name(idx), is_average=False,
+                )
+        else:
+            byteps_declare_tensor(weight_name(index))
+            byteps_push_pull(
+                delta_weight, priority=gradient_priority(index),
+                name=weight_name(index), is_average=False,
+            )
+
+    def _async_update(self, index, weight, grad, state, update_fn):
+        # mxnet passes either a scalar index + NDArray or parallel lists
+        # (same duality _do_push_pull handles); iterating a bare NDArray
+        # would walk its rows, so normalize to lists first
+        ws = [weight] if not isinstance(index, (tuple, list)) else weight
+        temp = [w.copy() for w in ws]
+        update_fn(index, weight, grad, state)
+        for w, t in zip(ws, temp):
+            w.__isub__(t)  # w now holds the local delta
+        self._do_push_pull_param(index, weight)
+
+    def update(self, index, weight, grad, state):
+        if self._enable_async:
+            self._async_update(index, weight, grad, state, self._optimizer.update)
+        else:
+            self._do_push_pull(index, grad)
+            self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self._enable_async:
+            self._async_update(
+                index, weight, grad, state, self._optimizer.update_multi_precision
+            )
+        else:
+            self._do_push_pull(index, grad)
+            self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Root's values win: non-root zeroes its copy, push_pull sums
+    (mxnet/__init__.py:124-161 semantics — broadcast = zero + sum)."""
+    global parameter_index
+
+    if not isinstance(params, dict):
+        raise ValueError(f"Invalid params of type: {type(params)}")
+    tensors = [p for _, p in sorted(params.items())]
+    for tensor in tensors:
+        byteps_declare_tensor(parameter_name(parameter_index))
+        if rank() != root_rank:
+            tensor.__imul__(0)
+        byteps_push_pull(
+            tensor, priority=0, name=parameter_name(parameter_index),
+            is_average=False,
+        )
+        parameter_index += 1
+    for tensor in tensors:
+        tensor.wait_to_read()
+
+
+class DistributedTrainer(mx.gluon.Trainer):
+    """gluon.Trainer whose gradient aggregation runs through the byteps
+    engine instead of a kvstore, with level-2 compression configured per
+    parameter via ``compression_params``
+    (mxnet/__init__.py:164-345)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 root_rank: int = 0, compression_params=None):
+        if isinstance(optimizer, DistributedOptimizer):
+            optimizer = optimizer._optimizer
+
+        param_list = params
+        if isinstance(params, dict):
+            param_list = [params[key] for key in sorted(params.keys())]
+
+        declare_kwargs, optimizer_params, use_fp16 = trainer_compression_kwargs(
+            compression_params, optimizer_params
+        )
+        self._intra_compressor = Compression.fp16 if use_fp16 else Compression.none
+
+        super().__init__(
+            param_list, optimizer, optimizer_params=optimizer_params, kvstore=None
+        )
+
+        self._bps_size = size()
+        self.root_rank = root_rank
+        for i, param in enumerate(self._params):
+            byteps_declare_tensor(parameter_name(i))
+            if param.grad_req != "null":
+                byteps_declare_tensor(gradient_name(i), **declare_kwargs)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        # grads get normalized by batch_size AND worker count inside
+        # _allreduce_grads; _scale=batch_size stops gluon re-normalizing
+        self._scale = batch_size
+        super().step(batch_size, ignore_stale_grad)
+
+    def _allreduce_grads(self):
+        # vanilla-EF lr scaling (replaces the reference's lr.s mmap)
+        _api.set_compression_lr(self.learning_rate)
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                grad = param.list_grad()[0]
+                grad *= 1.0 / (self._scale * self._bps_size)
+                compressed, ctx = self._intra_compressor.compress(grad)
+                byteps_push_pull(
+                    compressed, is_average=False,
+                    name=gradient_name(i), priority=gradient_priority(i),
+                )
+                param.list_grad()[0][:] = self._intra_compressor.decompress(
+                    compressed, ctx
+                )
+
+    def _init_params(self):
+        tensors = []
+        for param in self._params_to_init:
+            if param._deferred_init:
+                tensors.append(param)
+            else:
+                arrs = param._check_and_get(param._data, list)
+                idx = self._param2idx[param.name]
+                if rank() != self.root_rank:
+                    arrs[0].__imul__(0)
+                byteps_push_pull(
+                    arrs[0], priority=0, name=parameter_name(idx),
+                    is_average=False,
+                )
+        self._params_to_init = tensors

@@ -146,13 +146,17 @@ struct Codec {
     }
   }
 
-  std::vector<uint8_t> compress(const float* dense) {
+  std::vector<uint8_t> compress(const float* dense, float ef_lr = 1.0f) {
     const float* src = dense;
     std::vector<float> corrected;
     if (has_ef) {
       if (error.empty()) error.assign(n, 0.0f);
       corrected.resize(n);
-      for (int64_t i = 0; i < n; ++i) corrected[i] = dense[i] + error[i];
+      // lr-scaled residual correction (vanilla_error_feedback.h:44-58;
+      // the lr arrives over the wire via the kRegisterCompressor
+      // lr-update flag instead of the reference's lr.s mmap)
+      for (int64_t i = 0; i < n; ++i)
+        corrected[i] = dense[i] + ef_lr * error[i];
       src = corrected.data();
     }
     std::vector<uint8_t> out;
@@ -347,7 +351,7 @@ class NativeServer {
         ks->store_version++;
         ks->recv_count = 0;
         if (ks->codec)
-          ks->pull_payload = ks->codec->compress((const float*)ks->store.data());
+          ks->pull_payload = ks->codec->compress((const float*)ks->store.data(), ef_lr_.load());
         std::vector<PendingPull> still;
         for (auto& p : ks->pending) {
           if (p.version <= ks->store_version) {
@@ -554,7 +558,7 @@ class NativeServer {
           if (!handle_init(conn, seq, key, payload)) return;  // malformed → drop conn
           break;
         case kRegisterCompressor:
-          handle_register(conn, seq, key, payload);
+          handle_register(conn, seq, key, h.flags, payload);
           break;
         case kPush:
         case kPull: {
@@ -618,7 +622,21 @@ class NativeServer {
   }
 
   void handle_register(const ConnPtr& conn, uint32_t seq, uint64_t key,
-                       const std::vector<uint8_t>& payload) {
+                       uint8_t flags, const std::vector<uint8_t>& payload) {
+    if (flags & 1) {
+      // lr update for every EF chain (flag bit 0; payload = big-endian
+      // f64) — the wire replacement for the reference's lr.s mmap
+      if (payload.size() == 8) {
+        uint64_t bits;
+        std::memcpy(&bits, payload.data(), 8);
+        bits = be64toh(bits);
+        double lr;
+        std::memcpy(&lr, &bits, 8);
+        ef_lr_.store((float)lr);
+      }
+      send_msg(conn, kRegisterCompressor, seq, key, 0, nullptr, 0);
+      return;
+    }
     std::map<std::string, std::string> kw;
     std::string text((const char*)payload.data(), payload.size());
     size_t pos = 0;
@@ -686,7 +704,7 @@ class NativeServer {
           ks.store_version++;
           ks.recv_count = 0;
           if (ks.codec)
-            ks.pull_payload = ks.codec->compress((const float*)ks.store.data());
+            ks.pull_payload = ks.codec->compress((const float*)ks.store.data(), ef_lr_.load());
           std::vector<PendingPull> still;
           for (auto& p : ks.pending) {
             if (p.version <= ks.store_version) {
@@ -710,7 +728,7 @@ class NativeServer {
   std::vector<uint8_t> wire_payload_locked(KeyState& ks, bool wants_compressed) {
     if (wants_compressed && ks.codec) {
       if (async_ || ks.pull_payload.empty())
-        return ks.codec->compress((const float*)ks.store.data());
+        return ks.codec->compress((const float*)ks.store.data(), ef_lr_.load());
       return ks.pull_payload;
     }
     return ks.store;
@@ -757,6 +775,8 @@ class NativeServer {
   std::map<uint64_t, int> tid_cache_;
   std::vector<uint64_t> tid_load_;
   std::map<uint64_t, uint64_t> pushed_total_;
+  // EF residual lr (workers broadcast optimizer lr; default 1.0)
+  std::atomic<float> ef_lr_{1.0f};
 };
 
 // several server instances may coexist in one process (multi-server

@@ -53,6 +53,16 @@ from byteps_tpu.comm.transport import (
 from byteps_tpu.comm.rendezvous import GROUP_ALL
 
 
+def _apply_lr_to_chain(codec, lr: float) -> None:
+    """Walk a compressor decorator chain, feeding lr to every EF stage."""
+    c = codec
+    while c is not None:
+        setter = getattr(c, "set_lr", None)
+        if setter is not None:
+            setter(lr)
+        c = getattr(c, "inner", None)
+
+
 class _KeyState:
     __slots__ = (
         "store",
@@ -157,6 +167,9 @@ class PSServer:
         self._sock, self.host, self.port = self._van.listen(host)
         self._keys: Dict[int, _KeyState] = {}
         self._keys_lock = threading.Lock()
+        # EF residual lr broadcast by workers (lr-update flag on
+        # REGISTER_COMPRESSOR); chains registered later inherit it
+        self._ef_lr = 1.0
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         # key→engine-thread least-loaded assignment (server.h:154-178)
@@ -287,6 +300,22 @@ class PSServer:
                 msg = recv_message(conn)
                 if msg.op in (Op.PUSH, Op.PULL, Op.INIT):
                     self._enqueue(msg, conn, send_lock)
+                elif msg.op == Op.REGISTER_COMPRESSOR and msg.flags & 1:
+                    # lr update for every EF chain (flag bit 0; payload =
+                    # big-endian f64) — the wire replacement for the
+                    # reference's lr.s mmap (vanilla_error_feedback.h:44-58).
+                    # Malformed sizes are acked and ignored like the C++
+                    # engine (ps_server.cc payload.size()==8 guard)
+                    import struct as _struct
+
+                    if len(msg.payload) == 8:
+                        (lr,) = _struct.unpack("!d", msg.payload)
+                        self._ef_lr = lr  # late-registered chains inherit it
+                        with self._keys_lock:
+                            chains = [ks.compressor for ks in self._keys.values()]
+                        for c in chains:
+                            _apply_lr_to_chain(c, lr)
+                    send_message(conn, Message(Op.REGISTER_COMPRESSOR, seq=msg.seq), send_lock)
                 elif msg.op == Op.REGISTER_COMPRESSOR:
                     # compressor registration init-push (server.cc:228-257);
                     # server chain skips momentum (compressor_registry.cc:44);
@@ -302,6 +331,7 @@ class PSServer:
                         ks.compressor_kwargs = kwargs
                         size = ks.store.size if ks.store is not None else 0
                         ks.compressor = create_compressor(kwargs, size, server=True)
+                        _apply_lr_to_chain(ks.compressor, self._ef_lr)
                     send_message(conn, Message(Op.REGISTER_COMPRESSOR, seq=msg.seq), send_lock)
                 elif msg.op == Op.PING:
                     send_message(conn, Message(Op.PING, seq=msg.seq), send_lock)

@@ -1,0 +1,169 @@
+"""MXNet plugin tests.
+
+The pure policy layer (naming, priorities, compression-params
+translation, EF lr plumbing) runs everywhere; the mxnet-dependent
+surface tests skip when mxnet isn't installed (it is not in this image —
+reference coverage: tests/test_mxnet.py:30-126)."""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from byteps_tpu.mxnet._naming import (
+    gradient_name,
+    gradient_priority,
+    parameter_name,
+    trainer_compression_kwargs,
+    weight_name,
+)
+
+
+class TestNamingPolicy:
+    def test_names(self):
+        assert gradient_name(3) == "gradient_3"
+        assert parameter_name(0) == "parameter_0"
+        assert weight_name(7) == "weight_7"
+
+    def test_priority_is_negative_index(self):
+        # earlier layers win the scheduler (mxnet/__init__.py:56)
+        assert gradient_priority(0) == 0
+        assert gradient_priority(12) == -12
+
+
+class TestCompressionKwargs:
+    def test_empty(self):
+        kwargs, opt, fp16 = trainer_compression_kwargs(None, {"learning_rate": 0.1})
+        assert kwargs == {} and opt == {"learning_rate": 0.1} and not fp16
+
+    def test_fp16_only(self):
+        kwargs, opt, fp16 = trainer_compression_kwargs({"fp16": True}, {})
+        assert kwargs == {} and fp16
+
+    def test_full_chain_lifts_optimizer_momentum(self):
+        # momentum compression consumes the optimizer's mu — the chain
+        # applies it once server-side; the local optimizer must not
+        # apply it again (mxnet/__init__.py:300-321)
+        kwargs, opt, _ = trainer_compression_kwargs(
+            {"compressor": "onebit", "ef": "vanilla", "momentum": "nesterov",
+             "scaling": True, "seed": 13},
+            {"learning_rate": 0.1, "momentum": 0.9},
+        )
+        assert kwargs["byteps_compressor_type"] == "onebit"
+        assert kwargs["byteps_ef_type"] == "vanilla"
+        assert kwargs["byteps_momentum_type"] == "nesterov"
+        assert kwargs["byteps_momentum_mu"] == "0.9"
+        assert kwargs["byteps_compressor_onebit_scaling"] == "True"
+        assert "momentum" not in opt and opt["learning_rate"] == 0.1
+
+    def test_momentum_without_mu_raises(self):
+        with pytest.raises(KeyError):
+            trainer_compression_kwargs(
+                {"compressor": "topk", "k": 0.1, "momentum": "nesterov"}, {}
+            )
+
+    def test_inputs_not_mutated(self):
+        cp = {"compressor": "randomk", "k": 8, "momentum": "nesterov"}
+        op = {"momentum": 0.9}
+        trainer_compression_kwargs(cp, op)
+        assert op == {"momentum": 0.9} and "momentum" in cp
+
+
+class TestCompressionLrPlumbing:
+    def test_engine_walks_decorator_chains(self):
+        from byteps_tpu.compression.registry import create_compressor
+        from byteps_tpu.core.engine import PipelineEngine
+
+        chain = create_compressor(
+            {"byteps_compressor_type": "onebit", "byteps_ef_type": "vanilla",
+             "byteps_momentum_type": "nesterov", "byteps_momentum_mu": "0.9"},
+            size=256,
+        )
+        sent = []
+        fake = types.SimpleNamespace(
+            _compressors={0: chain},
+            _compression_lr=1.0,
+            _lr_sent_to_servers=1.0,
+            client=types.SimpleNamespace(set_compression_lr=sent.append),
+        )
+        fake._apply_lr_to_chain = PipelineEngine._apply_lr_to_chain
+        fake._maybe_send_lr = lambda: PipelineEngine._maybe_send_lr(fake)
+        PipelineEngine.set_compression_lr(fake, 0.25)
+        # the EF stage sits under the momentum decorator
+        assert chain.inner.lr == 0.25
+        assert sent == [0.25]  # servers get the lr over the wire
+        PipelineEngine.set_compression_lr(fake, 0.25)
+        assert sent == [0.25]  # unchanged lr: no repeat wire traffic
+
+    def test_api_noop_without_engine(self):
+        import byteps_tpu as bps
+
+        bps.init()
+        bps.api.set_compression_lr(0.5)  # non-distributed: engine is None
+        bps.shutdown()
+
+
+@pytest.fixture
+def mx_cluster(monkeypatch):
+    pytest.importorskip("mxnet")  # the surface tests need real mxnet
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.server.server import PSServer
+
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    srv = PSServer(Config.from_env())
+    threading.Thread(target=srv.start, daemon=True).start()
+    yield
+    srv.stop()
+    sched.stop()
+
+
+class TestMXNetSurface:
+    def test_push_pull_identity(self, mx_cluster):
+        import mxnet as mx
+
+        import byteps_tpu.mxnet as bps
+
+        bps.init()
+        x = mx.nd.array(np.arange(64, dtype=np.float32))
+        bps.byteps_declare_tensor("mx.t0")
+        out = bps.byteps_push_pull(x, name="mx.t0", is_average=True)
+        np.testing.assert_allclose(out.asnumpy(), np.arange(64, dtype=np.float32))
+        bps.shutdown()
+
+    def test_broadcast_parameters(self, mx_cluster):
+        import mxnet as mx
+
+        import byteps_tpu.mxnet as bps
+
+        bps.init()
+        params = {"w": mx.nd.ones((4, 4)), "b": mx.nd.full((4,), 3.0)}
+        bps.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(params["w"].asnumpy(), np.ones((4, 4)))
+        bps.shutdown()
+
+    def test_trainer_step(self, mx_cluster):
+        import mxnet as mx
+
+        import byteps_tpu.mxnet as bps
+
+        bps.init()
+        net = mx.gluon.nn.Dense(2)
+        net.initialize()
+        x = mx.nd.ones((8, 4))
+        with mx.autograd.record():
+            y = net(x)
+            loss = (y * y).mean()
+        loss.backward()
+        trainer = bps.DistributedTrainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.1}
+        )
+        trainer.step(8)
+        bps.shutdown()

@@ -264,6 +264,65 @@ class TestCompressionOverPS:
         bps.shutdown()
 
 
+    def test_ef_lr_reaches_server_chains(self, fake_cluster, monkeypatch):
+        """bps.set_compression_lr must scale the EF residual on BOTH
+        sides of the wire: the worker chain directly, the server chain
+        via the lr-update control message (the reference's lr.s mmap,
+        vanilla_error_feedback.h:44-58).  Proven numerically: a mid-run
+        lr change must keep the PS trajectory bit-matched to a
+        simulation whose sims get set_lr at the same step."""
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        import byteps_tpu as bps
+        from byteps_tpu.compression.registry import create_compressor
+
+        bps.init()
+        n, rounds = 64, 6
+        kwargs = {
+            "byteps_compressor_type": "randomk",
+            "byteps_compressor_k": "16",
+            "byteps_ef_type": "vanilla",
+            "byteps_seed": "99",
+        }
+        # lr set BEFORE any chain exists anywhere: must be remembered,
+        # applied to worker chains on creation and shipped with the
+        # first registration (the trainer's first step does exactly this)
+        bps.set_compression_lr(0.5)
+        bps.declare_tensor("c.eflr", **kwargs)
+        worker_sim = create_compressor(kwargs, n, server=False)
+        server_sim = create_compressor(kwargs, n, server=True)
+        worker_sim.set_lr(0.5)
+        server_sim.set_lr(0.5)
+        rng = np.random.default_rng(3)
+
+        def roundtrip(name, g, wsim, ssim, r):
+            out = np.asarray(bps.push_pull(g, name=name, average=False))
+            pushed = wsim.compress(g)
+            merged = wsim.decompress(pushed, n)
+            pulled = ssim.compress(merged)
+            expected = ssim.decompress(pulled, n)
+            np.testing.assert_allclose(
+                out, expected, rtol=1e-6, err_msg=f"{name} round {r}"
+            )
+
+        for r in range(rounds):
+            if r == 2:  # mid-run change after chains exist on both sides
+                bps.set_compression_lr(0.25)
+                worker_sim.set_lr(0.25)
+                server_sim.set_lr(0.25)
+            roundtrip("c.eflr", rng.normal(size=n).astype(np.float32), worker_sim, server_sim, r)
+
+        # a tensor declared AFTER the lr changes must inherit 0.25 on
+        # both sides (late-registered chains)
+        kwargs2 = dict(kwargs, byteps_seed="101")
+        bps.declare_tensor("c.eflr2", **kwargs2)
+        wsim2 = create_compressor(kwargs2, n, server=False)
+        ssim2 = create_compressor(kwargs2, n, server=True)
+        wsim2.set_lr(0.25)
+        ssim2.set_lr(0.25)
+        for r in range(3):
+            roundtrip("c.eflr2", rng.normal(size=n).astype(np.float32), wsim2, ssim2, r)
+        bps.shutdown()
+
     def test_async_mode_with_compression(self, monkeypatch):
         """Async parameter-store mode + codec: pulls must come back in the
         puller's requested wire format (compressed on demand).  The async
