@@ -290,3 +290,84 @@ class TestTFMultiWorker:
             assert p.returncode == 0, f"tf worker {i} failed:\n{out}"
         combined = "".join(outs)
         assert "TF_WORKER_0_OK" in combined and "TF_WORKER_1_OK" in combined
+
+
+class TestTFDistributeStrategy:
+    """tf.distribute integration (the reference's MirroredStrategy fork +
+    BytepsCrossDeviceOps, mirrored_strategy.py:349-414,
+    cross_device_ops.py:585-627 — TF2's cross_device_ops constructor arg
+    replaces the fork)."""
+
+    def test_strategy_reduce_single_worker(self):
+        from byteps_tpu.tensorflow.distribute import MirroredStrategy
+
+        bps.init()
+        strategy = MirroredStrategy(devices=["/cpu:0"])
+
+        with strategy.scope():
+            v = tf.Variable(2.0)
+
+        def step():
+            return v * 3.0
+
+        per_replica = strategy.run(step)
+        out = strategy.reduce(tf.distribute.ReduceOp.SUM, per_replica, axis=None)
+        np.testing.assert_allclose(float(out), 6.0)
+        bps.shutdown()
+
+    def test_cross_device_ops_route_through_push_pull(self, monkeypatch):
+        """The cross-worker hop must be the PS plane: with a fake cluster
+        and 1 worker, a SUM reduce through the strategy equals the local
+        value (identity through the server), and the PS server must have
+        seen CrossDeviceReduce keys."""
+        import threading
+
+        from byteps_tpu.common.config import Config
+        from byteps_tpu.comm.rendezvous import Scheduler
+        from byteps_tpu.server.server import PSServer
+
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+        try:
+            from tensorflow.python.distribute.values import PerReplica
+
+            from byteps_tpu.tensorflow.distribute import BytepsCrossDeviceOps
+
+            bps.init()
+            # a single-device strategy shortcuts reduces before reaching
+            # the ops, so drive the ops directly with a 2-replica value
+            ops = BytepsCrossDeviceOps()
+            per_replica = PerReplica([tf.constant([1.0, 2.0]), tf.constant([3.0, 4.0])])
+            out = ops.reduce(
+                tf.distribute.ReduceOp.SUM, per_replica, destinations="/cpu:0"
+            )
+            # local add_n then PS hop (identity with 1 worker)
+            np.testing.assert_allclose(np.asarray(tf.reshape(out, [-1])), [4.0, 6.0])
+
+            # assert on the SERVER's key table: the registry declares
+            # names before any network activity, but a server-side entry
+            # proves the cross-worker hop actually happened
+            from byteps_tpu.common.registry import get_registry
+
+            reduce_keys = {
+                c.base_key for c in get_registry().contexts_in_order()
+                if "CrossDeviceReduce" in c.name
+            }
+            assert reduce_keys, "no CrossDeviceReduce tensor was declared"
+            served = set()
+            for key in srv._keys:
+                served.add(key >> 16)  # partition keys carry declared_key<<16
+            assert {k >> 16 for k in reduce_keys} & served, (
+                "PS server never saw a CrossDeviceReduce key"
+            )
+            bps.shutdown()
+        finally:
+            srv.stop()
+            sched.stop()
