@@ -44,6 +44,55 @@ def get_state() -> RuntimeState:
     return _state
 
 
+_jax_distributed_up = False
+
+
+def _init_jax_distributed(cfg: Config) -> None:
+    """Bring up the JAX distributed runtime (multi-host pod slices;
+    SURVEY §5.8: scheduler node ↔ jax.distributed coordinator).
+
+    On Cloud TPU pods ``jax.distributed.initialize()`` auto-detects
+    everything from instance metadata; elsewhere (multi-process CPU
+    clusters, custom deployments) the coordinator must be explicit:
+
+        BYTEPS_JAX_COORDINATOR=host:port
+        BYTEPS_JAX_NUM_PROCESSES (default DMLC_NUM_WORKER)
+        BYTEPS_JAX_PROCESS_ID    (default BYTEPS_GLOBAL_RANK/DMLC_WORKER_ID)
+
+    The runtime survives suspend/resume (re-initializing the coordination
+    service would drop every other host's connection; the reference's
+    ps-lite similarly keeps its Postoffice across byteps_resume)."""
+    global _jax_distributed_up
+    if _jax_distributed_up:
+        return
+    import os
+
+    import jax
+
+    kwargs = {}
+    coord = os.environ.get("BYTEPS_JAX_COORDINATOR", "")
+    if coord:
+        # empty-string env values (a common way to "unset" in env files)
+        # fall back like missing ones
+        pid = os.environ.get("BYTEPS_JAX_PROCESS_ID") or (
+            cfg.global_rank if cfg.global_rank is not None else cfg.worker_id
+        )
+        nprocs = os.environ.get("BYTEPS_JAX_NUM_PROCESSES") or cfg.num_worker
+        kwargs = dict(
+            coordinator_address=coord,
+            num_processes=int(nprocs),
+            process_id=int(pid),
+        )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # tolerate a runtime someone else already brought up (jax's
+        # message: "distributed.initialize should only be called once.")
+        if "once" not in str(e).lower() and "already" not in str(e).lower():
+            raise
+    _jax_distributed_up = True
+
+
 def init_state(fresh_env: bool = True) -> RuntimeState:
     """Bring the process up (global.cc:105-297 + operations.cc:41-88)."""
     import jax
@@ -67,7 +116,7 @@ def init_state(fresh_env: bool = True) -> RuntimeState:
         import os
 
         if os.environ.get("BYTEPS_JAX_DISTRIBUTED", "0") == "1":
-            jax.distributed.initialize()
+            _init_jax_distributed(cfg)
         st.mesh = build_mesh(cfg.mesh_shape)
         set_global_mesh(st.mesh)
         st.telemetry = PushPullSpeed(enabled=cfg.telemetry_on)
