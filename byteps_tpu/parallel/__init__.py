@@ -7,5 +7,9 @@ all expressed as mesh axes under one ``shard_map`` — the north-star
 composition SURVEY §2.7/§7 calls for.
 """
 
-from byteps_tpu.parallel.mesh_utils import factorize_mesh, make_training_mesh
+from byteps_tpu.parallel.mesh_utils import (
+    factorize_mesh,
+    make_hybrid_mesh,
+    make_training_mesh,
+)
 from byteps_tpu.parallel.ring_attention import ring_attention

@@ -63,3 +63,58 @@ def make_training_mesh(
         raise ValueError(f"axis sizes {axis_sizes} != {n} devices")
     arr = np.array(devices).reshape(shape)
     return Mesh(arr, tuple(axis_order))
+
+
+def make_hybrid_mesh(
+    ici: Dict[str, int],
+    dcn: Dict[str, int],
+    axis_order: Optional[Sequence[str]] = None,
+    devices: Optional[Sequence] = None,
+    process_is_granule: Optional[bool] = None,
+) -> Mesh:
+    """Two-level mesh for multi-host/multi-slice jobs: ``dcn`` axis
+    factors span hosts or pod slices (the slow plane the reference
+    crosses with ps-lite, SURVEY §2.4/§5.8), ``ici`` factors stay inside
+    one host/slice so collectives on those axes ride the fast
+    interconnect only.
+
+    Each mesh axis's size is ``ici[ax] * dcn[ax]`` (either defaults to
+    1). Device placement delegates to jax's
+    ``mesh_utils.create_hybrid_device_mesh``, which lays devices out
+    granule-major.  ``process_is_granule`` auto-selects: a granule is a
+    pod slice when the devices actually span multiple slices
+    (multi-slice TPU), otherwise a process (multi-host within one
+    slice, and every non-TPU platform).
+
+        # 2 hosts × 8 chips: data-parallel over DCN, tensor-parallel on ICI
+        mesh = make_hybrid_mesh(ici={"tp": 8}, dcn={"dp": 2})
+    """
+    from jax.experimental import mesh_utils as jmu
+
+    devices = list(devices if devices is not None else jax.devices())
+    if axis_order is None:
+        seen = dict.fromkeys(("dp", "pp", "sp", "tp"))
+        for ax in list(ici) + list(dcn):
+            seen.setdefault(ax)
+        axis_order = [ax for ax in seen if ax in ici or ax in dcn]
+    ici_shape = [ici.get(ax, 1) for ax in axis_order]
+    dcn_shape = [dcn.get(ax, 1) for ax in axis_order]
+    total = int(np.prod(ici_shape)) * int(np.prod(dcn_shape))
+    if total != len(devices):
+        raise ValueError(
+            f"hybrid mesh ici={ici} × dcn={dcn} wants {total} devices, "
+            f"have {len(devices)}"
+        )
+    if process_is_granule is None:
+        if devices[0].platform == "tpu":
+            # slice granules only when there IS more than one slice —
+            # a multi-host single-slice pod must group by process
+            slices = {getattr(d, "slice_index", 0) for d in devices}
+            process_is_granule = len(slices) <= 1
+        else:
+            process_is_granule = True
+    arr = jmu.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=devices,
+        process_is_granule=process_is_granule,
+    )
+    return Mesh(arr, tuple(axis_order))

@@ -8,10 +8,49 @@ because a jax.distributed runtime cannot be torn down cleanly inside
 the main pytest process.
 """
 
+import os
 import socket
 import subprocess
 import sys
 import textwrap
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_two_workers(tmp_path, script_text, marker):
+    """Launch two copies of ``script_text`` (argv: pid, free-port) and
+    assert both exit 0 and print ``<marker>_<pid>_OK``."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(script_text)
+    env = {**os.environ, "PYTHONPATH": _REPO_ROOT}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(port)],
+            cwd=_REPO_ROOT,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=150)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"{marker} worker {i} failed:\n{out}"
+    combined = "".join(outs)
+    assert f"{marker}_0_OK" in combined and f"{marker}_1_OK" in combined
+
 
 _WORKER = textwrap.dedent(
     """
@@ -59,34 +98,52 @@ _WORKER = textwrap.dedent(
 
 
 def test_two_process_cluster_psum(tmp_path):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    script = tmp_path / "jaxdist_worker.py"
-    script.write_text(_WORKER)
-    import os
+    _run_two_workers(tmp_path, _WORKER, "JAXDIST")
 
-    env = {**os.environ, "PYTHONPATH": "/root/repo"}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(i), str(port)],
-            cwd="/root/repo",
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            outs.append(p.communicate(timeout=150)[0])
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"jaxdist worker {i} failed:\n{out}"
-    combined = "".join(outs)
-    assert "JAXDIST_0_OK" in combined and "JAXDIST_1_OK" in combined
+
+_HYBRID_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["BYTEPS_JAX_DISTRIBUTED"] = "1"
+    os.environ["BYTEPS_JAX_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["BYTEPS_JAX_NUM_PROCESSES"] = "2"
+    os.environ["BYTEPS_JAX_PROCESS_ID"] = str(pid)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import byteps_tpu as bps
+    bps.init()
+    assert jax.device_count() == 8
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from byteps_tpu.parallel.mesh_utils import make_hybrid_mesh
+
+    # dp spans the two processes (DCN plane), tp the 4 local devices (ICI)
+    mesh = make_hybrid_mesh(ici={"tp": 4}, dcn={"dp": 2})
+    assert mesh.shape == {"dp": 2, "tp": 4}, mesh.shape
+    # every device in one dp row must belong to one process (granule-major
+    # layout: tp collectives never cross the slow plane)
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1, mesh.devices
+
+    f = jax.jit(shard_map(lambda x: jax.lax.psum(x, ("dp", "tp")),
+                          mesh=mesh, in_specs=P(("dp", "tp")), out_specs=P()))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(("dp", "tp"))),
+        np.arange(4, dtype=np.float32) + 4.0 * pid,
+    )
+    out = float(np.asarray(jax.device_get(f(arr)))[()])
+    assert out == 28.0, out  # sum(0..7)
+    print(f"HYBRID_{pid}_OK", flush=True)
+    bps.shutdown()
+    """
+)
+
+
+def test_hybrid_dcn_ici_mesh(tmp_path):
+    _run_two_workers(tmp_path, _HYBRID_WORKER, "HYBRID")
