@@ -115,9 +115,9 @@ def main() -> None:
             (int(os.environ["BENCH_BATCH"]), os.environ.get("BENCH_REMAT", "0") == "1")
         ]
     else:
-        # try the measured-good config AND the remat+batch-64 candidate
-        # (reference per-GPU batch); report whichever is faster
-        configs = [(32, False), (64, True)]
+        # try the two measured-best configs (remat + large batch; dense
+        # attention — see TransformerConfig.use_flash); report the faster
+        configs = [(128, True), (64, True)]
 
     tried = {}
     best = None

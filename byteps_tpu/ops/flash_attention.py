@@ -114,10 +114,28 @@ def _fwd_kernel_factory(dh, bq, bk, nk, causal, scale):
     return kernel
 
 
+# vma typing (varying-manual-axes) exists from jax 0.7+; on older versions
+# ShapeDtypeStruct has no vma kwarg, so callers must omit it entirely.
+_HAS_VMA = "vma" in getattr(
+    getattr(jax.ShapeDtypeStruct.__init__, "__code__", None), "co_varnames", ()
+)
+
+
+def _vma_union(*xs):
+    """Union of the inputs' varying-manual-axes sets, for pallas out_shapes.
+
+    Under ``shard_map(check_vma=True)`` pallas_call outputs must declare how
+    they vary across the manual mesh axes; the attention output varies over
+    exactly the axes any of q/k/v vary over.
+    """
+    return frozenset().union(*(jax.typeof(x).vma for x in xs))
+
+
 def _flash_forward(q, k, v, causal, scale, bq, bk, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    vma_kw = {"vma": _vma_union(q, k, v)} if _HAS_VMA else {}
     b, h, s, dh = q.shape
     nk = s // bk
     bh = b * h
@@ -127,8 +145,8 @@ def _flash_forward(q, k, v, causal, scale, bq, bk, interpret):
     out, lse = pl.pallas_call(
         _fwd_kernel_factory(dh, bq, bk, nk, causal, scale),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, dh), q.dtype, **vma_kw),
+            jax.ShapeDtypeStruct((bh, s, LANES), jnp.float32, **vma_kw),
         ),
         grid=(bh, s // bq, nk),
         in_specs=[
@@ -245,6 +263,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    vma_kw = {"vma": _vma_union(q, k, v, o, lse, do)} if _HAS_VMA else {}
     b, h, s, dh = q.shape
     bh = b * h
     nq, nk = s // bq, s // bk
@@ -257,7 +276,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
 
     dq = pl.pallas_call(
         _bwd_dq_kernel_factory(dh, bq, bk, nk, causal, scale),
-        out_shape=jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s, dh), q.dtype, **vma_kw),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
@@ -275,8 +294,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
     dk, dv = pl.pallas_call(
         _bwd_dkv_kernel_factory(dh, bq, bk, nq, causal, scale),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, s, dh), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, dh), v.dtype),
+            jax.ShapeDtypeStruct((bh, s, dh), k.dtype, **vma_kw),
+            jax.ShapeDtypeStruct((bh, s, dh), v.dtype, **vma_kw),
         ),
         grid=(bh, nk, nq),
         in_specs=[

@@ -39,10 +39,18 @@ def _pack_kernel(words_per_block: int):
     from jax.experimental import pallas as pl
 
     def kernel(x_ref, out_ref):
-        # x block: (words_per_block, 32) fp32; out block: (words_per_block,)
-        bits = jnp.signbit(x_ref[:]).astype(jnp.uint32)
-        weights = (jnp.uint32(1) << jax.lax.broadcasted_iota(jnp.uint32, bits.shape, 1))
-        out_ref[:] = jnp.sum(bits * weights, axis=1).astype(jnp.uint32)
+        # x block: (words_per_block, 32) fp32; out block: (8, wpb/8) u32.
+        # Mosaic has no unsigned reductions: accumulate in int32 — the
+        # weights are distinct powers of two, so the wrapping sum is exactly
+        # the bitwise OR pattern — and bitcast at the store.
+        bits = jnp.signbit(x_ref[:]).astype(jnp.int32)
+        weights = jnp.left_shift(
+            jnp.int32(1), jax.lax.broadcasted_iota(jnp.int32, bits.shape, 1)
+        )
+        acc = jnp.sum(bits * weights, axis=1)  # (words_per_block,)
+        out_ref[:] = jax.lax.bitcast_convert_type(
+            acc.reshape(out_ref.shape), jnp.uint32
+        )
 
     return kernel
 
@@ -62,23 +70,25 @@ def onebit_compress_device(
     n = flat.shape[0]
     on_tpu = jax.devices()[0].platform == "tpu"
     nwords = (n + 31) // 32
-    if (not on_tpu and not interpret) or n % (32 * 256) != 0:
+    wpb = 1024  # words per grid cell → one native (8, 128) u32 output tile
+    if (not on_tpu and not interpret) or n % (32 * wpb) != 0:
         return _pack_jnp(flat, scaling)
 
     scale = jnp.where(
         scaling, jnp.sum(jnp.abs(flat)) / n, jnp.float32(1.0)
     ).astype(jnp.float32)
     x = flat.reshape(nwords, 32)
-    wpb = 256  # words per grid cell → (256, 32) fp32 blocks in VMEM
+    # Output blocks must be native (8, 128) u32 tiles: 1-D or (1, wpb)
+    # blocks trip Mosaic's layout/divisibility checks.
     words = pl.pallas_call(
         _pack_kernel(wpb),
-        out_shape=jax.ShapeDtypeStruct((nwords,), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((nwords // 128, 128), jnp.uint32),
         grid=(nwords // wpb,),
         in_specs=[pl.BlockSpec((wpb, 32), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((wpb,), lambda i: (i,)),
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
         interpret=interpret,
     )(x)
-    return scale, words
+    return scale, words.reshape(nwords)
 
 
 def onebit_payload(scale: jax.Array, words: jax.Array) -> bytes:

@@ -77,18 +77,26 @@ class TestFlashAttention:
 
 class TestOneBitDevice:
     def test_wire_parity_with_host_codec(self):
-        """Device-compressed payload must be byte-identical to the host
-        OneBitCompressor so the PS server decodes it unchanged."""
+        """Device-compressed sign words must be byte-identical to the host
+        OneBitCompressor so the PS server decodes it unchanged.  The f32
+        scale (sum(|g|)/n) may differ by an ULP from the host codec's
+        accumulation order at kernel-eligible sizes, so it gets a float
+        comparison rather than a byte one."""
         from byteps_tpu.compression.impl import OneBitCompressor
 
         rng = np.random.default_rng(3)
-        n = 32 * 256 * 2  # kernel-eligible size
+        n = 32 * 1024 * 2  # kernel-eligible size (multiple of 32*wpb, wpb=1024)
         g = rng.normal(size=n).astype(np.float32)
         scale, words = onebit_compress_device(jnp.asarray(g), scaling=True,
                                               interpret=True)
         dev_payload = onebit_payload(scale, words)
         host_payload = OneBitCompressor(n, scaling=True).compress(g)
-        assert dev_payload == host_payload
+        assert dev_payload[4:] == host_payload[4:]  # sign words: bit-exact
+        np.testing.assert_allclose(
+            np.frombuffer(dev_payload[:4], np.float32),
+            np.frombuffer(host_payload[:4], np.float32),
+            rtol=1e-6,
+        )
 
     def test_roundtrip_on_device(self):
         rng = np.random.default_rng(4)

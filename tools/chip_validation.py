@@ -96,10 +96,10 @@ def check_onebit_device() -> None:
     import ctypes
 
     rng = np.random.default_rng(2)
-    # n must be a multiple of 32*256 or the Pallas kernel path is skipped
+    # n must be a multiple of 32*1024 or the Pallas kernel path is skipped
     # for the jnp fallback (onebit_device.py:65) — the kernel IS the item
     # under validation here
-    n = 32 * 256 * 2
+    n = 32 * 1024 * 2
     x = rng.normal(size=n).astype(np.float32)
     scale, words = onebit_compress_device(jnp.asarray(x), scaling=True)
     out = np.empty(4 + 4 * ((n + 31) // 32), dtype=np.uint8)
