@@ -568,18 +568,20 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
     (static ``max_seq`` shapes — XLA-friendly), appends its K/V, and emits
     the argmax.  O(S) attention per new token instead of O(S²) recompute.
 
-    Supported mesh axes: dp (batch) and tp (heads); requires causal
-    config, pp == sp == 1, dense MLP.
+    Supported mesh axes: dp (batch), tp (heads), pp (layer stages: each
+    token's forward hops stage→stage via ppermute, the decode-inherent
+    pipeline bubble), and sp (replicated — sequence parallelism has no
+    per-token decode role, so sp members redundantly compute the same
+    rows).  Requires causal config and dense MLP.
     """
     if not cfg.causal:
         raise ValueError("generation requires a causal config")
-    if mesh.shape.get("pp", 1) != 1 or mesh.shape.get("sp", 1) != 1:
-        raise ValueError("cached decoding supports dp/tp meshes (pp=sp=1)")
     if cfg.moe:
         raise ValueError("cached decoding does not support MoE yet")
 
     cdt = cfg.compute_dtype
     S_max = cfg.max_seq
+    pp = mesh.shape.get("pp", 1)
 
     def cached_layer(x, lp, kc, vc, offset):
         """x: (B, s, D); kc/vc: (B, H_local, S_max, dh); returns updated
@@ -616,6 +618,32 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         x, (kcs, vcs) = lax.scan(body, x, (stage_params, kcs, vcs))
         return x, kcs, vcs
 
+    def full_stack(stage_params, x, kcs, vcs, offset):
+        """Run the FULL model depth.  With pp == 1 that is just the local
+        stack; otherwise unrolled pp turns: at turn s only stage s runs its
+        local layers (lax.cond keeps the others idle — the decode-inherent
+        pipeline bubble), then the residual hops to stage s+1 via ppermute.
+        The last stage's output is psum-broadcast so every stage computes
+        the same logits/token (head params are replicated over pp)."""
+        if pp == 1:
+            return run_layers(stage_params, x, kcs, vcs, offset)
+        pp_idx = lax.axis_index("pp")
+
+        def mine(ops):
+            xx, kk, vv = ops
+            return run_layers(stage_params, xx, kk, vv, offset)
+
+        for turn in range(pp):
+            x, kcs, vcs = lax.cond(
+                pp_idx == turn, mine, lambda ops: ops, (x, kcs, vcs)
+            )
+            if turn != pp - 1:
+                x = lax.ppermute(
+                    x, "pp", [(j, (j + 1) % pp) for j in range(pp)]
+                )
+        x = lax.psum(jnp.where(pp_idx == pp - 1, x, jnp.zeros_like(x)), "pp")
+        return x, kcs, vcs
+
     def logits_of(params, x):
         h = _ln(x, params["ln_f_s"], params["ln_f_b"]).astype(cdt)
         return jnp.einsum("bsd,dv->bsv", h, params["head"].astype(cdt))
@@ -632,7 +660,7 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         per step AND per dp shard so every row draws independently."""
         stage_params = {k: v[0] for k, v in params.items() if _is_layer_param(k)}
         b, s0 = tokens.shape
-        L = cfg.n_layers
+        L = stage_params["wq"].shape[0]  # pp-local layer count
         h_local = stage_params["wq"].shape[2]  # tp-local head count
         kcs = jnp.zeros((L, b, h_local, S_max, cfg.d_head), cdt)
         vcs = jnp.zeros_like(kcs)
@@ -655,13 +683,13 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
 
         positions = jnp.arange(s0)
         x = params["embed"][tokens] + params["pos"][positions]
-        x, kcs, vcs = run_layers(stage_params, x.astype(cdt), kcs, vcs, 0)
+        x, kcs, vcs = full_stack(stage_params, x.astype(cdt), kcs, vcs, 0)
         last = pick(logits_of(params, x)[:, -1, :], 0)
 
         def step(carry, i):
             kcs, vcs, tok, pos = carry
             x = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cdt)
-            x, kcs, vcs = run_layers(stage_params, x, kcs, vcs, pos)
+            x, kcs, vcs = full_stack(stage_params, x, kcs, vcs, pos)
             nxt = pick(logits_of(params, x)[:, -1, :], i + 1)
             return (kcs, vcs, nxt, pos + 1), tok
 

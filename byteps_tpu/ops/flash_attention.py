@@ -163,6 +163,12 @@ def _flash_forward(q, k, v, causal, scale, bq, bk, interpret):
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, dh), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            # bh and q-block cells are independent; only the k scan (which
+            # accumulates into scratch) is order-dependent — telling Mosaic
+            # lets it pipeline/parallelize the outer grid dims
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, s, dh), lse
@@ -288,6 +294,9 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
         ],
         out_specs=pl.BlockSpec((1, bq, dh), lambda i, qi, j: (i, qi, 0)),
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
@@ -314,6 +323,9 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             pltpu.VMEM((bk, dh), jnp.float32),
             pltpu.VMEM((bk, dh), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 

@@ -65,6 +65,7 @@ def onebit_compress_device(
     [f32 scale][u32 words] — identical to OneBitCompressor's payload.
     """
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     flat = grad.reshape(-1).astype(jnp.float32)
     n = flat.shape[0]
@@ -86,6 +87,7 @@ def onebit_compress_device(
         grid=(nwords // wpb,),
         in_specs=[pl.BlockSpec((wpb, 32), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x)
     return scale, words.reshape(nwords)

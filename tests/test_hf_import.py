@@ -142,6 +142,46 @@ class TestGeneration:
         )
         np.testing.assert_array_equal(g1, g4)
 
+    def test_kv_cached_pp2_sp2(self, gpt2_small):
+        """Cached decode under dp=2 x pp=2 x sp=2: the residual hops stage
+        to stage over pp, sp members replicate — tokens must match the
+        single-device decoder exactly."""
+        from byteps_tpu.models.transformer import build_generate_cached
+
+        prompt = np.array(
+            [[5, 17, 42, 7], [9, 3, 88, 21], [1, 2, 3, 4], [60, 61, 62, 63]],
+            dtype=np.int32,
+        )
+        cfg1, pnp1 = load_gpt2_weights(gpt2_small)
+        mesh1 = make_training_mesh(1, {"dp": 1, "pp": 1, "sp": 1, "tp": 1})
+        g1 = build_generate_cached(cfg1, mesh1)(
+            shard_params(pnp1, cfg1, mesh1), prompt, n_new=6
+        )
+        cfg8, pnp8 = load_gpt2_weights(gpt2_small, pp_size=2)
+        mesh8 = make_training_mesh(8, {"dp": 2, "pp": 2, "sp": 2, "tp": 1})
+        g8 = build_generate_cached(cfg8, mesh8)(
+            shard_params(pnp8, cfg8, mesh8), prompt, n_new=6
+        )
+        np.testing.assert_array_equal(g1, g8)
+
+    def test_kv_cached_pp2_tp2(self, gpt2_small):
+        """pp x tp cached decode: the tp head psum runs inside each stage's
+        cond branch (uniform predicate across the tp group)."""
+        from byteps_tpu.models.transformer import build_generate_cached
+
+        prompt = np.array([[5, 17, 42, 7], [9, 3, 88, 21]], dtype=np.int32)
+        cfg1, pnp1 = load_gpt2_weights(gpt2_small)
+        mesh1 = make_training_mesh(1, {"dp": 1, "pp": 1, "sp": 1, "tp": 1})
+        g1 = build_generate_cached(cfg1, mesh1)(
+            shard_params(pnp1, cfg1, mesh1), prompt, n_new=6
+        )
+        cfg4, pnp4 = load_gpt2_weights(gpt2_small, pp_size=2)
+        mesh4 = make_training_mesh(4, {"dp": 1, "pp": 2, "sp": 1, "tp": 2})
+        g4 = build_generate_cached(cfg4, mesh4)(
+            shard_params(pnp4, cfg4, mesh4), prompt, n_new=6
+        )
+        np.testing.assert_array_equal(g1, g4)
+
     def test_kv_cached_sampling(self, gpt2_small):
         """temperature=0 equals greedy; temperature>0 is deterministic per
         seed, varies across seeds, and top_k=1 collapses back to greedy."""

@@ -97,7 +97,7 @@ def check_onebit_device() -> None:
 
     rng = np.random.default_rng(2)
     # n must be a multiple of 32*1024 or the Pallas kernel path is skipped
-    # for the jnp fallback (onebit_device.py:65) — the kernel IS the item
+    # for the jnp fallback (onebit_device.py:75) — the kernel IS the item
     # under validation here
     n = 32 * 1024 * 2
     x = rng.normal(size=n).astype(np.float32)
