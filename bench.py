@@ -22,6 +22,10 @@ import time
 import numpy as np
 
 
+_LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".bench_last_good.json")
+
+
 def _probe_devices(timeout_s: float = 180.0):
     """Device discovery with a watchdog: a dead accelerator tunnel must
     produce a JSON result, not a hang (the driver records this output)."""
@@ -44,6 +48,19 @@ def _probe_devices(timeout_s: float = 180.0):
     t.join(timeout_s)
     if "devices" in result:
         return result["devices"]
+    extra = {
+        "error": result.get(
+            "error", f"device init exceeded {timeout_s}s (accelerator tunnel down?)"
+        )
+    }
+    # the tunnel to the chip comes and goes in this environment; surface the
+    # last measurement that DID complete on hardware (value stays 0 — this
+    # run measured nothing)
+    try:
+        with open(_LAST_GOOD_PATH) as f:
+            extra["last_good"] = json.load(f)
+    except (OSError, ValueError):  # missing OR truncated/corrupt cache
+        pass
     print(
         json.dumps(
             {
@@ -51,9 +68,7 @@ def _probe_devices(timeout_s: float = 180.0):
                 "value": 0,
                 "unit": "samples/s",
                 "vs_baseline": 0,
-                "extra": {
-                    "error": result.get("error", f"device init exceeded {timeout_s}s (accelerator tunnel down?)")
-                },
+                "extra": extra,
             }
         )
     )
@@ -142,9 +157,7 @@ def main() -> None:
     mfu = samples_per_sec * flops_per_sample / peak_bf16
     baseline_samples_per_sec = 0.40 * peak_bf16 / flops_per_sample
 
-    print(
-        json.dumps(
-            {
+    payload = {
                 "metric": "bert_large_train_samples_per_sec_per_chip",
                 "value": round(samples_per_sec, 2),
                 "unit": "samples/s",
@@ -166,8 +179,20 @@ def main() -> None:
                     ),
                 },
             }
-        )
-    )
+    try:
+        import datetime
+
+        tmp = _LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                dict(payload, measured_at=datetime.datetime.now(
+                    datetime.timezone.utc).isoformat()),
+                f,
+            )
+        os.replace(tmp, _LAST_GOOD_PATH)  # atomic: no truncated cache
+    except OSError:
+        pass
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

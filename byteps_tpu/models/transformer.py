@@ -296,6 +296,25 @@ def _dense_mlp(cfg: TransformerConfig, x, lp):
     return x + y.astype(x.dtype)
 
 
+def _moe_block(cfg: TransformerConfig, x, lp, sp: int,
+               capacity_factor: float):
+    """Shared MoE MLP block (ln2 → routed expert MLP → residual), used by
+    the training layer and the cached decoder so the two cannot drift."""
+    cdt = cfg.compute_dtype
+    g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)
+    b_, s_, d_ = g.shape
+    y = moe_mlp(
+        g.reshape(b_ * s_, d_),
+        lp["router"].astype(cdt),
+        lp["ew1"].astype(cdt), lp["eb1"].astype(cdt),
+        lp["ew2"].astype(cdt), lp["eb2"].astype(cdt),
+        axis_name="sp" if sp > 1 else None,
+        axis_size=sp,
+        capacity_factor=capacity_factor,
+    ).reshape(b_, s_, d_)
+    return x + y.astype(x.dtype)
+
+
 def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
     sp = mesh.shape.get("sp", 1)
     tp = mesh.shape.get("tp", 1)
@@ -325,20 +344,11 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
         if cfg.moe:
             g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)
             b_, s_, d_ = g.shape
-            flat = g.reshape(b_ * s_, d_)
-            y = moe_mlp(
-                flat,
-                lp["router"].astype(cdt),
-                lp["ew1"].astype(cdt), lp["eb1"].astype(cdt),
-                lp["ew2"].astype(cdt), lp["eb2"].astype(cdt),
-                axis_name="sp" if sp > 1 else None,
-                axis_size=sp,
-                capacity_factor=cfg.capacity_factor,
-            ).reshape(b_, s_, d_)
             aux = moe_aux_loss(
-                flat, lp["router"].astype(cdt), sp, lp["ew1"].shape[0]
+                g.reshape(b_ * s_, d_), lp["router"].astype(cdt), sp,
+                lp["ew1"].shape[0],
             )
-            x = x + y.astype(x.dtype)
+            x = _moe_block(cfg, x, lp, sp, cfg.capacity_factor)
         else:
             x = _dense_mlp(cfg, x, lp)
             aux = jnp.zeros((), cdt)
@@ -570,18 +580,18 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
 
     Supported mesh axes: dp (batch), tp (heads), pp (layer stages: each
     token's forward hops stage→stage via ppermute, the decode-inherent
-    pipeline bubble), and sp (replicated — sequence parallelism has no
-    per-token decode role, so sp members redundantly compute the same
-    rows).  Requires causal config and dense MLP.
+    pipeline bubble), and sp (replicated residual stream — sequence
+    parallelism has no per-token decode role; for MoE configs sp doubles
+    as the EXPERT axis, with the all_to_all dispatch running on the
+    replicated tokens).  Requires a causal config.
     """
     if not cfg.causal:
         raise ValueError("generation requires a causal config")
-    if cfg.moe:
-        raise ValueError("cached decoding does not support MoE yet")
 
     cdt = cfg.compute_dtype
     S_max = cfg.max_seq
     pp = mesh.shape.get("pp", 1)
+    sp = mesh.shape.get("sp", 1)
 
     def cached_layer(x, lp, kc, vc, offset):
         """x: (B, s, D); kc/vc: (B, H_local, S_max, dh); returns updated
@@ -604,6 +614,20 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cdt)
         ctx = jnp.einsum("bhst,bhtk->bhsk", attn, vc.astype(cdt))
         x = _attn_out(cfg, ctx, lp, x)
+        if cfg.moe:
+            # expert-parallel MLP: decode tokens are REPLICATED across the
+            # sp (expert) axis, and the all_to_all dispatch/inverse is
+            # copy-symmetric — every rank reassembles the full expert
+            # output, so the replicated-token result stays identical on
+            # all sp members (n redundant capacity copies, trivial at
+            # decode token counts).  Serving semantics: capacity covers
+            # EVERY token (cf = n_experts ⇒ capacity = t) — training-style
+            # capacity drops would zero a token's MLP output whenever a
+            # decode step's tiny token count concentrated on one expert.
+            return (
+                _moe_block(cfg, x, lp, sp, float(cfg.n_experts)),
+                kc, vc,
+            )
         return _dense_mlp(cfg, x, lp), kc, vc
 
     def run_layers(stage_params, x, kcs, vcs, offset):

@@ -168,6 +168,25 @@ class TestMoE:
         losses, _ = _run_steps(cfg, _mesh(), n_steps=6, batch=4, lr=0.05)
         assert losses[-1] < losses[0]
 
+    def test_moe_cached_decode_matches_single(self):
+        """KV-cached decode with MoE: experts sharded over sp, layers over
+        pp, batch over dp — tokens must match the single-device cached
+        decoder.  The cached path uses serving capacity (no token drops)
+        regardless of cfg.capacity_factor, so the default config must
+        agree across meshes."""
+        from byteps_tpu.models.transformer import build_generate_cached
+
+        cfg = tiny_test(moe=True, n_experts=4, causal=True)
+        prompt = np.array(
+            [[1, 2, 3], [4, 5, 6], [7, 8, 9], [3, 1, 2]], np.int32
+        )
+        p1 = shard_params(init_params(cfg, seed=3), cfg, _mesh())
+        g1 = build_generate_cached(cfg, _mesh())(p1, prompt, n_new=5)
+        mesh8 = _mesh(dp=2, pp=2, sp=2)
+        p8 = shard_params(init_params(cfg, seed=3, pp_size=2), cfg, mesh8)
+        g8 = build_generate_cached(cfg, mesh8)(p8, prompt, n_new=5)
+        np.testing.assert_array_equal(g1, g8)
+
 
 class TestForward:
     def test_forward_shapes(self):
