@@ -76,8 +76,10 @@ def _probe_devices(timeout_s: float = 180.0):
 
 
 def _run_config(batch: int, seq: int, steps: int, remat: bool):
-    """Compile + time one train-step config; returns (samples/s, loss) or
-    None if it does not fit (OOM)."""
+    """Compile + time one train-step config.  Returns (samples/s, loss,
+    cfg) on success, None on OOM, or ("error", msg) on any other failure
+    (e.g. a transient through-tunnel compile error) so remaining configs
+    still run."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -117,7 +119,9 @@ def _run_config(batch: int, seq: int, steps: int, remat: bool):
     except Exception as e:  # noqa: BLE001  (XlaRuntimeError / RESOURCE_EXHAUSTED)
         if "RESOURCE_EXHAUSTED" in repr(e) or "out of memory" in repr(e).lower():
             return None
-        raise
+        # transient through-tunnel compile failures (HTTP 500s from the
+        # remote compile service) must not kill configs that DO compile
+        return ("error", f"{type(e).__name__}: {repr(e)[:120]}")
 
 
 def main() -> None:
@@ -130,9 +134,10 @@ def main() -> None:
             (int(os.environ["BENCH_BATCH"]), os.environ.get("BENCH_REMAT", "0") == "1")
         ]
     else:
-        # try the two measured-best configs (remat + large batch; dense
-        # attention — see TransformerConfig.use_flash); report the faster
-        configs = [(128, True), (64, True)]
+        # try the measured-best configs plus the no-remat candidate (skips
+        # the ~30% recompute FLOPs if activations fit); dense attention —
+        # see TransformerConfig.use_flash.  Report the fastest that fits.
+        configs = [(128, False), (128, True), (64, True)]
 
     tried = {}
     best = None
@@ -142,12 +147,34 @@ def main() -> None:
         if res is None:
             tried[key] = "OOM"
             continue
+        if isinstance(res, tuple) and res[0] == "error":
+            tried[key] = res[1]
+            continue
         sps, loss, mcfg = res
         tried[key] = round(sps, 2)
         if best is None or sps > best[0]:
             best = (sps, loss, batch, remat, mcfg)
     if best is None:
-        raise SystemExit("no benchmark config fit in memory")
+        # every config OOM'd or failed to compile: still emit the JSON
+        # contract line (the driver records stdout, not tracebacks)
+        extra = {"error": "no benchmark config completed", "configs_tried": tried}
+        try:
+            with open(_LAST_GOOD_PATH) as f:
+                extra["last_good"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+        print(
+            json.dumps(
+                {
+                    "metric": "bert_large_train_samples_per_sec_per_chip",
+                    "value": 0,
+                    "unit": "samples/s",
+                    "vs_baseline": 0,
+                    "extra": extra,
+                }
+            )
+        )
+        raise SystemExit(0)
     samples_per_sec, loss, batch, remat, mcfg = best
 
     # model FLOPs per sample (fwd+bwd = 3x fwd): matmul params + attention

@@ -40,20 +40,31 @@ class _ServerConn:
         self.callbacks: Dict[int, Callable[[Message], None]] = {}
         self.next_seq = 0
         self.recv_thread: Optional[threading.Thread] = None
+        self.dead = False  # set once the recv loop exits; guarded by cb_lock
 
     def alloc_seq(self, cb: Callable[[Message], None]) -> int:
+        """Register a response callback; returns -1 (after firing
+        ``cb(None)``) if the connection already died — a request enqueued
+        AFTER the recv loop drained pending callbacks would otherwise
+        never fire and its caller would hang in synchronize()."""
         with self.cb_lock:
-            seq = self.next_seq
-            self.next_seq += 1
-            self.callbacks[seq] = cb
-            return seq
+            if not self.dead:
+                seq = self.next_seq
+                self.next_seq += 1
+                self.callbacks[seq] = cb
+                return seq
+        cb(None)  # outside the lock: callbacks run user code
+        return -1
 
     def pop_cb(self, seq: int) -> Optional[Callable[[Message], None]]:
         with self.cb_lock:
             return self.callbacks.pop(seq, None)
 
-    def pop_all(self):
+    def mark_dead(self):
+        """Flag the connection dead and drain pending callbacks (fired
+        with None by the caller).  New alloc_seq calls fail immediately."""
         with self.cb_lock:
+            self.dead = True
             cbs = list(self.callbacks.values())
             self.callbacks.clear()
             return cbs
@@ -220,7 +231,7 @@ class PSClient:
         finally:
             # a dead server connection must FAIL every pending request
             # (cb(None)), not leave its callers blocked in synchronize()
-            for cb in sc.pop_all():
+            for cb in sc.mark_dead():
                 try:
                     cb(None)
                 except Exception:  # noqa: BLE001
@@ -251,18 +262,22 @@ class PSClient:
 
         sc = self._servers[self.server_for(key)]
         done = threading.Event()
-        seq = sc.alloc_seq(lambda msg: done.set())
-        send_message(
-            sc.sock,
-            Message(
-                Op.INIT,
-                key=key,
-                seq=seq,
-                payload=struct.pack("!QI", num_elements, dtype_id),
-            ),
-            sc.send_lock,
-        )
+        box: list = []
+        seq = sc.alloc_seq(lambda msg: (box.append(msg), done.set()))
+        if seq >= 0:
+            send_message(
+                sc.sock,
+                Message(
+                    Op.INIT,
+                    key=key,
+                    seq=seq,
+                    payload=struct.pack("!QI", num_elements, dtype_id),
+                ),
+                sc.send_lock,
+            )
         done.wait()
+        if not box or box[0] is None:
+            raise ConnectionError(f"server connection lost during init of key {key}")
 
     def push(
         self,
@@ -282,6 +297,8 @@ class PSClient:
             lambda msg: cb() if msg is not None
             else (on_error() if on_error is not None else None)
         )
+        if seq < 0:  # connection died; on_error already fired
+            return
         send_message(
             sc.sock,
             Message(
@@ -312,6 +329,8 @@ class PSClient:
             lambda msg: cb(msg.payload) if msg is not None
             else (on_error() if on_error is not None else None)
         )
+        if seq < 0:  # connection died; on_error already fired
+            return
         send_message(
             sc.sock,
             Message(
@@ -332,14 +351,20 @@ class PSClient:
         Python and native C++ servers alike."""
         sc = self._servers[self.server_for(key)]
         done = threading.Event()
-        seq = sc.alloc_seq(lambda msg: done.set())
+        box: list = []
+        seq = sc.alloc_seq(lambda msg: (box.append(msg), done.set()))
         payload = "\n".join(f"{k}={v}" for k, v in sorted(kwargs.items())).encode()
-        send_message(
-            sc.sock,
-            Message(Op.REGISTER_COMPRESSOR, key=key, seq=seq, payload=payload),
-            sc.send_lock,
-        )
+        if seq >= 0:
+            send_message(
+                sc.sock,
+                Message(Op.REGISTER_COMPRESSOR, key=key, seq=seq, payload=payload),
+                sc.send_lock,
+            )
         done.wait()
+        if not box or box[0] is None:
+            raise ConnectionError(
+                f"server connection lost registering compressor for key {key}"
+            )
 
     def set_compression_lr(self, lr: float) -> None:
         """Broadcast the optimizer lr to every server's EF chains (flag
@@ -353,6 +378,8 @@ class PSClient:
         for sc in self._servers:
             try:
                 seq = sc.alloc_seq(lambda msg: None)
+                if seq < 0:
+                    continue  # dead server already handled by the data path
                 send_message(
                     sc.sock,
                     Message(Op.REGISTER_COMPRESSOR, seq=seq, payload=payload, flags=1),

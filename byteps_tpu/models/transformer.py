@@ -299,7 +299,9 @@ def _dense_mlp(cfg: TransformerConfig, x, lp):
 def _moe_block(cfg: TransformerConfig, x, lp, sp: int,
                capacity_factor: float):
     """Shared MoE MLP block (ln2 → routed expert MLP → residual), used by
-    the training layer and the cached decoder so the two cannot drift."""
+    the training layer and the cached decoder so the two cannot drift.
+    Returns (new residual stream, router input g) — g feeds the aux loss
+    so it always matches exactly what was routed."""
     cdt = cfg.compute_dtype
     g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)
     b_, s_, d_ = g.shape
@@ -312,7 +314,7 @@ def _moe_block(cfg: TransformerConfig, x, lp, sp: int,
         axis_size=sp,
         capacity_factor=capacity_factor,
     ).reshape(b_, s_, d_)
-    return x + y.astype(x.dtype)
+    return x + y.astype(x.dtype), g
 
 
 def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
@@ -342,13 +344,12 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
         x = _attn_out(cfg, attn, lp, x)
 
         if cfg.moe:
-            g = _ln(x, lp["ln2_s"], lp["ln2_b"]).astype(cdt)
+            x, g = _moe_block(cfg, x, lp, sp, cfg.capacity_factor)
             b_, s_, d_ = g.shape
             aux = moe_aux_loss(
                 g.reshape(b_ * s_, d_), lp["router"].astype(cdt), sp,
                 lp["ew1"].shape[0],
             )
-            x = _moe_block(cfg, x, lp, sp, cfg.capacity_factor)
         else:
             x = _dense_mlp(cfg, x, lp)
             aux = jnp.zeros((), cdt)
@@ -593,7 +594,7 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
     pp = mesh.shape.get("pp", 1)
     sp = mesh.shape.get("sp", 1)
 
-    def cached_layer(x, lp, kc, vc, offset):
+    def cached_layer(x, lp, kc, vc, offset, cf):
         """x: (B, s, D); kc/vc: (B, H_local, S_max, dh); returns updated
         residual stream and caches with positions [offset, offset+s).
         Projections and MLP are the SAME helpers the training stage uses —
@@ -620,29 +621,29 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
             # copy-symmetric — every rank reassembles the full expert
             # output, so the replicated-token result stays identical on
             # all sp members (n redundant capacity copies, trivial at
-            # decode token counts).  Serving semantics: capacity covers
-            # EVERY token (cf = n_experts ⇒ capacity = t) — training-style
-            # capacity drops would zero a token's MLP output whenever a
-            # decode step's tiny token count concentrated on one expert.
-            return (
-                _moe_block(cfg, x, lp, sp, float(cfg.n_experts)),
-                kc, vc,
-            )
+            # decode token counts).  ``cf`` is the capacity factor:
+            # training semantics for the batched prefill (memory-bounded
+            # like the train step), serving no-drop capacity
+            # (cf = n_experts ⇒ capacity = t) for the per-token steps,
+            # where a tiny token count concentrating on one expert would
+            # otherwise zero a token's MLP output.
+            y, _ = _moe_block(cfg, x, lp, sp, cf)
+            return y, kc, vc
         return _dense_mlp(cfg, x, lp), kc, vc
 
-    def run_layers(stage_params, x, kcs, vcs, offset):
+    def run_layers(stage_params, x, kcs, vcs, offset, cf):
         """scan the layer stack; kcs/vcs leading dim = layers."""
 
         def body(carry, inp):
             xc = carry
             lp, kc, vc = inp
-            xc, kc, vc = cached_layer(xc, lp, kc, vc, offset)
+            xc, kc, vc = cached_layer(xc, lp, kc, vc, offset, cf)
             return xc, (kc, vc)
 
         x, (kcs, vcs) = lax.scan(body, x, (stage_params, kcs, vcs))
         return x, kcs, vcs
 
-    def full_stack(stage_params, x, kcs, vcs, offset):
+    def full_stack(stage_params, x, kcs, vcs, offset, cf):
         """Run the FULL model depth.  With pp == 1 that is just the local
         stack; otherwise unrolled pp turns: at turn s only stage s runs its
         local layers (lax.cond keeps the others idle — the decode-inherent
@@ -650,12 +651,12 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         The last stage's output is psum-broadcast so every stage computes
         the same logits/token (head params are replicated over pp)."""
         if pp == 1:
-            return run_layers(stage_params, x, kcs, vcs, offset)
+            return run_layers(stage_params, x, kcs, vcs, offset, cf)
         pp_idx = lax.axis_index("pp")
 
         def mine(ops):
             xx, kk, vv = ops
-            return run_layers(stage_params, xx, kk, vv, offset)
+            return run_layers(stage_params, xx, kk, vv, offset, cf)
 
         for turn in range(pp):
             x, kcs, vcs = lax.cond(
@@ -707,13 +708,19 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
 
         positions = jnp.arange(s0)
         x = params["embed"][tokens] + params["pos"][positions]
-        x, kcs, vcs = full_stack(stage_params, x.astype(cdt), kcs, vcs, 0)
+        # prefill: training capacity semantics (memory-bounded like train)
+        x, kcs, vcs = full_stack(
+            stage_params, x.astype(cdt), kcs, vcs, 0, cfg.capacity_factor
+        )
         last = pick(logits_of(params, x)[:, -1, :], 0)
 
         def step(carry, i):
             kcs, vcs, tok, pos = carry
             x = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cdt)
-            x, kcs, vcs = full_stack(stage_params, x, kcs, vcs, pos)
+            # per-token steps: serving capacity (no drops at tiny t)
+            x, kcs, vcs = full_stack(
+                stage_params, x, kcs, vcs, pos, float(cfg.n_experts)
+            )
             nxt = pick(logits_of(params, x)[:, -1, :], i + 1)
             return (kcs, vcs, nxt, pos + 1), tok
 

@@ -433,6 +433,60 @@ class TestMultiWorker:
         assert "WORKER_0_OK" in combined and "WORKER_1_OK" in combined
 
 
+class TestServerDeath:
+    def test_sigkill_server_fails_handles_not_hangs(self, monkeypatch, tmp_path):
+        """Failure detection (SURVEY §5.3): SIGKILL the server subprocess
+        mid-job; subsequent push_pulls must surface a RuntimeError on the
+        handle within the test timeout — never hang in synchronize().
+        Exercises the dead-connection callback chain end to end
+        (ps_client._recv_loop → engine._fail_task → handle status)."""
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        env = {
+            **os.environ,
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "1",
+            "DMLC_NUM_SERVER": "1",
+            "DMLC_ROLE": "server",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": "/root/repo",
+        }
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"],
+            env=env,
+            cwd="/root/repo",
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            x = np.ones(64, np.float32)
+            out = bps.push_pull(x, name="chaos.g", average=False)
+            np.testing.assert_allclose(np.asarray(out), x)
+
+            srv.kill()
+            srv.wait(timeout=10)
+
+            deadline = time.time() + 60
+            with pytest.raises(RuntimeError, match="push_pull failed"):
+                while time.time() < deadline:
+                    bps.push_pull(x, name="chaos.g", average=False)
+        finally:
+            bps.shutdown()
+            if srv.poll() is None:
+                srv.kill()
+            sched.stop()
+
+
 class TestServerScheduling:
     """BYTEPS_SERVER_ENABLE_SCHEDULE (queue.h:49-97) must be honored by
     BOTH engines: with scheduling on and multiple engine threads, traffic

@@ -1,0 +1,99 @@
+"""On-chip flash-attention block-size sweep vs the dense reference.
+
+Times fwd+bwd (value_and_grad of a sum-of-squares) for the Pallas flash
+kernel across (block_q, block_k) candidates and sequence lengths, against
+XLA's fused dense attention — the data behind TransformerConfig.use_flash
+defaults.  Refuses to run off-TPU (CPU timings say nothing about Mosaic).
+
+    python tools/flash_tune.py [--seqs 512,1024,2048,4096] [--bh 8,4]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="512,1024,2048,4096")
+    ap.add_argument("--bh", default="8,4",
+                    help="batch,heads used at every seq")
+    ap.add_argument("--dh", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform != "tpu":
+        print("not on TPU — refusing (flash timings need real Mosaic)")
+        return 2
+
+    from byteps_tpu.ops.flash_attention import flash_attention, _dense_reference
+
+    b, h = (int(x) for x in args.bh.split(","))
+    dh = args.dh
+    blocks = [128, 256, 512]
+
+    def time_fn(fn, *xs):
+        f = jax.jit(jax.value_and_grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)))
+        out = f(*xs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = f(*xs)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.steps * 1e3  # ms
+
+    rng = np.random.default_rng(0)
+    for s in (int(x) for x in args.seqs.split(",")):
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(b, h, s, dh)).astype(np.float32) * 0.1,
+                        jnp.bfloat16)
+            for _ in range(3)
+        )
+        try:
+            dense_ms = time_fn(
+                lambda q, k, v: _dense_reference(q, k, v, True, dh ** -0.5), q, k, v
+            )
+        except Exception as e:  # noqa: BLE001 (dense S^2 can OOM at long S)
+            dense_ms = None
+            print(f"seq {s}: dense failed ({type(e).__name__})")
+        best = None
+        for bq in blocks:
+            for bk in blocks:
+                if s % bq or s % bk:
+                    continue
+                try:
+                    ms = time_fn(
+                        lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                            q, k, v, causal=True, block_q=bq, block_k=bk
+                        ),
+                        q, k, v,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    print(f"seq {s} flash bq={bq} bk={bk}: {type(e).__name__}")
+                    continue
+                tag = ""
+                if best is None or ms < best[0]:
+                    best = (ms, bq, bk)
+                    tag = " *"
+                print(f"seq {s} flash bq={bq} bk={bk}: {ms:8.2f} ms{tag}")
+        if dense_ms is not None:
+            print(f"seq {s} dense:               {dense_ms:8.2f} ms")
+        if best is not None and dense_ms is not None:
+            verdict = "flash WINS" if best[0] < dense_ms else "dense wins"
+            print(
+                f"seq {s}: best flash {best[0]:.2f} ms (bq={best[1]}, "
+                f"bk={best[2]}) vs dense {dense_ms:.2f} ms → {verdict}"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
