@@ -84,6 +84,7 @@ class PSClient:
         self._sched_cbs: Dict[int, threading.Event] = {}
         self._sched_cb_lock = threading.Lock()
         self._sched_seq = 0
+        self._sched_dead = False  # set when the scheduler recv loop exits
         self._servers: List[_ServerConn] = []
         self._stop = threading.Event()
         self.is_recovery = False
@@ -155,8 +156,11 @@ class PSClient:
 
     def _sched_request(self, msg: Message) -> Message:
         """Send a scheduler request and wait for its seq-matched response.
-        Raises ConnectionError if the scheduler link dies while waiting."""
+        Raises ConnectionError if the scheduler link is dead or dies while
+        waiting."""
         with self._sched_cb_lock:
+            if self._sched_dead:
+                raise ConnectionError("scheduler connection lost")
             seq = self._sched_seq
             self._sched_seq += 1
             ev = threading.Event()
@@ -211,12 +215,30 @@ class PSClient:
                     ev.set()
         finally:
             # wake every pending waiter with an empty box → they raise
-            # ConnectionError instead of hanging on a dead scheduler
+            # ConnectionError instead of hanging on a dead scheduler; flag
+            # the link dead so LATER _sched_request calls fail fast instead
+            # of registering callbacks nobody will ever drain
             with self._sched_cb_lock:
+                self._sched_dead = True
                 pending = list(self._sched_cbs.values())
                 self._sched_cbs.clear()
             for ev, _ in pending:
                 ev.set()
+
+    @staticmethod
+    def _blocking_request(sc: _ServerConn, make_msg, errmsg: str) -> Message:
+        """Send one server request and block for its ack; raises
+        ConnectionError if the connection is dead or dies while waiting
+        (the alloc_seq dead-path fires the callback with None)."""
+        done = threading.Event()
+        box: list = []
+        seq = sc.alloc_seq(lambda msg: (box.append(msg), done.set()))
+        if seq >= 0:
+            send_message(sc.sock, make_msg(seq), sc.send_lock)
+        done.wait()
+        if not box or box[0] is None:
+            raise ConnectionError(errmsg)
+        return box[0]
 
     def _recv_loop(self, sc: _ServerConn) -> None:
         try:
@@ -261,23 +283,16 @@ class PSClient:
         import struct
 
         sc = self._servers[self.server_for(key)]
-        done = threading.Event()
-        box: list = []
-        seq = sc.alloc_seq(lambda msg: (box.append(msg), done.set()))
-        if seq >= 0:
-            send_message(
-                sc.sock,
-                Message(
-                    Op.INIT,
-                    key=key,
-                    seq=seq,
-                    payload=struct.pack("!QI", num_elements, dtype_id),
-                ),
-                sc.send_lock,
-            )
-        done.wait()
-        if not box or box[0] is None:
-            raise ConnectionError(f"server connection lost during init of key {key}")
+        self._blocking_request(
+            sc,
+            lambda seq: Message(
+                Op.INIT,
+                key=key,
+                seq=seq,
+                payload=struct.pack("!QI", num_elements, dtype_id),
+            ),
+            f"server connection lost during init of key {key}",
+        )
 
     def push(
         self,
@@ -350,21 +365,14 @@ class PSClient:
         Payload is newline-separated ``key=value`` text — parseable by the
         Python and native C++ servers alike."""
         sc = self._servers[self.server_for(key)]
-        done = threading.Event()
-        box: list = []
-        seq = sc.alloc_seq(lambda msg: (box.append(msg), done.set()))
         payload = "\n".join(f"{k}={v}" for k, v in sorted(kwargs.items())).encode()
-        if seq >= 0:
-            send_message(
-                sc.sock,
-                Message(Op.REGISTER_COMPRESSOR, key=key, seq=seq, payload=payload),
-                sc.send_lock,
-            )
-        done.wait()
-        if not box or box[0] is None:
-            raise ConnectionError(
-                f"server connection lost registering compressor for key {key}"
-            )
+        self._blocking_request(
+            sc,
+            lambda seq: Message(
+                Op.REGISTER_COMPRESSOR, key=key, seq=seq, payload=payload
+            ),
+            f"server connection lost registering compressor for key {key}",
+        )
 
     def set_compression_lr(self, lr: float) -> None:
         """Broadcast the optimizer lr to every server's EF chains (flag

@@ -708,7 +708,10 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
 
         positions = jnp.arange(s0)
         x = params["embed"][tokens] + params["pos"][positions]
-        # prefill: training capacity semantics (memory-bounded like train)
+        # prefill: training capacity semantics — memory-bounded like the
+        # train step, and like it the overflow-drop set is computed per
+        # dp shard (GShard-style), so MoE prefill output can depend on
+        # the mesh when an expert overflows
         x, kcs, vcs = full_stack(
             stage_params, x.astype(cdt), kcs, vcs, 0, cfg.capacity_factor
         )

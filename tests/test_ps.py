@@ -434,12 +434,23 @@ class TestMultiWorker:
 
 
 class TestServerDeath:
-    def test_sigkill_server_fails_handles_not_hangs(self, monkeypatch, tmp_path):
+    @pytest.mark.parametrize("server_kind", ["python", "native"])
+    def test_sigkill_server_fails_handles_not_hangs(
+        self, monkeypatch, tmp_path, server_kind
+    ):
         """Failure detection (SURVEY §5.3): SIGKILL the server subprocess
         mid-job; subsequent push_pulls must surface a RuntimeError on the
         handle within the test timeout — never hang in synchronize().
         Exercises the dead-connection callback chain end to end
-        (ps_client._recv_loop → engine._fail_task → handle status)."""
+        (ps_client._recv_loop → engine._fail_task → handle status), for
+        both server engines (the worker-side plumbing is engine-agnostic,
+        but the kill timing differs)."""
+        if server_kind == "native":
+            from byteps_tpu.native import HAVE_NATIVE
+
+            if not HAVE_NATIVE:
+                pytest.skip("native lib not built")
+            monkeypatch.setenv("BYTEPS_SERVER_NATIVE", "1")
         sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
         sched.start()
         env = {
@@ -485,6 +496,85 @@ class TestServerDeath:
             if srv.poll() is None:
                 srv.kill()
             sched.stop()
+
+
+class TestSchedulerDeath:
+    def test_data_plane_survives_control_plane_errors(self, monkeypatch):
+        """SIGKILL the scheduler subprocess mid-job: the data plane rides
+        direct worker↔server connections and must keep aggregating, while
+        control-plane calls (query_cluster) must raise ConnectionError —
+        including calls made AFTER the link died, which previously
+        registered waiters nobody would ever wake."""
+        port_probe = __import__("socket").socket()
+        port_probe.bind(("127.0.0.1", 0))
+        port = port_probe.getsockname()[1]
+        port_probe.close()
+        env = {
+            **os.environ,
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": "1",
+            "DMLC_NUM_SERVER": "1",
+            "DMLC_ROLE": "scheduler",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": "/root/repo",
+        }
+        sched_proc = subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"],
+            env=env,
+            cwd="/root/repo",
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        import socket as _socket
+
+        deadline = time.time() + 30
+        while time.time() < deadline:  # wait for the subprocess to bind
+            try:
+                _socket.create_connection(("127.0.0.1", port), timeout=1).close()
+                break
+            except OSError:
+                time.sleep(0.2)
+        else:
+            raise RuntimeError("scheduler subprocess never bound its port")
+
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        scfg = Config.from_env()
+        srv = PSServer(scfg)
+        threading.Thread(target=srv.start, daemon=True).start()
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            x = np.ones(32, np.float32)
+            out = bps.push_pull(x, name="sched.chaos", average=False)
+            np.testing.assert_allclose(np.asarray(out), x)
+
+            sched_proc.kill()
+            sched_proc.wait(timeout=10)
+            time.sleep(0.5)  # let the recv loop observe the FIN/RST
+
+            # data plane: still aggregating over the live server link
+            out2 = bps.push_pull(x, name="sched.chaos", average=False)
+            np.testing.assert_allclose(np.asarray(out2), x)
+
+            # control plane: fail fast, even well after the death
+            from byteps_tpu.core.state import require_state
+
+            client = require_state().ps_client
+            for _ in range(3):
+                with pytest.raises(ConnectionError):
+                    client.query_cluster()
+        finally:
+            bps.shutdown()
+            if sched_proc.poll() is None:
+                sched_proc.kill()
+            srv.stop()
 
 
 class TestServerScheduling:
