@@ -158,6 +158,25 @@ def main() -> None:
         # every config OOM'd or failed to compile: still emit the JSON
         # contract line (the driver records stdout, not tracebacks)
         extra = {"error": "no benchmark config completed", "configs_tried": tried}
+        # A tunnel outage and a code regression must not look alike: if the
+        # same non-tunnel-shaped exception type killed every config, this is
+        # a persistent failure — flag it and exit nonzero so the driver (and
+        # a human reading BENCH_r*.json) can tell them apart.
+        errs = [v for v in tried.values() if isinstance(v, str) and v != "OOM"]
+        # anchored tokens only: gRPC status codes are SHOUTY and distinctive;
+        # a bare "500"/"internal" substring would also match e.g. a shape
+        # (1500, 128) in a genuine regression's message
+        transient_markers = (
+            "UNAVAILABLE", "DEADLINE_EXCEEDED", "INTERNAL:", "HTTP 500",
+            "tunnel", "Connection reset", "Socket closed",
+            "Unable to initialize backend",
+        )
+        persistent = (
+            len(errs) == len(tried)
+            and len({e.split(":", 1)[0] for e in errs}) == 1
+            and not any(m in e for e in errs for m in transient_markers)
+        )
+        extra["failure_class"] = "persistent" if persistent else "transient"
         try:
             with open(_LAST_GOOD_PATH) as f:
                 extra["last_good"] = json.load(f)
@@ -174,7 +193,7 @@ def main() -> None:
                 }
             )
         )
-        raise SystemExit(0)
+        raise SystemExit(1 if persistent else 0)
     samples_per_sec, loss, batch, remat, mcfg = best
 
     # model FLOPs per sample (fwd+bwd = 3x fwd): matmul params + attention

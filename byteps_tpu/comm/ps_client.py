@@ -234,7 +234,13 @@ class PSClient:
         box: list = []
         seq = sc.alloc_seq(lambda msg: (box.append(msg), done.set()))
         if seq >= 0:
-            send_message(sc.sock, make_msg(seq), sc.send_lock)
+            try:
+                send_message(sc.sock, make_msg(seq), sc.send_lock)
+            except OSError:
+                # connection died between alloc_seq and send: callers see
+                # the same ConnectionError as the dead-connection path
+                sc.pop_cb(seq)
+                raise ConnectionError(errmsg) from None
         done.wait()
         if not box or box[0] is None:
             raise ConnectionError(errmsg)

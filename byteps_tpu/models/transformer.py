@@ -54,6 +54,13 @@ class TransformerConfig:
     moe: bool = False
     n_experts: int = 8
     capacity_factor: float = 2.0
+    # capacity factor for GENERATION prefill.  None (default) = no-drop
+    # serving capacity (cf = n_experts, capacity = token count): prompt
+    # tokens are never silently dropped from the MLP and generation output
+    # is mesh-independent.  Set a finite value (e.g. the training
+    # capacity_factor) to bound prefill memory for very long prompts, at
+    # the documented cost of GShard-style per-dp-shard overflow drops.
+    prefill_capacity_factor: float | None = None
     moe_aux_coef: float = 0.01
     compute_dtype: Any = jnp.float32
     microbatches: int = 0  # 0 → pipeline stages count
@@ -622,11 +629,10 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
             # output, so the replicated-token result stays identical on
             # all sp members (n redundant capacity copies, trivial at
             # decode token counts).  ``cf`` is the capacity factor:
-            # training semantics for the batched prefill (memory-bounded
-            # like the train step), serving no-drop capacity
-            # (cf = n_experts ⇒ capacity = t) for the per-token steps,
-            # where a tiny token count concentrating on one expert would
-            # otherwise zero a token's MLP output.
+            # no-drop serving capacity (cf = n_experts ⇒ capacity = t)
+            # for the per-token steps AND, by default, for prefill
+            # (cfg.prefill_capacity_factor opts back into memory-bounded
+            # training semantics for very long prompts).
             y, _ = _moe_block(cfg, x, lp, sp, cf)
             return y, kc, vc
         return _dense_mlp(cfg, x, lp), kc, vc
@@ -708,12 +714,17 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
 
         positions = jnp.arange(s0)
         x = params["embed"][tokens] + params["pos"][positions]
-        # prefill: training capacity semantics — memory-bounded like the
-        # train step, and like it the overflow-drop set is computed per
-        # dp shard (GShard-style), so MoE prefill output can depend on
-        # the mesh when an expert overflows
+        # prefill: no-drop serving capacity by default (cf = n_experts ⇒
+        # capacity = token count — no prompt token ever loses its MLP
+        # contribution, and output is mesh-independent); opt into
+        # memory-bounded training semantics via prefill_capacity_factor
+        prefill_cf = (
+            float(cfg.n_experts)
+            if cfg.prefill_capacity_factor is None
+            else cfg.prefill_capacity_factor
+        )
         x, kcs, vcs = full_stack(
-            stage_params, x.astype(cdt), kcs, vcs, 0, cfg.capacity_factor
+            stage_params, x.astype(cdt), kcs, vcs, 0, prefill_cf
         )
         last = pick(logits_of(params, x)[:, -1, :], 0)
 

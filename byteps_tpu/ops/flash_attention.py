@@ -116,9 +116,14 @@ def _fwd_kernel_factory(dh, bq, bk, nk, causal, scale):
 
 # vma typing (varying-manual-axes) exists from jax 0.7+; on older versions
 # ShapeDtypeStruct has no vma kwarg, so callers must omit it entirely.
-_HAS_VMA = "vma" in getattr(
-    getattr(jax.ShapeDtypeStruct.__init__, "__code__", None), "co_varnames", ()
-)
+# Probe by construction, not introspection: a wrapped/C-accelerated
+# __init__ would hide the kwarg from co_varnames and silently break
+# shard_map(check_vma=True).
+try:
+    jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+    _HAS_VMA = True
+except TypeError:
+    _HAS_VMA = False
 
 
 def _vma_union(*xs):
