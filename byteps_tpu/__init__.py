@@ -36,6 +36,8 @@ from byteps_tpu.api import (
     declare_tensor,
     push_pull,
     push_pull_async,
+    push_pull_rowsparse,
+    push_pull_rowsparse_async,
     poll,
     synchronize,
     broadcast_parameters,
@@ -83,6 +85,8 @@ __all__ = [
     "declare_tensor",
     "push_pull",
     "push_pull_async",
+    "push_pull_rowsparse",
+    "push_pull_rowsparse_async",
     "poll",
     "synchronize",
     "broadcast_parameters",

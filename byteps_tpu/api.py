@@ -162,6 +162,70 @@ def push_pull(
     return synchronize(push_pull_async(tensor, name, average=average, priority=priority))
 
 
+def push_pull_rowsparse_async(
+    indices: Any,
+    values: Any,
+    name: str,
+    total_rows: int,
+    average: bool = True,
+    priority: int = 0,
+) -> int:
+    """Start a row-sparse push_pull (RequestType::kRowSparsePushPull,
+    common.h:267-271): push ``values`` rows at ``indices`` of a
+    ``(total_rows, row_len)`` tensor; the server scatter-sums all workers'
+    rows into the dense store, and the result (same ``indices``, gathered
+    after the round completes) is retrieved by :func:`synchronize` as a
+    ``(len(indices), row_len)`` array — the embedding-gradient path."""
+    st = require_state()
+    cfg = st.config
+    get_registry().declare(name)
+    handle = st.handles.allocate()
+    if not cfg.is_distributed:
+        # same semantics as the 1-worker PS path — scatter-add then gather,
+        # so duplicate indices accumulate and bad indices raise identically
+        idx = np.asarray(indices, dtype=np.int64)
+        vals = np.ascontiguousarray(np.asarray(values, dtype=np.float32))
+        if idx.ndim != 1 or vals.ndim != 2 or vals.shape[0] != idx.shape[0]:
+            raise ValueError(
+                f"rowsparse wants indices (n,), values (n, row_len); got "
+                f"{idx.shape} / {vals.shape}"
+            )
+        if idx.size and (idx.min() < 0 or idx.max() >= total_rows):
+            raise ValueError(f"rowsparse indices out of range [0, {total_rows})")
+        dense = np.zeros((total_rows, vals.shape[1]), dtype=vals.dtype)
+        np.add.at(dense, idx, vals)
+        st.handles.mark_done(handle, dense[idx])
+        return handle
+    st.engine.submit_rowsparse(
+        name=name,
+        indices=indices,
+        values=values,
+        total_rows=total_rows,
+        average=average,
+        priority=priority,
+        version=0,
+        handle=handle,
+    )
+    return handle
+
+
+def push_pull_rowsparse(
+    indices: Any,
+    values: Any,
+    name: str,
+    total_rows: int,
+    average: bool = True,
+    priority: int = 0,
+) -> Any:
+    """Synchronous row-sparse push_pull; see
+    :func:`push_pull_rowsparse_async`."""
+    return synchronize(
+        push_pull_rowsparse_async(
+            indices, values, name, total_rows, average=average, priority=priority
+        )
+    )
+
+
 def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     """Sync a pytree of parameters from ``root_rank`` to all workers.
 

@@ -86,7 +86,14 @@ class PSClient:
         self._sched_seq = 0
         self._sched_dead = False  # set when the scheduler recv loop exits
         self._servers: List[_ServerConn] = []
+        self._server_addrs: List[tuple] = []
+        #: bumped whenever the server list is rebuilt (elastic server
+        #: resize): the engine re-runs each key's init-push barrier — and
+        #: re-ships compressor configs — against the new owners before the
+        #: key's next use
+        self.server_generation = 0
         self._stop = threading.Event()
+        self._rebuild_lock = threading.Lock()  # serializes live server swaps
         self.is_recovery = False
 
     # --- rendezvous ------------------------------------------------------
@@ -123,7 +130,8 @@ class PSClient:
         self.num_workers = book["num_workers"]
         self.num_servers = book["num_servers"]
         self.is_recovery = book.get("is_recovery", False)
-        for host, port in book["servers"]:
+        self._server_addrs = [tuple(s) for s in book["servers"]]
+        for host, port in self._server_addrs:
             sc = _ServerConn(host, port)
             sc.recv_thread = threading.Thread(
                 target=self._recv_loop, args=(sc,), daemon=True
@@ -201,11 +209,23 @@ class PSClient:
                     return
                 if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
                     # another worker resized the cluster: adopt the worker
-                    # count (averaging reads it live).  num_servers never
-                    # changes in a resize — the scheduler refuses those, as
-                    # self._servers' connections couldn't follow.
+                    # count (averaging reads it live) and, on a SERVER
+                    # resize, rebuild the connection set — key→server
+                    # routing follows num_servers automatically and the
+                    # engine re-inits keys on their new owners
+                    # (server_generation bump)
                     book = json.loads(msg.payload.decode())
                     self.num_workers = book["num_workers"]
+                    new_addrs = [tuple(s) for s in book["servers"]]
+                    if new_addrs != self._server_addrs:
+                        # rebuild OFF this thread: connects can block/fail
+                        # and must neither stall scheduler callback
+                        # delivery nor kill this loop (→ _sched_dead)
+                        threading.Thread(
+                            target=self._rebuild_servers,
+                            args=(book["num_servers"], new_addrs),
+                            daemon=True,
+                        ).start()
                     continue
                 with self._sched_cb_lock:
                     entry = self._sched_cbs.pop(msg.seq, None)
@@ -224,6 +244,51 @@ class PSClient:
                 self._sched_cbs.clear()
             for ev, _ in pending:
                 ev.set()
+
+    def _rebuild_servers(self, num_servers: int, new_addrs: List[tuple]) -> None:
+        """Adopt a resized server book live: connect to the new set, swap,
+        then fail the old connections' in-flight requests (same path as a
+        server death — the handle errors instead of hanging).  Requests
+        racing the swap may still land on an old connection and fail; the
+        caller's next round routes and re-inits against the new owners.
+
+        Runs on its own thread (a connect may block or fail during elastic
+        churn); rebuilds are serialized, and a superseded book (another
+        RESIZE_SEQ arrived meanwhile) is skipped."""
+        with self._rebuild_lock:
+            if new_addrs == self._server_addrs or self._stop.is_set():
+                return  # already applied or shutting down
+            fresh: List[_ServerConn] = []
+            for attempt in range(3):
+                try:
+                    for host, port in new_addrs[len(fresh):]:
+                        sc = _ServerConn(host, port)
+                        sc.recv_thread = threading.Thread(
+                            target=self._recv_loop, args=(sc,), daemon=True
+                        )
+                        sc.recv_thread.start()
+                        fresh.append(sc)
+                    break
+                except OSError as e:
+                    if attempt == 2:
+                        # persistent: keep the current (stale) server set —
+                        # the control plane stays alive, and in-flight
+                        # failures surface per-request, not as a dead loop
+                        from byteps_tpu.common import logging as bpslog
+
+                        bpslog.warning(
+                            "server-resize rebuild failed after retries: %r", e
+                        )
+                        for sc in fresh:
+                            close_socket(sc.sock)
+                        return
+                    self._stop.wait(0.3 * (attempt + 1))
+            old, self._servers = self._servers, fresh
+            self._server_addrs = list(new_addrs)
+            self.num_servers = num_servers
+            self.server_generation += 1
+        for sc in old:
+            close_socket(sc.sock)  # recv loop exits → mark_dead fails pendings
 
     @staticmethod
     def _blocking_request(sc: _ServerConn, make_msg, errmsg: str) -> Message:
@@ -341,10 +406,12 @@ class PSClient:
         dtype_id: int = 0,
         request_type: RequestType = RequestType.DEFAULT_PUSH_PULL,
         on_error: Optional[Callable[[], None]] = None,
+        payload: bytes = b"",
     ) -> None:
         """Async pull; ``cb`` receives the aggregated payload (ZPull,
         core_loops.cc:584-618); ``on_error`` fires if the server connection
-        dies before the response."""
+        dies before the response.  ``payload`` carries the request body for
+        row-sparse pulls (the row indices to gather)."""
         sc = self._servers[self.server_for(key)]
         seq = sc.alloc_seq(
             lambda msg: cb(msg.payload) if msg is not None
@@ -358,6 +425,7 @@ class PSClient:
                 Op.PULL,
                 key=key,
                 seq=seq,
+                payload=payload,
                 cmd=get_command_type(request_type, dtype_id),
                 version=version,
             ),

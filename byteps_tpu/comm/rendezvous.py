@@ -86,6 +86,12 @@ class Scheduler:
         # connections of recovering nodes: their first barrier releases
         # immediately (the rest of the cluster is not at a barrier)
         self._recovered_conns: set = set()
+        # registrations parked until the (resized) population is complete:
+        # a worker resuming with num_servers+k can only receive its address
+        # book once the new server has actually registered
+        self._parked_regs: List[Tuple[Any, Any, str, int, int]] = []
+        #: a resize-initiating worker was parked; broadcast when it flushes
+        self._pending_broadcast = False
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, name="sched-accept", daemon=True)
@@ -186,40 +192,37 @@ class Scheduler:
             # live nodes keep their ranks (stable keys depend on it).
             nw = info.get("num_workers")
             ns = info.get("num_servers")
-            if (
-                self._addrbook_sent
-                and role == "worker"
-                and ns
-                and int(ns) != self.num_servers
+            if self._addrbook_sent and role == "worker" and (
+                (nw and int(nw) != self.num_workers)
+                or (ns and int(ns) != self.num_servers)
             ):
-                # server elasticity is NOT live-resizable: every live
-                # worker's key→server routing and open connections assume
-                # the server list; refuse rather than desync the cluster
-                err = {
-                    "error": f"num_servers change ({self.num_servers}→{ns}) "
-                    "requires a cluster restart"
-                }
-                try:
-                    send_message(
-                        conn,
-                        Message(Op.ADDRBOOK, status=1, seq=msg.seq,
-                                payload=json.dumps(err).encode()),
-                        send_lock,
-                    )
-                except (ConnectionError, OSError):
-                    pass
-                return
-            if (
-                self._addrbook_sent
-                and role == "worker"
-                and nw
-                and int(nw) != self.num_workers
-            ):
-                self.num_workers = int(nw)
                 for r in ("worker", "server"):
                     self._nodes[r] = [
                         n for n in self._nodes[r] if n.conn in self._conn_ids
                     ]
+                if nw and int(nw) != self.num_workers:
+                    self.num_workers = int(nw)
+                if ns and int(ns) != self.num_servers:
+                    # Server elasticity (resume(num_servers=±k), the
+                    # reference rewrites DMLC_NUM_SERVER,
+                    # common/__init__.py:75-82).  Scale-DOWN: keep the
+                    # lowest-ranked servers, tell the dropped ones to shut
+                    # down.  Scale-UP: adopt the expectation; address books
+                    # are parked until the new server actually registers.
+                    self.num_servers = int(ns)
+                    keep, dropped = [], []
+                    for n in sorted(self._nodes["server"], key=lambda n: n.rank):
+                        (keep if n.rank < self.num_servers else dropped).append(n)
+                    self._nodes["server"] = keep
+                    for n in dropped:
+                        self._conn_ids.pop(n.conn, None)
+                        try:
+                            send_message(
+                                n.conn, Message(Op.SHUTDOWN, seq=RESIZE_SEQ),
+                                n.send_lock,
+                            )
+                        except (ConnectionError, OSError):
+                            pass
                 resized = True
             nodes = self._nodes[role]
             existing = [n for n in nodes if n.uid == uid]
@@ -290,23 +293,42 @@ class Scheduler:
                 and len(self._nodes["server"]) >= self.num_servers
             )
             if recovery:
-                self._send_addrbook_to(conn, send_lock, role, rank, msg.seq, recovery=True)
-                if resized:
-                    # every OTHER live node adopts the new topology from an
-                    # unsolicited RESIZE_SEQ book on its control connection
-                    for r in ("worker", "server"):
-                        for node in self._nodes[r]:
-                            if node.conn is not conn:
-                                self._send_addrbook_to(
-                                    node.conn, node.send_lock, r, node.rank,
-                                    RESIZE_SEQ,
-                                )
+                self._complete_recovery(conn, send_lock, role, rank, msg.seq, resized)
                 return
             if full and not self._addrbook_sent:
                 self._addrbook_sent = True
                 for r in ("worker", "server"):
                     for node in self._nodes[r]:
                         self._send_addrbook_to(node.conn, node.send_lock, r, node.rank, 0)
+
+    def _complete_recovery(self, conn, send_lock, role, rank, seq, resized) -> None:
+        """Reply to a mid-training (re)registration — parking worker
+        replies while a server scale-up leaves the population short, and
+        broadcasting RESIZE_SEQ books to the rest of the cluster once the
+        topology settles.  Caller holds ``self._lock``."""
+        servers_ready = len(self._nodes["server"]) >= self.num_servers
+        if role == "worker" and not servers_ready:
+            # the book this worker needs doesn't exist yet (it would list
+            # fewer servers than the topology it just declared); its
+            # connect() blocks until the new server registers
+            self._parked_regs.append((conn, send_lock, role, rank, seq))
+            self._pending_broadcast = self._pending_broadcast or resized
+            return
+        self._send_addrbook_to(conn, send_lock, role, rank, seq, recovery=True)
+        parked, self._parked_regs = self._parked_regs, []
+        for pconn, plock, prole, prank, pseq in parked:
+            self._send_addrbook_to(pconn, plock, prole, prank, pseq, recovery=True)
+        if resized or parked or self._pending_broadcast:
+            self._pending_broadcast = False
+            # every OTHER live node adopts the new topology from an
+            # unsolicited RESIZE_SEQ book on its control connection
+            exclude = {conn} | {p[0] for p in parked}
+            for r in ("worker", "server"):
+                for node in self._nodes[r]:
+                    if node.conn not in exclude:
+                        self._send_addrbook_to(
+                            node.conn, node.send_lock, r, node.rank, RESIZE_SEQ
+                        )
 
     def _send_addrbook_to(self, conn, send_lock, role, rank, seq, recovery=False) -> None:
         servers = sorted(self._nodes["server"], key=lambda n: n.rank)

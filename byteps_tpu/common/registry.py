@@ -38,6 +38,10 @@ class TensorContext:
     kwargs: Dict[str, str] = dataclasses.field(default_factory=dict)
     # profiling attachment points (SURVEY §5.1)
     version: int = 0
+    # PS-client server-list generation this ctx last ran its init-push
+    # barrier against; a mismatch (elastic server resize) re-inits the
+    # key on its new owning server before the next use
+    server_generation: int = 0
 
     @property
     def base_key(self) -> int:

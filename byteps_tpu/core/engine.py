@@ -48,10 +48,11 @@ class _Job:
     __slots__ = (
         "name", "ctx", "flat", "result", "dtype_id", "average", "handle",
         "pending", "lock", "shape", "np_dtype", "is_jax", "version", "t0",
+        "rowsparse",
     )
 
     def __init__(self, name, ctx, flat, result, dtype_id, average, handle,
-                 pending, shape, np_dtype, is_jax, version):
+                 pending, shape, np_dtype, is_jax, version, rowsparse=None):
         self.name = name
         self.ctx = ctx
         self.flat = flat
@@ -66,6 +67,9 @@ class _Job:
         self.is_jax = is_jax
         self.version = version
         self.t0 = time.time()
+        # row-sparse jobs: {"push_payload": bytes, "pull_req": bytes}
+        # (kRowSparsePushPull, common.h:267-271)
+        self.rowsparse = rowsparse
 
 
 class _StripedStage:
@@ -222,27 +226,13 @@ class PipelineEngine:
             np_dtype = flat.dtype
         dtype_id = int(to_datatype(np_dtype))
 
-        with self._init_lock:
-            if not ctx.initialized:
-                partition_tensor(
-                    ctx, flat.size, np_dtype.itemsize, self.cfg.partition_bytes
-                )
-                for part in ctx.partitions:
-                    # blocking init-push doubles as the cross-worker barrier
-                    # for the key (operations.cc:283-414)
-                    self.client.init_tensor(part.key, part.length, dtype_id)
-                self._maybe_setup_compression(ctx, np_dtype, flat.size * np_dtype.itemsize)
-                ctx.initialized = True
-            ctx.version += 1
-            # Seed the round-order gate per ENGINE, not per ctx-init: the
-            # registry (and its version counters) outlive shutdown()/init()
-            # cycles, while each engine starts with a fresh ReadyTable — a
-            # reused tensor name must start from its CURRENT version, not 1,
-            # or its tasks would never become eligible.
-            for part in ctx.partitions:
-                if part.key not in self._seeded:
-                    self._seeded.add(part.key)
-                    self._push_ready.set_ready_count(part.key, ctx.version)
+        def build_partitions(c):
+            partition_tensor(c, flat.size, np_dtype.itemsize, self.cfg.partition_bytes)
+
+        def on_first_init():
+            self._maybe_setup_compression(ctx, np_dtype, flat.size * np_dtype.itemsize)
+
+        self._prepare_round(ctx, dtype_id, build_partitions, on_first_init)
         result = np.empty(flat.shape, dtype=np_dtype)
         job = _Job(
             name, ctx, flat, result, dtype_id, average, handle,
@@ -264,6 +254,117 @@ class PipelineEngine:
                 context=job,
             )
             self.queues[QueueType.COPYD2H].add_task(task)
+
+    def _prepare_round(self, ctx, dtype_id, build_partitions, on_first_init=None):
+        """Shared per-submit bookkeeping for dense AND row-sparse paths:
+        run (or, after an elastic server resize, RE-run) the init-push
+        barrier, then advance the version and seed the PUSH round gate.
+
+        - First init: build partitions, init every key (the blocking
+          init-push doubles as the cross-worker barrier, operations.cc:
+          283-414), then ``on_first_init`` (compressor setup).
+        - server_generation mismatch (elastic resize): keys re-homed via
+          the hash fns, so the init barrier re-runs against the new owners
+          (their stores start fresh), compressor configs re-ship, and the
+          version sequence restarts (the barrier reset server-side round
+          counters) with the round gate re-seeded to match.
+        - Gate seeding is per ENGINE, not per ctx-init: the registry (and
+          its version counters) outlive shutdown()/init() cycles, while
+          each engine starts with a fresh ReadyTable — a reused tensor name
+          must start from its CURRENT version, not 1, or its tasks would
+          never become eligible."""
+        with self._init_lock:
+            gen = getattr(self.client, "server_generation", 0)
+            if not ctx.initialized or ctx.server_generation != gen:
+                if not ctx.partitions:
+                    build_partitions(ctx)
+                for part in ctx.partitions:
+                    self.client.init_tensor(part.key, part.length, dtype_id)
+                if ctx.initialized:
+                    self._reship_compressors(ctx)
+                    ctx.version = 0
+                    for part in ctx.partitions:
+                        self._seeded.discard(part.key)
+                elif on_first_init is not None:
+                    on_first_init()
+                ctx.initialized = True
+                ctx.server_generation = gen
+            ctx.version += 1
+            for part in ctx.partitions:
+                if part.key not in self._seeded:
+                    self._seeded.add(part.key)
+                    self._push_ready.set_ready_count(part.key, ctx.version)
+
+    def submit_rowsparse(
+        self,
+        name: str,
+        indices: Any,
+        values: Any,
+        total_rows: int,
+        average: bool,
+        priority: int,
+        version: int,
+        handle: int,
+    ) -> None:
+        """Row-sparse push_pull (RequestType::kRowSparsePushPull,
+        common.h:267-271): push (indices, values) rows of a
+        ``(total_rows, row_len)`` tensor; the server scatter-sums into the
+        dense store and the pull gathers the SAME indices back — the
+        embedding-gradient path.  One key, no partitioning (the reference
+        likewise exempts sparse tensors from byte partitioning)."""
+        import struct
+
+        idx = np.ascontiguousarray(np.asarray(indices, dtype=np.int64))
+        vals = np.ascontiguousarray(np.asarray(values, dtype=np.float32))
+        if idx.ndim != 1 or vals.ndim != 2 or vals.shape[0] != idx.shape[0]:
+            raise ValueError(
+                f"rowsparse wants indices (n,), values (n, row_len); got "
+                f"{idx.shape} / {vals.shape}"
+            )
+        if idx.size and (idx.min() < 0 or idx.max() >= total_rows):
+            raise ValueError(f"rowsparse indices out of range [0, {total_rows})")
+        nrows, row_len = vals.shape
+        dtype_id = int(to_datatype(vals.dtype))
+
+        registry = get_registry()
+        ctx = registry.declare(name)
+
+        def build_partitions(c):
+            from byteps_tpu.common.types import Partition
+
+            c.partitions = [
+                Partition(
+                    key=c.key_for_part(0), offset=0, length=total_rows * row_len
+                )
+            ]
+
+        self._prepare_round(ctx, dtype_id, build_partitions)
+        key = ctx.partitions[0].key
+
+        header = struct.pack("!II", nrows, row_len)
+        idx_wire = idx.astype(">u4").tobytes()
+        rowsparse = {
+            "push_payload": header + idx_wire + vals.tobytes(),
+            "pull_req": header + idx_wire,
+        }
+        result = np.empty(nrows * row_len, dtype=vals.dtype)
+        job = _Job(
+            name, ctx, None, result, dtype_id, average, handle,
+            pending=1, shape=(nrows, row_len), np_dtype=vals.dtype,
+            is_jax=False, version=ctx.version, rowsparse=rowsparse,
+        )
+        task = TensorTableEntry(
+            tensor_name=name,
+            key=key,
+            priority=priority,
+            version=ctx.version,
+            offset=0,
+            length=total_rows * row_len,
+            total_partnum=1,
+            queue_list=[QueueType.PUSH, QueueType.PULL],
+            context=job,
+        )
+        self.queues[QueueType.PUSH].add_task(task)
 
     def _maybe_setup_compression(self, ctx, np_dtype: np.dtype, nbytes: int) -> None:
         """Instantiate per-partition codec chains and ship the config to the
@@ -290,6 +391,20 @@ class PipelineEngine:
             self._apply_lr_to_chain(codec, self._compression_lr)
             self.client.register_compressor(part.key, ctx.kwargs)
         self._maybe_send_lr()
+
+    def _reship_compressors(self, ctx) -> None:
+        """After a server resize, re-register each partition's compressor
+        config with the key's (possibly new) owning server; local chains —
+        and their EF/momentum state — are kept."""
+        shipped = False
+        for part in ctx.partitions:
+            if part.key in self._compressors:
+                self.client.register_compressor(part.key, ctx.kwargs)
+                shipped = True
+        if shipped:
+            # new server-side chains start at lr=1; resend the current lr
+            self._lr_sent_to_servers = 1.0
+            self._maybe_send_lr()
 
     @staticmethod
     def _apply_lr_to_chain(codec, lr: float) -> None:
@@ -439,7 +554,10 @@ class PipelineEngine:
     def _push_once(self, task: TensorTableEntry) -> None:
         """Priority-ordered ZPush (RunPushLoopOnce, core_loops.cc:538-582)."""
         job: _Job = task.context
-        if task.compressed is not None:
+        if job.rowsparse is not None:
+            payload = job.rowsparse["push_payload"]
+            rtype = RequestType.ROW_SPARSE_PUSH_PULL
+        elif task.compressed is not None:
             payload = task.compressed
             rtype = RequestType.COMPRESSED_PUSH_PULL
         else:
@@ -461,6 +579,24 @@ class PipelineEngine:
         core_loops.cc:584-618)."""
         job: _Job = task.context
         compressed = task.key in self._compressors
+
+        if job.rowsparse is not None:
+            def on_rs_pull(payload: bytes) -> None:
+                if self.telemetry is not None:
+                    self.telemetry.record(len(payload))
+                arr = np.frombuffer(payload, dtype=job.np_dtype)
+                job.result[: arr.size] = arr
+                self._proceed(task)
+
+            self.client.pull(
+                task.key, task.version, on_rs_pull, dtype_id=job.dtype_id,
+                request_type=RequestType.ROW_SPARSE_PUSH_PULL,
+                payload=job.rowsparse["pull_req"],
+                on_error=lambda: self._fail_task(
+                    task, QueueType.PULL, "server connection lost"
+                ),
+            )
+            return
 
         def on_pull(payload: bytes) -> None:
             if self.telemetry is not None:

@@ -254,7 +254,23 @@ struct PendingPull {
   ConnPtr conn;
   uint32_t seq;
   bool wants_compressed;
+  // row-sparse pull request bytes (header + big-endian row indices);
+  // empty = dense pull (kRowSparsePushPull, common.h:267-271)
+  std::vector<uint8_t> rs_req;
 };
+
+// RS wire header: !II (nrows, row_len), then nrows big-endian u32 indices
+// [+ nrows*row_len native-order f32 values on pushes]
+static bool rs_parse_header(const std::vector<uint8_t>& p, uint32_t* nrows,
+                            uint32_t* row_len) {
+  if (p.size() < 8) return false;
+  uint32_t a, b;
+  std::memcpy(&a, p.data(), 4);
+  std::memcpy(&b, p.data() + 4, 4);
+  *nrows = ntohl(a);
+  *row_len = ntohl(b);
+  return *row_len != 0;
+}
 
 // ---------------------------------------------------------------------------
 // engine queue plane (server.cc:82-202, queue.h:49-97): N engine threads,
@@ -347,22 +363,7 @@ class NativeServer {
       {
         std::lock_guard<std::mutex> g(ks->mu);
         if (ks->store.empty() || ks->recv_count < n) continue;
-        ks->store.swap(ks->accum);
-        ks->store_version++;
-        ks->recv_count = 0;
-        if (ks->codec)
-          ks->pull_payload = ks->codec->compress((const float*)ks->store.data(), ef_lr_.load());
-        std::vector<PendingPull> still;
-        for (auto& p : ks->pending) {
-          if (p.version <= ks->store_version) {
-            flush.emplace_back(p.conn, p.seq,
-                               wire_payload_locked(*ks, p.wants_compressed),
-                               ks->store_version);
-          } else {
-            still.push_back(p);
-          }
-        }
-        ks->pending.swap(still);
+        publish_round_locked(*ks, &flush);
       }
       for (auto& [pconn, pseq, data, ver] : flush)
         send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
@@ -515,7 +516,7 @@ class NativeServer {
       if (t.op == kPush)
         ok = handle_push(t.conn, t.seq, t.key, t.cmd, t.version, t.payload);
       else if (t.op == kPull)
-        ok = handle_pull(t.conn, t.seq, t.key, t.cmd, t.version);
+        ok = handle_pull(t.conn, t.seq, t.key, t.cmd, t.version, t.payload);
       if (!ok) {
         // malformed request → drop the connection: shutdown wakes the
         // serve thread's recv; the fd closes when the last holder releases
@@ -614,6 +615,14 @@ class NativeServer {
       ks.init_waiters.emplace_back(conn, seq);
       if ((int)ks.init_waiters.size() >= num_workers_.load()) {
         waiters.swap(ks.init_waiters);
+        // completed init barrier (re-)establishes round numbering: after
+        // an elastic resize/resume every worker re-inits and restarts
+        // versions at 1 (ReDeclareTensor semantics); store contents are
+        // preserved (async parameter store across resume)
+        ks.store_version = 0;
+        ks.recv_count = 0;
+        ks.pending.clear();
+        ks.pull_payload.clear();  // stale round cache must not be served
       }
     }
     for (auto& [wconn, wseq] : waiters)
@@ -663,7 +672,11 @@ class NativeServer {
     decode_cantor(cmd, &rtype, &dtype);
     auto& ks = key_state(key);
     std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>> flush;
-    {
+    if (rtype == 1) {  // kRowSparsePushPull: scatter-sum rows
+      std::lock_guard<std::mutex> g(ks.mu);
+      if (ks.store.empty()) return false;
+      if (!handle_push_rowsparse_locked(ks, payload, &flush)) return false;
+    } else {
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) return false;  // push before init → drop conn
       bool compressed = (rtype == 2) && ks.codec != nullptr;
@@ -699,29 +712,115 @@ class NativeServer {
           bps_sum(ks.accum.data(), payload.data(), n_elems, ks.dtype);
         }
         ks.recv_count++;
-        if (ks.recv_count >= num_workers_.load()) {
-          ks.store.swap(ks.accum);
-          ks.store_version++;
-          ks.recv_count = 0;
-          if (ks.codec)
-            ks.pull_payload = ks.codec->compress((const float*)ks.store.data(), ef_lr_.load());
-          std::vector<PendingPull> still;
-          for (auto& p : ks.pending) {
-            if (p.version <= ks.store_version) {
-              flush.emplace_back(p.conn, p.seq,
-                                 wire_payload_locked(ks, p.wants_compressed),
-                                 ks.store_version);
-            } else {
-              still.push_back(p);
-            }
-          }
-          ks.pending.swap(still);
-        }
+        if (ks.recv_count >= num_workers_.load())
+          publish_round_locked(ks, &flush);
       }
     }
     send_msg(conn, kPush, seq, key, version, nullptr, 0);
     for (auto& [pconn, pseq, data, ver] : flush)
       send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
+    return true;
+  }
+
+  // ALL_RECV: publish the round and collect serviceable buffered pulls
+  // (server.cc:348-375).  Caller holds ks.mu.
+  void publish_round_locked(
+      KeyState& ks,
+      std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>*
+          flush) {
+    ks.store.swap(ks.accum);
+    ks.store_version++;
+    ks.recv_count = 0;
+    if (ks.codec)
+      ks.pull_payload =
+          ks.codec->compress((const float*)ks.store.data(), ef_lr_.load());
+    std::vector<PendingPull> still;
+    for (auto& p : ks.pending) {
+      if (p.version <= ks.store_version) {
+        std::vector<uint8_t> data;
+        if (!p.rs_req.empty()) {
+          if (!rs_gather_locked(ks, p.rs_req, &data)) {
+            // malformed gather request: drop THAT connection so the
+            // worker's on_error fires instead of hanging in synchronize()
+            shutdown(p.conn->fd, SHUT_RDWR);
+            continue;
+          }
+        } else {
+          data = wire_payload_locked(ks, p.wants_compressed);
+        }
+        flush->emplace_back(p.conn, p.seq, std::move(data), ks.store_version);
+      } else {
+        still.push_back(std::move(p));
+      }
+    }
+    ks.pending.swap(still);
+  }
+
+  // scatter-sum one worker's (indices, values) rows into the round
+  // accumulator (sparse COPY_FIRST zeroes untouched rows); caller holds
+  // ks.mu.  f32 only — the worker engine enforces the dtype.
+  bool handle_push_rowsparse_locked(
+      KeyState& ks, const std::vector<uint8_t>& payload,
+      std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>*
+          flush) {
+    uint32_t nrows, row_len;
+    if (!rs_parse_header(payload, &nrows, &row_len)) return false;
+    if (dtype_size(ks.dtype) != 4) return false;
+    const uint64_t total = ks.store.size() / 4;
+    if (total % row_len) return false;
+    const uint64_t total_rows = total / row_len;
+    if (payload.size() < 8ull + 4ull * nrows + 4ull * nrows * row_len)
+      return false;
+    const uint8_t* idxp = payload.data() + 8;
+    const float* vals = (const float*)(payload.data() + 8 + 4ull * nrows);
+    float* dst;
+    if (async_) {
+      dst = (float*)ks.store.data();  // parameter store: scatter in place
+    } else {
+      if (ks.recv_count == 0)
+        std::memset(ks.accum.data(), 0, ks.accum.size());
+      dst = (float*)ks.accum.data();
+    }
+    for (uint32_t r = 0; r < nrows; ++r) {
+      uint32_t be;
+      std::memcpy(&be, idxp + 4ull * r, 4);
+      const uint64_t row = ntohl(be);
+      if (row >= total_rows) return false;
+      float* out = dst + row * (uint64_t)row_len;
+      const float* src = vals + (uint64_t)r * row_len;
+      for (uint32_t c = 0; c < row_len; ++c) out[c] += src[c];
+    }
+    if (async_) {
+      ks.store_version++;
+      return true;
+    }
+    ks.recv_count++;
+    if (ks.recv_count >= num_workers_.load()) publish_round_locked(ks, flush);
+    return true;
+  }
+
+  // gather the rows a row-sparse pull requests; caller holds ks.mu
+  bool rs_gather_locked(KeyState& ks, const std::vector<uint8_t>& req,
+                        std::vector<uint8_t>* out) {
+    uint32_t nrows, row_len;
+    if (!rs_parse_header(req, &nrows, &row_len)) return false;
+    if (dtype_size(ks.dtype) != 4) return false;
+    const uint64_t total = ks.store.size() / 4;
+    if (total % row_len) return false;
+    const uint64_t total_rows = total / row_len;
+    if (req.size() < 8ull + 4ull * nrows) return false;
+    out->resize(4ull * nrows * row_len);
+    const float* store = (const float*)ks.store.data();
+    float* o = (float*)out->data();
+    const uint8_t* idxp = req.data() + 8;
+    for (uint32_t r = 0; r < nrows; ++r) {
+      uint32_t be;
+      std::memcpy(&be, idxp + 4ull * r, 4);
+      const uint64_t row = ntohl(be);
+      if (row >= total_rows) return false;
+      std::memcpy(o + (uint64_t)r * row_len, store + row * (uint64_t)row_len,
+                  4ull * row_len);
+    }
     return true;
   }
 
@@ -735,7 +834,7 @@ class NativeServer {
   }
 
   bool handle_pull(const ConnPtr& conn, uint32_t seq, uint64_t key, uint32_t cmd,
-                   uint32_t version) {
+                   uint32_t version, const std::vector<uint8_t>& payload) {
     int32_t rtype, dtype;
     decode_cantor(cmd, &rtype, &dtype);
     auto& ks = key_state(key);
@@ -746,10 +845,15 @@ class NativeServer {
       if (ks.store.empty()) return false;  // pull before init → drop conn
       bool ready = async_ || version <= ks.store_version;
       if (!ready) {
-        ks.pending.push_back({version, conn, seq, rtype == 2});
+        ks.pending.push_back({version, conn, seq, rtype == 2,
+                              rtype == 1 ? payload : std::vector<uint8_t>{}});
         return true;
       }
-      data = wire_payload_locked(ks, rtype == 2);
+      if (rtype == 1) {
+        if (!rs_gather_locked(ks, payload, &data)) return false;
+      } else {
+        data = wire_payload_locked(ks, rtype == 2);
+      }
       ver = ks.store_version;
     }
     send_msg(conn, kPull, seq, key, ver, data.data(), data.size());

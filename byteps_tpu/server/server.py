@@ -253,31 +253,49 @@ class PSServer:
         # global barrier before serving (server.cc:506)
         send_message(conn, Message(Op.BARRIER, flags=GROUP_ALL))
         recv_message(conn)
-        # periodic heartbeat so the scheduler's liveness view covers
-        # servers too (ps-lite heartbeats, SURVEY §5.3); this thread owns
-        # the scheduler connection from here on (synchronous ping/pong)
+        # This thread owns the scheduler connection from here on: periodic
+        # heartbeat (ps-lite heartbeats, SURVEY §5.3) when enabled, and in
+        # all cases the reader for unsolicited control messages — RESIZE_SEQ
+        # address books and the scale-down SHUTDOWN must be honored even
+        # with heartbeats disabled (BYTEPS_HEARTBEAT_INTERVAL=0).
         hb = self.cfg.heartbeat_interval
-        if hb > 0:
-            from byteps_tpu.comm.rendezvous import RESIZE_SEQ
+        from byteps_tpu.comm.rendezvous import RESIZE_SEQ
 
-            def beat() -> None:
+        def handle_control(msg) -> bool:
+            """True = keep draining; False = this was the ping response."""
+            if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
+                book = json.loads(msg.payload.decode())
+                self.update_num_workers(book["num_workers"])
+                return True
+            if msg.op == Op.SHUTDOWN:
+                # elastic scale-down dropped this server from the book;
+                # stop serving (stop() joins threads — run it off-thread)
+                threading.Thread(target=self.stop, daemon=True).start()
+                raise ConnectionError("scheduler requested shutdown")
+            return False
+
+        def beat() -> None:
+            try:
                 while not self._stop.wait(hb):
-                    try:
-                        send_message(conn, Message(Op.PING))
-                        # drain until the PING response: unsolicited
-                        # RESIZE_SEQ address books (elastic world-size
-                        # change) arrive interleaved on this conn
-                        while True:
-                            msg = recv_message(conn)
-                            if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
-                                book = json.loads(msg.payload.decode())
-                                self.update_num_workers(book["num_workers"])
-                                continue
-                            break
-                    except (ConnectionError, OSError):
-                        return
+                    send_message(conn, Message(Op.PING))
+                    # drain until the PING response: unsolicited control
+                    # messages arrive interleaved on this conn
+                    while handle_control(recv_message(conn)):
+                        pass
+            except (ConnectionError, OSError):
+                return
 
-            threading.Thread(target=beat, name="ps-heartbeat", daemon=True).start()
+        def listen_only() -> None:
+            try:
+                while not self._stop.is_set():
+                    handle_control(recv_message(conn))
+            except (ConnectionError, OSError):
+                return
+
+        threading.Thread(
+            target=beat if hb > 0 else listen_only,
+            name="ps-heartbeat", daemon=True,
+        ).start()
 
     # --- connection plane ------------------------------------------------
 
@@ -411,14 +429,51 @@ class PSServer:
             ks.init_waiters.append((conn, send_lock, msg.seq))
             if len(ks.init_waiters) >= self.num_workers:
                 waiters, ks.init_waiters = ks.init_waiters, []
+                # A completed init barrier (re-)establishes round numbering:
+                # after an elastic resize/resume EVERY worker re-inits and
+                # restarts versions at 1 (ReDeclareTensor semantics,
+                # global.cc:431-436), so stale sync-round state from the
+                # previous generation must not gate the new sequence.  Store
+                # CONTENTS survive (async parameter store across resume).
+                ks.store_version = 0
+                ks.recv_count = 0
+                ks.pending_pulls = []
+                # round caches are stamped with version numbers that the
+                # new generation will REUSE — a stale cache would serve
+                # the previous generation's bytes as the new round
+                ks.pull_payload = None
+                ks.pull_version = -1
+                ks.raw_payload = None
+                ks.raw_version = -1
             else:
                 return
         for wconn, wlock, wseq in waiters:
             send_message(wconn, Message(Op.INIT, key=msg.key, seq=wseq), wlock)
 
+    @staticmethod
+    def _parse_rowsparse(payload: bytes, dtype, with_values: bool):
+        """RS wire format (kRowSparsePushPull, common.h:267-271): header
+        ``!II`` (nrows, row_len) + nrows big-endian u32 row indices
+        [+ nrows*row_len values in the key's dtype, native order — same
+        byte order as dense payloads]."""
+        import struct
+
+        nrows, row_len = struct.unpack_from("!II", payload, 0)
+        idx = np.frombuffer(payload, dtype=">u4", count=nrows, offset=8).astype(
+            np.int64
+        )
+        if not with_values:
+            return nrows, row_len, idx, None
+        vals = np.frombuffer(
+            payload, dtype=dtype, count=nrows * row_len, offset=8 + 4 * nrows
+        ).reshape(nrows, row_len)
+        return nrows, row_len, idx, vals
+
     def _handle_push(self, msg: Message, conn, send_lock) -> None:
         ks = self._key_state(msg.key)
         rtype, dtype_id = decode_command_type(msg.cmd)
+        if rtype == RequestType.ROW_SPARSE_PUSH_PULL:
+            return self._handle_push_rowsparse(msg, conn, send_lock, ks)
         if self._debug:
             # per-request key log (BYTEPS_SERVER_DEBUG, server.cc:120-144)
             from byteps_tpu.common import logging as bpslog
@@ -473,6 +528,68 @@ class PSServer:
                 plock,
             )
 
+    def _handle_push_rowsparse(self, msg: Message, conn, send_lock, ks) -> None:
+        """Row-sparse push (RequestType::kRowSparsePushPull,
+        common.h:267-271): scatter-sum (indices, values) rows into the
+        dense store — the embedding-gradient path.  Round semantics match
+        the dense path: one push per worker per round; rows untouched by
+        every worker aggregate to zero for that round."""
+        flush: List = []
+        with ks.lock:
+            if ks.store is None:
+                raise RuntimeError(f"push for uninitialized key {msg.key}")
+            nrows, row_len, idx, vals = self._parse_rowsparse(
+                msg.payload, ks.dtype, with_values=True
+            )
+            if row_len == 0 or ks.store.size % row_len:
+                raise RuntimeError(
+                    f"rowsparse row_len {row_len} does not divide "
+                    f"store size {ks.store.size} (key {msg.key})"
+                )
+            total_rows = ks.store.size // row_len
+            if nrows and int(idx.max()) >= total_rows:
+                raise RuntimeError(
+                    f"rowsparse index {int(idx.max())} >= {total_rows} rows"
+                )
+            if self.cfg.enable_async:
+                # async parameter store: scatter deltas in place
+                np.add.at(ks.store.reshape(total_rows, row_len), idx, vals)
+                ks.store_version += 1
+                ks.pushed_total += 1
+            else:
+                if ks.recv_count == 0:
+                    # sparse COPY_FIRST: rows this worker does NOT touch
+                    # must start the round at zero, not last round's sum
+                    ks.accum[:] = 0
+                # np.add.at accumulates duplicate indices correctly
+                np.add.at(ks.accum.reshape(total_rows, row_len), idx, vals)
+                ks.recv_count += 1
+                ks.pushed_total += 1
+                if ks.recv_count >= self.num_workers:
+                    flush.extend(self._publish_round_locked(ks, False))
+        send_message(
+            conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version),
+            send_lock,
+        )
+        for pconn, plock, pseq, payload, ver in flush:
+            send_message(
+                pconn,
+                Message(Op.PULL, key=msg.key, payload=payload, seq=pseq, version=ver),
+                plock,
+            )
+
+    def _rowsparse_gather(self, ks: "_KeyState", req_payload: bytes) -> bytes:
+        """Serve an RS pull: gather the requested rows from the store."""
+        nrows, row_len, idx, _ = self._parse_rowsparse(
+            req_payload, ks.dtype, with_values=False
+        )
+        if row_len == 0 or ks.store.size % row_len:
+            raise RuntimeError(f"rowsparse pull row_len {row_len} invalid")
+        total_rows = ks.store.size // row_len
+        if nrows and int(idx.max()) >= total_rows:
+            raise RuntimeError("rowsparse pull index out of range")
+        return ks.store.reshape(total_rows, row_len)[idx].tobytes()
+
     def _publish_round_locked(self, ks: "_KeyState", compressed: bool) -> List:
         """ALL_RECV: publish the round, flush buffered pulls
         (server.cc:348-375).  Caller holds ks.lock; returns the flush list."""
@@ -486,13 +603,23 @@ class PSServer:
             ks.pull_version = ks.store_version
         flush: List = []
         still_pending = []
-        for version, pconn, plock, pseq, pcomp in ks.pending_pulls:
+        for version, pconn, plock, pseq, pcomp, rs_req in ks.pending_pulls:
             if version <= ks.store_version:
-                flush.append(
-                    (pconn, plock, pseq, ks.wire_payload(pcomp), ks.store_version)
-                )
+                try:
+                    payload = (
+                        self._rowsparse_gather(ks, rs_req)
+                        if rs_req is not None
+                        else ks.wire_payload(pcomp)
+                    )
+                except RuntimeError:
+                    # malformed RS gather request: drop THAT connection (the
+                    # worker's on_error fires instead of hanging forever) —
+                    # and keep serving the rest of the flush list
+                    close_socket(pconn)
+                    continue
+                flush.append((pconn, plock, pseq, payload, ks.store_version))
             else:
-                still_pending.append((version, pconn, plock, pseq, pcomp))
+                still_pending.append((version, pconn, plock, pseq, pcomp, rs_req))
         ks.pending_pulls = still_pending
         return flush
 
@@ -522,16 +649,22 @@ class PSServer:
         ks = self._key_state(msg.key)
         rtype, _ = decode_command_type(msg.cmd)
         wants_compressed = rtype == RequestType.COMPRESSED_PUSH_PULL
+        rowsparse = rtype == RequestType.ROW_SPARSE_PUSH_PULL
         with ks.lock:
             if ks.store is None:
                 raise RuntimeError(f"pull for uninitialized key {msg.key}")
             ready = self.cfg.enable_async or msg.version <= ks.store_version
             if ready:
-                payload = ks.wire_payload(wants_compressed, self.cfg.enable_async)
+                payload = (
+                    self._rowsparse_gather(ks, msg.payload)
+                    if rowsparse
+                    else ks.wire_payload(wants_compressed, self.cfg.enable_async)
+                )
                 ver = ks.store_version
             else:
                 ks.pending_pulls.append(
-                    (msg.version, conn, send_lock, msg.seq, wants_compressed)
+                    (msg.version, conn, send_lock, msg.seq, wants_compressed,
+                     msg.payload if rowsparse else None)
                 )
                 return
         send_message(

@@ -616,3 +616,158 @@ class TestServerScheduling:
         finally:
             srv.stop()
             sched.stop()
+
+
+_RS_WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import byteps_tpu as bps
+
+    bps.init()
+    r = bps.rank()
+    # worker 0 touches rows {0, 2}; worker 1 touches rows {1, 2}:
+    # disjoint rows pass through, row 2 sums across workers
+    if r == 0:
+        idx = np.array([0, 2], np.int64)
+        vals = np.stack([np.full(8, 1.0), np.full(8, 10.0)]).astype(np.float32)
+    else:
+        idx = np.array([1, 2], np.int64)
+        vals = np.stack([np.full(8, 2.0), np.full(8, 20.0)]).astype(np.float32)
+    out = bps.push_pull_rowsparse(idx, vals, name="emb.grad", total_rows=16,
+                                  average=False)
+    assert out.shape == (2, 8), out.shape
+    if r == 0:
+        assert np.allclose(out[0], 1.0), out[0]   # row 0: only w0
+        assert np.allclose(out[1], 30.0), out[1]  # row 2: 10 + 20
+    else:
+        assert np.allclose(out[0], 2.0), out[0]   # row 1: only w1
+        assert np.allclose(out[1], 30.0), out[1]
+    # averaged round on the same key
+    avg = bps.push_pull_rowsparse(idx, vals, name="emb.grad", total_rows=16,
+                                  average=True)
+    assert np.allclose(avg[1], 15.0), avg[1]
+    bps.shutdown()
+    print(f"RS_WORKER_{r}_OK")
+    """
+)
+
+
+class TestRowSparse:
+    def test_rowsparse_identity_one_worker(self, fake_cluster):
+        """1 worker ⇒ RS push_pull returns the pushed rows
+        (kRowSparsePushPull, common.h:267-271) — runs against every
+        engine/van combination via the fixture."""
+        import byteps_tpu as bps
+
+        bps.init()
+        idx = np.array([3, 0, 7], np.int64)
+        vals = np.arange(12, dtype=np.float32).reshape(3, 4) + 1.0
+        out = bps.push_pull_rowsparse(
+            idx, vals, name="rs.id", total_rows=10, average=False
+        )
+        np.testing.assert_allclose(out, vals)
+        bps.shutdown()
+
+    def test_rowsparse_duplicate_indices_accumulate(self, fake_cluster):
+        """Duplicate indices in one push scatter-ADD (np.add.at semantics);
+        the pull then gathers the summed row for each occurrence."""
+        import byteps_tpu as bps
+
+        bps.init()
+        idx = np.array([5, 5], np.int64)
+        vals = np.stack(
+            [np.full(4, 1.0), np.full(4, 2.0)]
+        ).astype(np.float32)
+        out = bps.push_pull_rowsparse(
+            idx, vals, name="rs.dup", total_rows=8, average=False
+        )
+        np.testing.assert_allclose(out, 3.0)  # both gathers see row5 = 1+2
+        bps.shutdown()
+
+    def test_rowsparse_multi_round_and_untouched_rows_reset(self, fake_cluster):
+        """Round 2 must not inherit round 1's rows (sparse COPY_FIRST
+        zeroes the accumulator): a row touched only in round 1 reads 0 in
+        round 2."""
+        import byteps_tpu as bps
+
+        bps.init()
+        idx1 = np.array([1], np.int64)
+        v1 = np.full((1, 4), 7.0, np.float32)
+        out1 = bps.push_pull_rowsparse(idx1, v1, name="rs.rounds", total_rows=4,
+                                       average=False)
+        np.testing.assert_allclose(out1, 7.0)
+        idx2 = np.array([2, 1], np.int64)
+        v2 = np.stack([np.full(4, 5.0), np.zeros(4)]).astype(np.float32)
+        out2 = bps.push_pull_rowsparse(idx2, v2, name="rs.rounds", total_rows=4,
+                                       average=False)
+        np.testing.assert_allclose(out2[0], 5.0)
+        np.testing.assert_allclose(out2[1], 0.0)  # round 1's 7.0 is gone
+        bps.shutdown()
+
+    def test_rowsparse_validation(self, fake_cluster):
+        import byteps_tpu as bps
+
+        bps.init()
+        with pytest.raises(ValueError, match="out of range"):
+            bps.push_pull_rowsparse(
+                np.array([9], np.int64), np.ones((1, 4), np.float32),
+                name="rs.bad", total_rows=4,
+            )
+        with pytest.raises(ValueError, match="indices"):
+            bps.push_pull_rowsparse(
+                np.array([[1]], np.int64), np.ones((1, 4), np.float32),
+                name="rs.bad2", total_rows=4,
+            )
+        bps.shutdown()
+
+    @pytest.mark.parametrize("server_kind", ["python", "native"])
+    def test_two_workers_rowsparse_sum(self, tmp_path, server_kind):
+        """Cross-worker RS aggregation: disjoint rows pass through, shared
+        rows sum — against BOTH server engines."""
+        if server_kind == "native":
+            from byteps_tpu.native import HAVE_NATIVE
+
+            if not HAVE_NATIVE:
+                pytest.skip("native lib not built")
+        sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
+        sched.start()
+        env_common = {
+            **os.environ,
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "1",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": "/root/repo",
+        }
+        scfg = Config.from_env()
+        scfg.num_worker = 2
+        scfg.num_server = 1
+        scfg.ps_root_uri = "127.0.0.1"
+        scfg.ps_root_port = sched.port
+        srv = NativePSServer(scfg) if server_kind == "native" else PSServer(scfg)
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        script = tmp_path / "rs_worker.py"
+        script.write_text(_RS_WORKER_SCRIPT)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env={**env_common, "BYTEPS_GLOBAL_RANK": str(i)},
+                cwd="/root/repo",
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        srv.stop()
+        sched.stop()
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rs worker {i} failed:\n{out}"
+        combined = "".join(outs)
+        assert "RS_WORKER_0_OK" in combined and "RS_WORKER_1_OK" in combined
