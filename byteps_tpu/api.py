@@ -183,15 +183,10 @@ def push_pull_rowsparse_async(
     if not cfg.is_distributed:
         # same semantics as the 1-worker PS path — scatter-add then gather,
         # so duplicate indices accumulate and bad indices raise identically
-        idx = np.asarray(indices, dtype=np.int64)
-        vals = np.ascontiguousarray(np.asarray(values, dtype=np.float32))
-        if idx.ndim != 1 or vals.ndim != 2 or vals.shape[0] != idx.shape[0]:
-            raise ValueError(
-                f"rowsparse wants indices (n,), values (n, row_len); got "
-                f"{idx.shape} / {vals.shape}"
-            )
-        if idx.size and (idx.min() < 0 or idx.max() >= total_rows):
-            raise ValueError(f"rowsparse indices out of range [0, {total_rows})")
+        # (shared validator keeps the two paths in lockstep)
+        from byteps_tpu.common.partition import validate_rowsparse
+
+        idx, vals = validate_rowsparse(indices, values, total_rows)
         dense = np.zeros((total_rows, vals.shape[1]), dtype=vals.dtype)
         np.add.at(dense, idx, vals)
         st.handles.mark_done(handle, dense[idx])

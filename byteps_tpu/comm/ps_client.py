@@ -25,11 +25,16 @@ from byteps_tpu.comm.rendezvous import GROUP_ALL, GROUP_WORKERS, RESIZE_SEQ
 from byteps_tpu.comm.transport import (
     Message,
     Op,
+    _recv_exact,
     close_socket,
     connect,
     recv_message,
     send_message,
 )
+
+#: sentinel payload marking a response whose bytes were received directly
+#: into the caller's registered sink buffer (zero-copy pull)
+_ZERO_COPIED = object()
 
 
 class _ServerConn:
@@ -38,11 +43,18 @@ class _ServerConn:
         self.send_lock = threading.Lock()
         self.cb_lock = threading.Lock()
         self.callbacks: Dict[int, Callable[[Message], None]] = {}
+        #: seq → caller-owned buffer the response payload is received INTO
+        #: (zero-copy pull; ps-lite ZPull-into-SArray parity)
+        self.sinks: Dict[int, memoryview] = {}
         self.next_seq = 0
         self.recv_thread: Optional[threading.Thread] = None
         self.dead = False  # set once the recv loop exits; guarded by cb_lock
 
-    def alloc_seq(self, cb: Callable[[Message], None]) -> int:
+    def alloc_seq(
+        self,
+        cb: Callable[[Message], None],
+        sink: Optional[memoryview] = None,
+    ) -> int:
         """Register a response callback; returns -1 (after firing
         ``cb(None)``) if the connection already died — a request enqueued
         AFTER the recv loop drained pending callbacks would otherwise
@@ -52,13 +64,24 @@ class _ServerConn:
                 seq = self.next_seq
                 self.next_seq += 1
                 self.callbacks[seq] = cb
+                if sink is not None:
+                    self.sinks[seq] = sink
                 return seq
         cb(None)  # outside the lock: callbacks run user code
         return -1
 
     def pop_cb(self, seq: int) -> Optional[Callable[[Message], None]]:
         with self.cb_lock:
+            self.sinks.pop(seq, None)
             return self.callbacks.pop(seq, None)
+
+    def peek_sink(self, seq: int) -> Optional[memoryview]:
+        """The registered receive buffer for a response seq, WITHOUT
+        popping the callback: the entry must stay registered until the
+        payload is fully received, so a connection dying mid-payload still
+        drains the callback with None (mark_dead) instead of losing it."""
+        with self.cb_lock:
+            return self.sinks.get(seq)
 
     def mark_dead(self):
         """Flag the connection dead and drain pending callbacks (fired
@@ -67,6 +90,7 @@ class _ServerConn:
             self.dead = True
             cbs = list(self.callbacks.values())
             self.callbacks.clear()
+            self.sinks.clear()
             return cbs
 
 
@@ -94,7 +118,11 @@ class PSClient:
         self.server_generation = 0
         self._stop = threading.Event()
         self._rebuild_lock = threading.Lock()  # serializes live server swaps
+        self._book_token = 0     # RESIZE_SEQ arrival counter (sched thread)
+        self._applied_token = 0  # newest book actually applied
         self.is_recovery = False
+        #: responses whose payloads landed directly in caller buffers
+        self.zero_copy_pulls = 0
 
     # --- rendezvous ------------------------------------------------------
 
@@ -218,12 +246,18 @@ class PSClient:
                     self.num_workers = book["num_workers"]
                     new_addrs = [tuple(s) for s in book["servers"]]
                     if new_addrs != self._server_addrs:
+                        # token = book arrival order on THIS (single)
+                        # thread: rebuild threads acquire the lock in
+                        # arbitrary order, so staleness is decided by
+                        # token, not address equality
+                        self._book_token += 1
                         # rebuild OFF this thread: connects can block/fail
                         # and must neither stall scheduler callback
                         # delivery nor kill this loop (→ _sched_dead)
                         threading.Thread(
                             target=self._rebuild_servers,
-                            args=(book["num_servers"], new_addrs),
+                            args=(book["num_servers"], new_addrs,
+                                  self._book_token),
                             daemon=True,
                         ).start()
                     continue
@@ -245,7 +279,9 @@ class PSClient:
             for ev, _ in pending:
                 ev.set()
 
-    def _rebuild_servers(self, num_servers: int, new_addrs: List[tuple]) -> None:
+    def _rebuild_servers(
+        self, num_servers: int, new_addrs: List[tuple], token: int = 1 << 62
+    ) -> None:
         """Adopt a resized server book live: connect to the new set, swap,
         then fail the old connections' in-flight requests (same path as a
         server death — the handle errors instead of hanging).  Requests
@@ -253,11 +289,12 @@ class PSClient:
         caller's next round routes and re-inits against the new owners.
 
         Runs on its own thread (a connect may block or fail during elastic
-        churn); rebuilds are serialized, and a superseded book (another
-        RESIZE_SEQ arrived meanwhile) is skipped."""
+        churn); rebuilds are serialized, and a stale book — one that
+        ARRIVED before the currently-applied one, regardless of which
+        thread wins the lock — is skipped by its monotonic ``token``."""
         with self._rebuild_lock:
-            if new_addrs == self._server_addrs or self._stop.is_set():
-                return  # already applied or shutting down
+            if token <= self._applied_token or self._stop.is_set():
+                return  # superseded by a newer book, or shutting down
             fresh: List[_ServerConn] = []
             for attempt in range(3):
                 try:
@@ -287,6 +324,7 @@ class PSClient:
             self._server_addrs = list(new_addrs)
             self.num_servers = num_servers
             self.server_generation += 1
+            self._applied_token = token
         for sc in old:
             close_socket(sc.sock)  # recv loop exits → mark_dead fails pendings
 
@@ -312,15 +350,39 @@ class PSClient:
         return box[0]
 
     def _recv_loop(self, sc: _ServerConn) -> None:
+        from byteps_tpu.comm.transport import recv_header, recv_into
+
         try:
             while not self._stop.is_set():
                 try:
-                    msg = recv_message(sc.sock)
+                    op, status, flags, seq, key, cmd, version, length = (
+                        recv_header(sc.sock)
+                    )
+                    # the callback is popped only AFTER the payload is
+                    # fully received: dying mid-payload must leave it for
+                    # mark_dead's cb(None) drain, never lose it
+                    sink = sc.peek_sink(seq)
+                    if sink is not None and length == len(sink):
+                        # zero-copy: the aggregated payload lands directly
+                        # in the caller's result buffer — no intermediate
+                        # bytes object, no frombuffer+slice copy
+                        recv_into(sc.sock, sink)
+                        payload = _ZERO_COPIED
+                        self.zero_copy_pulls += 1
+                    else:
+                        payload = (
+                            _recv_exact(sc.sock, length) if length else b""
+                        )
                 except (ConnectionError, OSError):
                     return
-                cb = sc.pop_cb(msg.seq)
+                cb = sc.pop_cb(seq)
                 if cb is not None:
-                    cb(msg)
+                    cb(
+                        Message(
+                            op, key=key, payload=payload, seq=seq, cmd=cmd,
+                            version=version, status=status, flags=flags,
+                        )
+                    )
         finally:
             # a dead server connection must FAIL every pending request
             # (cb(None)), not leave its callers blocked in synchronize()
@@ -343,6 +405,25 @@ class PSClient:
             num_workers=self.num_workers,
         )
 
+    def _conn_for(self, key: int) -> _ServerConn:
+        """Route a key from ONE atomic snapshot of the server list.
+        During a live resize the list reference swaps under us; hashing
+        with ``len(snapshot)`` keeps count and list consistent (reading
+        self.num_servers separately could pair the new count with the old
+        list → IndexError instead of the designed dead-connection path)."""
+        servers = self._servers
+        return servers[
+            assign_server(
+                key,
+                len(servers),
+                fn=self.cfg.key_hash_fn,
+                coef=self.cfg.built_in_hash_coef,
+                mixed_mode=self.cfg.enable_mixed_mode,
+                mixed_bound=self.cfg.mixed_mode_bound,
+                num_workers=self.num_workers,
+            )
+        ]
+
     # --- data plane ------------------------------------------------------
 
     def init_tensor(self, key: int, num_elements: int, dtype_id: int) -> None:
@@ -353,7 +434,7 @@ class PSClient:
         order) so the native C++ server parses it directly."""
         import struct
 
-        sc = self._servers[self.server_for(key)]
+        sc = self._conn_for(key)
         self._blocking_request(
             sc,
             lambda seq: Message(
@@ -378,7 +459,7 @@ class PSClient:
         """Async push; ``cb`` fires on server ack (ZPush,
         core_loops.cc:538-582); ``on_error`` fires if the server connection
         dies before the ack."""
-        sc = self._servers[self.server_for(key)]
+        sc = self._conn_for(key)
         seq = sc.alloc_seq(
             lambda msg: cb() if msg is not None
             else (on_error() if on_error is not None else None)
@@ -407,15 +488,21 @@ class PSClient:
         request_type: RequestType = RequestType.DEFAULT_PUSH_PULL,
         on_error: Optional[Callable[[], None]] = None,
         payload: bytes = b"",
+        sink: Optional[memoryview] = None,
     ) -> None:
         """Async pull; ``cb`` receives the aggregated payload (ZPull,
         core_loops.cc:584-618); ``on_error`` fires if the server connection
         dies before the response.  ``payload`` carries the request body for
-        row-sparse pulls (the row indices to gather)."""
-        sc = self._servers[self.server_for(key)]
+        row-sparse pulls (the row indices to gather).
+
+        ``sink``: caller-owned writable buffer; when the response length
+        matches, the payload is received INTO it (zero payload copies) and
+        ``cb`` gets the ``_ZERO_COPIED`` sentinel instead of bytes."""
+        sc = self._conn_for(key)
         seq = sc.alloc_seq(
             lambda msg: cb(msg.payload) if msg is not None
-            else (on_error() if on_error is not None else None)
+            else (on_error() if on_error is not None else None),
+            sink=sink,
         )
         if seq < 0:  # connection died; on_error already fired
             return
@@ -438,7 +525,7 @@ class PSClient:
 
         Payload is newline-separated ``key=value`` text — parseable by the
         Python and native C++ servers alike."""
-        sc = self._servers[self.server_for(key)]
+        sc = self._conn_for(key)
         payload = "\n".join(f"{k}={v}" for k, v in sorted(kwargs.items())).encode()
         self._blocking_request(
             sc,

@@ -90,45 +90,69 @@ class Message:
         return self.encode_header() + self.payload
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
+def recv_into(sock: socket.socket, view: memoryview) -> None:
+    """Receive exactly len(view) bytes INTO the caller's buffer — the
+    zero-copy receive primitive (ps-lite ZPull pulls into the caller's
+    SArray, core_loops.cc:584-618)."""
+    n = len(view)
     got = 0
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise ConnectionError("peer closed")
         got += r
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    recv_into(sock, memoryview(buf))
     return bytes(buf)
 
 
-def recv_message(sock: socket.socket) -> Message:
+def recv_header(sock: socket.socket) -> tuple:
+    """Read + parse one 32-byte header; returns
+    (op, status, flags, seq, key, cmd, version, length)."""
     hdr = _recv_exact(sock, HEADER_SIZE)
     magic, op, status, flags, seq, key, cmd, version, length = struct.unpack(
         HEADER_FMT, hdr
     )
     if magic != MAGIC:
         raise ConnectionError(f"bad magic {magic:#x}")
+    return Op(op), status, flags, seq, key, cmd, version, length
+
+
+def recv_message(sock: socket.socket) -> Message:
+    op, status, flags, seq, key, cmd, version, length = recv_header(sock)
     payload = _recv_exact(sock, length) if length else b""
     return Message(
-        Op(op), key=key, payload=payload, seq=seq, cmd=cmd, version=version,
+        op, key=key, payload=payload, seq=seq, cmd=cmd, version=version,
         status=status, flags=flags,
     )
 
 
-_SPLIT_SEND_BYTES = 64 * 1024
-
-
 def _send(sock: socket.socket, msg: Message) -> None:
-    if len(msg.payload) >= _SPLIT_SEND_BYTES:
-        # two sends instead of one header+payload concat: for multi-MB
-        # gradient partitions the concat would copy the whole tensor an
-        # extra time on every push/pull
-        hdr = msg.encode_header()
-        sock.sendall(hdr)
-        sock.sendall(msg.payload)
-    else:
-        sock.sendall(msg.encode())
+    payload = msg.payload
+    if not payload:
+        sock.sendall(msg.encode_header())
+        return
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None:
+        # van object without scatter-gather: header-then-payload, still no
+        # concat copy of the payload
+        sock.sendall(msg.encode_header())
+        sock.sendall(payload)
+        return
+    # scatter-gather send: header + payload leave in ONE syscall with ZERO
+    # payload memcpys (the kernel gathers straight from the caller's
+    # buffer) — ps-lite's zero-copy ZPush property (core_loops.cc:538-582)
+    bufs = [memoryview(msg.encode_header()), memoryview(payload)]
+    while bufs:
+        sent = sendmsg(bufs)
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if bufs and sent:
+            bufs[0] = bufs[0][sent:]
 
 
 def send_message(sock: socket.socket, msg: Message, lock: Optional[threading.Lock] = None) -> None:

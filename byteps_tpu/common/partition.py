@@ -71,3 +71,22 @@ def flatten_for_comm(arr: np.ndarray) -> np.ndarray:
     """Flatten to 1-D without copy when possible; the comm plane works on
     flat element ranges (the reference communicates raw byte buffers)."""
     return np.ascontiguousarray(arr).reshape(-1)
+
+
+def validate_rowsparse(indices, values, total_rows: int):
+    """Shared validation/normalization for the row-sparse paths
+    (kRowSparsePushPull, common.h:267-271) — the engine submit and the
+    api's non-distributed shortcut must agree exactly, or 1-worker and
+    N-worker runs would diverge.  Returns (idx int64[n], vals f32[n, r])."""
+    import numpy as _np
+
+    idx = _np.ascontiguousarray(_np.asarray(indices, dtype=_np.int64))
+    vals = _np.ascontiguousarray(_np.asarray(values, dtype=_np.float32))
+    if idx.ndim != 1 or vals.ndim != 2 or vals.shape[0] != idx.shape[0]:
+        raise ValueError(
+            f"rowsparse wants indices (n,), values (n, row_len); got "
+            f"{idx.shape} / {vals.shape}"
+        )
+    if idx.size and (idx.min() < 0 or idx.max() >= total_rows):
+        raise ValueError(f"rowsparse indices out of range [0, {total_rows})")
+    return idx, vals

@@ -29,7 +29,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from byteps_tpu.common.config import Config
-from byteps_tpu.common.partition import partition_tensor
+from byteps_tpu.common.partition import partition_tensor, validate_rowsparse
 from byteps_tpu.common.registry import get_registry
 from byteps_tpu.common.types import (
     QueueType,
@@ -314,15 +314,7 @@ class PipelineEngine:
         likewise exempts sparse tensors from byte partitioning)."""
         import struct
 
-        idx = np.ascontiguousarray(np.asarray(indices, dtype=np.int64))
-        vals = np.ascontiguousarray(np.asarray(values, dtype=np.float32))
-        if idx.ndim != 1 or vals.ndim != 2 or vals.shape[0] != idx.shape[0]:
-            raise ValueError(
-                f"rowsparse wants indices (n,), values (n, row_len); got "
-                f"{idx.shape} / {vals.shape}"
-            )
-        if idx.size and (idx.min() < 0 or idx.max() >= total_rows):
-            raise ValueError(f"rowsparse indices out of range [0, {total_rows})")
+        idx, vals = validate_rowsparse(indices, values, total_rows)
         nrows, row_len = vals.shape
         dtype_id = int(to_datatype(vals.dtype))
 
@@ -561,7 +553,15 @@ class PipelineEngine:
             payload = task.compressed
             rtype = RequestType.COMPRESSED_PUSH_PULL
         else:
-            payload = task.cpubuff.tobytes()
+            # zero-copy send: hand the staged partition's buffer straight
+            # to the scatter-gather sendmsg (no tobytes() copy); fall back
+            # to a copy only for non-contiguous staging buffers
+            buf = task.cpubuff
+            payload = (
+                buf.data.cast("B")
+                if buf.flags.c_contiguous
+                else buf.tobytes()
+            )
             rtype = RequestType.DEFAULT_PUSH_PULL
         if self.telemetry is not None:
             self.telemetry.record(len(payload))
@@ -598,12 +598,34 @@ class PipelineEngine:
             )
             return
 
-        def on_pull(payload: bytes) -> None:
+        # zero-copy receive target: the partition's byte range of the
+        # result buffer — the aggregated payload lands there directly
+        # (ZPull into the caller's SArray, core_loops.cc:584-618)
+        sink = None
+        if not compressed:
+            sink = memoryview(job.result).cast("B")[
+                task.offset * job.np_dtype.itemsize
+                : (task.offset + task.length) * job.np_dtype.itemsize
+            ]
+
+        def on_pull(payload) -> None:
+            from byteps_tpu.comm.ps_client import _ZERO_COPIED
+
             if self.telemetry is not None:
-                self.telemetry.record(len(payload))
-            if compressed:
+                # actual WIRE bytes: a zero-copy sink is always the full
+                # uncompressed partition; otherwise len(payload) is the
+                # real (possibly compressed) transfer size
+                self.telemetry.record(
+                    task.length * job.np_dtype.itemsize
+                    if payload is _ZERO_COPIED
+                    else len(payload)
+                )
+            if payload is _ZERO_COPIED:
+                pass  # already in job.result via the sink
+            elif compressed:
                 task.compressed = payload  # decoded by DECOMPRESS stage
             else:
+                # fallback (response length differed from the sink)
                 arr = np.frombuffer(payload, dtype=job.np_dtype)
                 job.result[task.offset : task.offset + task.length] = arr[: task.length]
             self._proceed(task)
@@ -612,6 +634,7 @@ class PipelineEngine:
             task.key, task.version, on_pull, dtype_id=job.dtype_id,
             request_type=RequestType.COMPRESSED_PUSH_PULL
             if compressed else RequestType.DEFAULT_PUSH_PULL,
+            sink=sink,
             on_error=lambda: self._fail_task(
                 task, QueueType.PULL, "server connection lost"
             ),

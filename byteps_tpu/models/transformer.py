@@ -343,6 +343,15 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
             attn = ulysses_attention(
                 q, k, v, axis_name="sp", axis_size=sp, causal=cfg.causal
             )
+        elif sp > 1 and cfg.use_flash:
+            # long-context composition (round-2 VERDICT #9): flash-kernel
+            # hops inside the ring — O(block) memory per hop instead of
+            # the (B, H, S_local, S_local) per-hop score matrix
+            from byteps_tpu.parallel.ring_attention import ring_flash_attention
+
+            attn = ring_flash_attention(
+                q, k, v, axis_name="sp", axis_size=sp, causal=cfg.causal
+            )
         else:
             attn = ring_attention(
                 q, k, v, axis_name="sp" if sp > 1 else None, axis_size=sp,

@@ -270,7 +270,8 @@ def _bwd_dkv_kernel_factory(dh, bq, bk, nq, causal, scale):
     return kernel
 
 
-def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
+def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret,
+                    dlse=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -283,6 +284,12 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
     delta = jnp.sum(
         dof.astype(jnp.float32) * o.reshape(bh, s, dh).astype(jnp.float32), axis=-1
     )  # (bh, s) → lane-broadcast like lse so its blocks stay tileable
+    if dlse is not None:
+        # An lse cotangent (ring-attention online-softmax merge, which
+        # consumes lse) folds EXACTLY into the delta term: with
+        # ∂lse/∂s_ij = p_ij, ds_ij = p_ij·(dp_ij − Δ_i + dlse_i), so the
+        # kernels run unchanged on Δ' = Δ − dlse.
+        delta = delta - dlse.reshape(bh, s).astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (bh, s, LANES))
 
     dq = pl.pallas_call(
@@ -360,6 +367,59 @@ def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, bq, bk, interpret):
+    out, lse = _flash_forward(q, k, v, causal, scale, bq, bk, interpret)
+    return out, lse[..., 0].reshape(q.shape[:3])  # (b, h, s)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, bq, bk, interpret):
+    out, lse = _flash_forward(q, k, v, causal, scale, bq, bk, interpret)
+    return (out, lse[..., 0].reshape(q.shape[:3])), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, bq, bk, interpret, res, g):
+    q, k, v, o, lse = res
+    do, dlse = g
+    return _flash_backward(
+        q, k, v, o, lse, do, causal, scale, bq, bk, interpret, dlse=dlse
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> tuple:
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    ``(b, h, s)`` — the hook ring attention needs to merge per-hop partial
+    attention online (o, lse merging is exact: L = logaddexp(L_a, L_b),
+    o = o_a·e^{L_a−L} + o_b·e^{L_b−L}).  Differentiable in (q, k, v)
+    including the lse output (its cotangent folds into the backward's
+    delta term)."""
+    b, h, s, dh = q.shape
+    scale = scale if scale is not None else dh**-0.5
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if (s % bq or s % bk) or (not on_tpu and not interpret):
+        out = _dense_reference(q, k, v, causal, scale)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((s, k.shape[2]), bool))
+            sc = jnp.where(mask, sc, NEG_INF)
+        return out, jax.scipy.special.logsumexp(sc.astype(jnp.float32), axis=-1)
+    return _flash_lse(q, k, v, causal, scale, bq, bk, interpret)
 
 
 def flash_attention(

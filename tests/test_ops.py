@@ -112,3 +112,158 @@ class TestOneBitDevice:
         assert words.shape == (4,)  # ceil(100/32)
         out = onebit_decompress_device(scale, words, 100)
         np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+class TestFlashLse:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_lse_matches_dense(self, causal):
+        rng = np.random.default_rng(5)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(1, 2, 64, 16)).astype(np.float32))
+            for _ in range(3)
+        )
+        from byteps_tpu.ops.flash_attention import flash_attention_lse
+
+        out, lse = flash_attention_lse(
+            q, k, v, causal=causal, block_q=16, block_k=16, interpret=True
+        )
+        scale = 16 ** -0.5
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            mask = np.tril(np.ones((64, 64), bool))
+            s = np.where(mask, s, -1e30)
+        ref_lse = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + s.max(-1)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        ref = np.einsum("bhqk,bhkd->bhqd", p / p.sum(-1, keepdims=True), v)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse), ref_lse, rtol=2e-4, atol=2e-5)
+
+    def test_lse_cotangent_folds_into_backward(self):
+        """grad through a function of BOTH outputs (out, lse) must match
+        the dense autodiff reference — the dlse→delta fold."""
+        from byteps_tpu.ops.flash_attention import (
+            _dense_reference,
+            flash_attention_lse,
+        )
+
+        rng = np.random.default_rng(6)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(1, 1, 32, 8)).astype(np.float32))
+            for _ in range(3)
+        )
+
+        def loss_flash(q, k, v):
+            o, lse = flash_attention_lse(
+                q, k, v, causal=True, block_q=16, block_k=16, interpret=True
+            )
+            return jnp.sum(o**2) + jnp.sum(jnp.sin(lse))
+
+        def loss_dense(q, k, v):
+            scale = 8 ** -0.5
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((32, 32), bool))
+            s = jnp.where(mask, s, -1e30)
+            lse = jax.scipy.special.logsumexp(s, axis=-1)
+            o = _dense_reference(q, k, v, True, scale)
+            return jnp.sum(o**2) + jnp.sum(jnp.sin(lse))
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+
+class TestDeviceCodecs:
+    """On-device topk/dithering (round-2 VERDICT #8): wire parity with the
+    host codecs and the D2H byte reduction that motivates them."""
+
+    def test_topk_payload_bit_matches_host_codec(self):
+        from byteps_tpu.compression.impl import TopKCompressor
+        from byteps_tpu.ops.codecs_device import topk_compress_device, topk_payload
+
+        rng = np.random.default_rng(0)
+        n, k = 4096, 64
+        grad = rng.normal(size=n).astype(np.float32)  # distinct |values| w.p. 1
+        host = TopKCompressor(n, k).compress(grad)
+        idx, vals = topk_compress_device(jnp.asarray(grad), k)
+        assert topk_payload(idx, vals) == host
+
+    def test_topk_d2h_reduction_and_roundtrip(self):
+        from byteps_tpu.compression.impl import TopKCompressor
+        from byteps_tpu.ops.codecs_device import (
+            topk_compress_device,
+            topk_payload,
+            topk_sum_device,
+        )
+
+        rng = np.random.default_rng(1)
+        n, k = 8192, 128
+        grad = rng.normal(size=n).astype(np.float32)
+        idx, vals = topk_compress_device(jnp.asarray(grad), k)
+        payload = topk_payload(idx, vals)
+        # D2H bytes: 8k vs 4n — 32x smaller at this (n, k)
+        assert len(payload) == 8 * k
+        assert len(payload) * 32 == 4 * n
+        # host server decodes the device payload exactly
+        dec = TopKCompressor(n, k).decompress(payload, n)
+        ref = topk_sum_device(idx, vals, n)
+        np.testing.assert_array_equal(dec, np.asarray(ref))
+
+    @pytest.mark.parametrize("natural,l2", [(False, False), (True, False),
+                                            (False, True), (True, True)])
+    def test_dithering_wire_decodes_identically_on_host(self, natural, l2):
+        """Host DitheringCompressor.decompress of a DEVICE payload must
+        equal the device decompress — exact decode parity (the wire carries
+        levels; no RNG on the decode side)."""
+        from byteps_tpu.compression.impl import DitheringCompressor
+        from byteps_tpu.ops.codecs_device import (
+            dithering_compress_device,
+            dithering_decompress_device,
+            dithering_payload,
+        )
+
+        rng = np.random.default_rng(2)
+        n, s = 1024, 4
+        grad = rng.normal(size=n).astype(np.float32)
+        norm, levels = dithering_compress_device(
+            jnp.asarray(grad), jax.random.PRNGKey(7), s=s, natural=natural, l2=l2
+        )
+        payload = dithering_payload(norm, levels)
+        assert len(payload) == 4 + n  # ~4x smaller than 4n fp32
+        host_codec = DitheringCompressor(
+            n, k=s, partition="natural" if natural else "linear",
+            normalize="l2" if l2 else "max",
+        )
+        host_dec = host_codec.decompress(payload, n)
+        dev_dec = dithering_decompress_device(norm, levels, s=s, natural=natural)
+        np.testing.assert_allclose(np.asarray(dev_dec), host_dec, rtol=1e-6)
+
+    def test_dithering_unbiased_and_on_grid(self):
+        """Stochastic rounding must be unbiased (E[decompress] = grad) and
+        every level must sit on the host codec's quantization grid."""
+        from byteps_tpu.ops.codecs_device import (
+            dithering_compress_device,
+            dithering_decompress_device,
+        )
+
+        rng = np.random.default_rng(3)
+        n, s = 512, 4
+        grad = rng.normal(size=n).astype(np.float32)
+        acc = np.zeros(n, np.float64)
+        trials = 200
+        for t in range(trials):
+            norm, levels = dithering_compress_device(
+                jnp.asarray(grad), jax.random.PRNGKey(t), s=s
+            )
+            lv = np.asarray(levels, np.int32)
+            assert np.all(np.abs(lv) <= s)
+            acc += np.asarray(
+                dithering_decompress_device(norm, levels, s=s), np.float64
+            )
+        mean = acc / trials
+        # unbiasedness: mean of 200 draws within a few quantization-noise
+        # standard errors of the input
+        norm_v = float(np.abs(grad).max())
+        se = norm_v / s / np.sqrt(trials)
+        np.testing.assert_allclose(mean, grad, atol=6 * se)

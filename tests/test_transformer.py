@@ -334,3 +334,156 @@ class TestUlyssesAttention:
         l1, _ = _run_steps(cfg, _mesh(sp=1), batch=4)
         l2, _ = _run_steps(cfg, _mesh(sp=2), batch=4)
         np.testing.assert_allclose(l1, l2, rtol=1e-3)
+
+
+class TestRingFlashAttention:
+    """Ring attention with Pallas flash hops (round-2 VERDICT #9): must be
+    numerically identical to the dense ring, differentiable, and must not
+    materialize block-pair score matrices at the jaxpr level."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense_ring(self, causal):
+        from byteps_tpu.parallel.ring_attention import ring_flash_attention
+
+        rng = np.random.default_rng(0)
+        B, H, S, dh, sp = 2, 2, 64, 8, 4
+        q = rng.normal(size=(B, H, S, dh)).astype(np.float32)
+        k = rng.normal(size=(B, H, S, dh)).astype(np.float32)
+        v = rng.normal(size=(B, H, S, dh)).astype(np.float32)
+
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(dh)
+        if causal:
+            mask = np.tril(np.ones((S, S), bool))
+            scores = np.where(mask, scores, -1e30)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        ref = np.einsum("bhqk,bhkd->bhqd", p / p.sum(-1, keepdims=True), v)
+
+        mesh = Mesh(np.array(jax.devices()[:sp]).reshape(sp), ("sp",))
+
+        def body(qb, kb, vb):
+            return ring_flash_attention(
+                qb, kb, vb, "sp", sp, causal=causal,
+                block_q=8, block_k=8, interpret=True,
+            )
+
+        fn = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(None, None, "sp"),) * 3,
+                out_specs=P(None, None, "sp"),
+                check_vma=False,
+            )
+        )
+        out = fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+    def test_differentiable_matches_dense_ring_grad(self):
+        from byteps_tpu.parallel.ring_attention import (
+            ring_attention,
+            ring_flash_attention,
+        )
+
+        sp = 2
+        mesh = Mesh(np.array(jax.devices()[:sp]).reshape(sp), ("sp",))
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(1, 2, 32, 8)).astype(np.float32))
+
+        def make_loss(fn):
+            def loss(qb):
+                out = fn(qb)
+                return jnp.sum(out**2)
+
+            def body(qb):
+                l, g = jax.value_and_grad(loss)(qb)
+                return jax.lax.psum(l, "sp"), g
+
+            return jax.jit(
+                jax.shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P(None, None, "sp"),),
+                    out_specs=(P(), P(None, None, "sp")),
+                    check_vma=False,
+                )
+            )
+
+        l1, g1 = make_loss(
+            lambda qb: ring_attention(qb, qb, qb, "sp", sp, causal=True)
+        )(q)
+        l2, g2 = make_loss(
+            lambda qb: ring_flash_attention(
+                qb, qb, qb, "sp", sp, causal=True,
+                block_q=8, block_k=8, interpret=True,
+            )
+        )(q)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_no_dense_score_matrix_in_jaxpr(self):
+        """Peak-memory proxy: the flash ring's jaxpr must contain NO
+        intermediate of shape (..., S_local, S_local) — the dense ring's
+        per-hop score matrix.  Blocks are 8×8 inside the kernel, so any
+        32×32 array would mean dense materialization leaked back in."""
+        from byteps_tpu.parallel.ring_attention import (
+            ring_attention,
+            ring_flash_attention,
+        )
+
+        sp = 2
+        S_local = 32
+        mesh = Mesh(np.array(jax.devices()[:sp]).reshape(sp), ("sp",))
+
+        def wrap(fn):
+            return jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(P(None, None, "sp"),) * 3,
+                out_specs=P(None, None, "sp"),
+                check_vma=False,
+            )
+
+        q = jnp.zeros((1, 2, S_local * sp, 8), jnp.float32)
+
+        def has_square(fn):
+            jaxpr = jax.make_jaxpr(wrap(fn))(q, q, q)
+            found = []
+
+            def subjaxprs_of(params):
+                for val in params.values():
+                    if isinstance(val, jax.extend.core.ClosedJaxpr):
+                        yield val.jaxpr
+                    elif isinstance(val, jax.extend.core.Jaxpr):
+                        yield val
+                    elif isinstance(val, (tuple, list)):
+                        for item in val:
+                            if isinstance(item, jax.extend.core.ClosedJaxpr):
+                                yield item.jaxpr
+                            elif isinstance(item, jax.extend.core.Jaxpr):
+                                yield item
+
+            def scan_eqns(jx):
+                for eqn in jx.eqns:
+                    for var in eqn.outvars:
+                        shape = getattr(getattr(var, "aval", None), "shape", ())
+                        if len(shape) >= 2 and shape[-1] == S_local and shape[-2] == S_local:
+                            found.append(shape)
+                    for sub in subjaxprs_of(eqn.params):
+                        scan_eqns(sub)
+
+            scan_eqns(jaxpr.jaxpr)
+            return bool(found)
+
+        dense_fn = lambda a, b, c: ring_attention(a, b, c, "sp", sp, causal=True)
+        flash_fn = lambda a, b, c: ring_flash_attention(
+            a, b, c, "sp", sp, causal=True, block_q=8, block_k=8, interpret=True
+        )
+        assert has_square(dense_fn), "sanity: dense ring materializes scores"
+        assert not has_square(flash_fn), "flash ring leaked a dense score matrix"
+
+    def test_model_sp2_with_flash_ring_trains(self):
+        """Model wiring: use_flash + sp>1 routes through ring_flash_attention
+        (dense fallback off-TPU) and matches the plain ring numerically."""
+        cfg_d = tiny_test(causal=True)
+        cfg_f = tiny_test(causal=True, use_flash=True)
+        l1, _ = _run_steps(cfg_d, _mesh(sp=2), batch=4)
+        l2, _ = _run_steps(cfg_f, _mesh(sp=2), batch=4)
+        np.testing.assert_allclose(l1, l2, rtol=1e-3)

@@ -1,0 +1,111 @@
+"""Van throughput microbenchmark — MB/s per transport, with copy audit.
+
+Measures the PS data plane's hot path per van (tcp / uds / shm): one
+worker drives push+pull rounds of a fixed payload against a live
+in-process server, and reports payload MB/s plus how many pulls landed
+zero-copy (received directly into the caller's result buffer — the
+ps-lite ZPull-into-SArray property, core_loops.cc:571,609).
+
+    python tools/van_bench.py [--mbytes 8] [--rounds 20] [--vans tcp,uds,shm]
+
+Prints one JSON line per van.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def bench_van(van: str, mbytes: float, rounds: int) -> dict:
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.ps_client import PSClient
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.server.server import PSServer
+
+    os.environ["BYTEPS_VAN"] = van
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    os.environ.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(sched.port),
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    cfg = Config.from_env()
+    srv = PSServer(cfg)
+    threading.Thread(target=srv.start, daemon=True).start()
+    client = PSClient(cfg, node_uid="vb")
+    client.connect()
+
+    n = int(mbytes * 1e6) // 4
+    payload = np.random.default_rng(0).normal(size=n).astype(np.float32)
+    result = np.empty(n, dtype=np.float32)
+    sink = memoryview(result).cast("B")
+    client.init_tensor(1, n, 0)
+
+    def round_once(version: int) -> None:
+        done = threading.Event()
+        state = [2]
+        lock = threading.Lock()
+
+        def dec(*_a):
+            with lock:
+                state[0] -= 1
+                if state[0] == 0:
+                    done.set()
+
+        client.push(1, payload.data.cast("B"), 0, version, cb=dec)
+        client.pull(1, version, dec, sink=sink)
+        if not done.wait(60):
+            raise RuntimeError(f"van {van} round timed out")
+
+    for w in range(2):  # warmup
+        round_once(w + 1)
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        round_once(r + 3)
+    dt = time.perf_counter() - t0
+
+    zero_copy = client.zero_copy_pulls
+    client.close()
+    srv.stop()
+    sched.stop()
+    # bytes moved per round: payload pushed + payload pulled
+    mb = 2 * mbytes * rounds
+    return {
+        "van": van,
+        "mb_per_s": round(mb / dt, 1),
+        "round_ms": round(dt / rounds * 1e3, 2),
+        "zero_copy_pulls": zero_copy,
+        "total_pulls": rounds + 2,
+        "mbytes_payload": mbytes,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mbytes", type=float, default=8.0)
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--vans", default="tcp,uds,shm")
+    args = ap.parse_args()
+    for van in args.vans.split(","):
+        van = van.strip()
+        if van == "shm":
+            import platform
+
+            if platform.machine() not in ("x86_64", "AMD64", "i686"):
+                print(json.dumps({"van": van, "skipped": "needs x86-64 TSO"}))
+                continue
+        print(json.dumps(bench_van(van, args.mbytes, args.rounds)))
+
+
+if __name__ == "__main__":
+    main()
