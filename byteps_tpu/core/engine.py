@@ -232,7 +232,7 @@ class PipelineEngine:
         def on_first_init():
             self._maybe_setup_compression(ctx, np_dtype, flat.size * np_dtype.itemsize)
 
-        self._prepare_round(ctx, dtype_id, build_partitions, on_first_init)
+        self._prepare_round(ctx, dtype_id, flat.size, build_partitions, on_first_init)
         result = np.empty(flat.shape, dtype=np_dtype)
         job = _Job(
             name, ctx, flat, result, dtype_id, average, handle,
@@ -255,7 +255,8 @@ class PipelineEngine:
             )
             self.queues[QueueType.COPYD2H].add_task(task)
 
-    def _prepare_round(self, ctx, dtype_id, build_partitions, on_first_init=None):
+    def _prepare_round(self, ctx, dtype_id, n_elements, build_partitions,
+                       on_first_init=None):
         """Shared per-submit bookkeeping for dense AND row-sparse paths:
         run (or, after an elastic server resize, RE-run) the init-push
         barrier, then advance the version and seed the PUSH round gate.
@@ -274,6 +275,17 @@ class PipelineEngine:
           must start from its CURRENT version, not 1, or its tasks would
           never become eligible."""
         with self._init_lock:
+            if ctx.partitions:
+                declared = sum(p.length for p in ctx.partitions)
+                if declared != n_elements:
+                    # silent acceptance would scatter the new tensor into
+                    # stores sized for the old one — garbage sums
+                    raise ValueError(
+                        f"tensor {ctx.name!r} re-used with a different size: "
+                        f"declared {declared} elements, got {n_elements} "
+                        "(name-keyed tensors keep a stable shape; use a "
+                        "distinct name per tensor)"
+                    )
             gen = getattr(self.client, "server_generation", 0)
             if not ctx.initialized or ctx.server_generation != gen:
                 if not ctx.partitions:
@@ -330,7 +342,7 @@ class PipelineEngine:
                 )
             ]
 
-        self._prepare_round(ctx, dtype_id, build_partitions)
+        self._prepare_round(ctx, dtype_id, total_rows * row_len, build_partitions)
         key = ctx.partitions[0].key
 
         header = struct.pack("!II", nrows, row_len)
