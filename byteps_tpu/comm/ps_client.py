@@ -245,21 +245,23 @@ class PSClient:
                     book = json.loads(msg.payload.decode())
                     self.num_workers = book["num_workers"]
                     new_addrs = [tuple(s) for s in book["servers"]]
-                    if new_addrs != self._server_addrs:
-                        # token = book arrival order on THIS (single)
-                        # thread: rebuild threads acquire the lock in
-                        # arbitrary order, so staleness is decided by
-                        # token, not address equality
-                        self._book_token += 1
-                        # rebuild OFF this thread: connects can block/fail
-                        # and must neither stall scheduler callback
-                        # delivery nor kill this loop (→ _sched_dead)
-                        threading.Thread(
-                            target=self._rebuild_servers,
-                            args=(book["num_servers"], new_addrs,
-                                  self._book_token),
-                            daemon=True,
-                        ).start()
+                    # token = book arrival order on THIS (single) thread:
+                    # rebuild threads acquire the lock in arbitrary order,
+                    # so staleness is decided by token, not address
+                    # equality.  EVERY book spawns a rebuild — even one
+                    # matching the live set (a rollback can race a failed
+                    # rebuild's delayed retry; the no-op case is detected
+                    # under the rebuild lock, where it is atomic with any
+                    # in-flight apply).  Rebuild OFF this thread: connects
+                    # can block/fail and must neither stall scheduler
+                    # callback delivery nor kill this loop (→ _sched_dead)
+                    self._book_token += 1
+                    threading.Thread(
+                        target=self._rebuild_servers,
+                        args=(book["num_servers"], new_addrs,
+                              self._book_token),
+                        daemon=True,
+                    ).start()
                     continue
                 with self._sched_cb_lock:
                     entry = self._sched_cbs.pop(msg.seq, None)
@@ -280,7 +282,11 @@ class PSClient:
                 ev.set()
 
     def _rebuild_servers(
-        self, num_servers: int, new_addrs: List[tuple], token: int = 1 << 62
+        self,
+        num_servers: int,
+        new_addrs: List[tuple],
+        token: int = 1 << 62,
+        retry_delay: float = 2.0,
     ) -> None:
         """Adopt a resized server book live: connect to the new set, swap,
         then fail the old connections' in-flight requests (same path as a
@@ -295,8 +301,27 @@ class PSClient:
         with self._rebuild_lock:
             if token <= self._applied_token or self._stop.is_set():
                 return  # superseded by a newer book, or shutting down
+            if token < self._book_token:
+                # a newer book exists and ITS rebuild was spawned
+                # unconditionally — let it establish the truth; applying
+                # this older one would override the correct topology
+                return
+            if new_addrs == self._server_addrs:
+                # live set already matches this newest book (rollback
+                # racing a failed rebuild's retry): mark applied so older
+                # pending retries cancel, no reconnect churn
+                self.num_servers = num_servers
+                self._applied_token = token
+                return
             fresh: List[_ServerConn] = []
             for attempt in range(3):
+                if token < self._book_token:
+                    # superseded mid-rebuild: stop holding the lock through
+                    # further connect timeouts; the newer book's rebuild is
+                    # blocked on us and owns the truth
+                    for sc in fresh:
+                        close_socket(sc.sock)
+                    return
                 try:
                     for host, port in new_addrs[len(fresh):]:
                         sc = _ServerConn(host, port)
@@ -308,18 +333,38 @@ class PSClient:
                     break
                 except OSError as e:
                     if attempt == 2:
-                        # persistent: keep the current (stale) server set —
-                        # the control plane stays alive, and in-flight
-                        # failures surface per-request, not as a dead loop
+                        # persistent: keep the current (stale) server set for
+                        # now (the control plane stays alive, in-flight
+                        # failures surface per-request), but don't stay
+                        # desynced forever — RESIZE_SEQ books are broadcast
+                        # once, so schedule a delayed re-attempt of this same
+                        # book; a newer book supersedes it via the token check
                         from byteps_tpu.common import logging as bpslog
 
                         bpslog.warning(
-                            "server-resize rebuild failed after retries: %r", e
+                            "server-resize rebuild failed after retries: %r "
+                            "— retrying in %.0fs", e, retry_delay
                         )
                         for sc in fresh:
                             close_socket(sc.sock)
+
+                        def _retry():
+                            if self._stop.wait(retry_delay):
+                                return
+                            self._rebuild_servers(
+                                num_servers, new_addrs, token,
+                                min(retry_delay * 2, 30.0),
+                            )
+
+                        threading.Thread(target=_retry, daemon=True).start()
                         return
                     self._stop.wait(0.3 * (attempt + 1))
+            if token < self._book_token:
+                # a newer book arrived while we were blocked in connects;
+                # its unconditionally-spawned rebuild owns the truth
+                for sc in fresh:
+                    close_socket(sc.sock)
+                return
             old, self._servers = self._servers, fresh
             self._server_addrs = list(new_addrs)
             self.num_servers = num_servers

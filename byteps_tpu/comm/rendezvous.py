@@ -336,7 +336,10 @@ class Scheduler:
             "role": role,
             "rank": rank,
             "num_workers": self.num_workers,
-            "num_servers": self.num_servers,
+            # during a scale-up a new server can register before the
+            # resize-initiating worker: the book then already lists it, so
+            # num_servers must never undercount the list it ships with
+            "num_servers": max(self.num_servers, len(servers)),
             "servers": [(n.host, n.port) for n in servers],
             "is_recovery": recovery,
         }

@@ -42,13 +42,21 @@ LANES = 128
 
 
 def _dense_reference(q, k, v, causal, scale):
+    return _dense_reference_lse(q, k, v, causal, scale)[0]
+
+
+def _dense_reference_lse(q, k, v, causal, scale):
+    """Dense (out, lse) from ONE (s, s) score matrix — the lse fallback
+    must not materialize scores twice (round-3 advisor finding)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         qlen, klen = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((qlen, klen), bool))
         s = jnp.where(mask, s, NEG_INF)
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    s32 = s.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(s32, axis=-1)
+    p = jnp.exp(s32 - lse[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v), lse
 
 
 def _block_needed(causal: bool, qi, j, bq: int, bk: int):
@@ -413,12 +421,7 @@ def flash_attention_lse(
     bk = min(block_k, s)
     on_tpu = jax.devices()[0].platform == "tpu"
     if (s % bq or s % bk) or (not on_tpu and not interpret):
-        out = _dense_reference(q, k, v, causal, scale)
-        sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-        if causal:
-            mask = jnp.tril(jnp.ones((s, k.shape[2]), bool))
-            sc = jnp.where(mask, sc, NEG_INF)
-        return out, jax.scipy.special.logsumexp(sc.astype(jnp.float32), axis=-1)
+        return _dense_reference_lse(q, k, v, causal, scale)
     return _flash_lse(q, k, v, causal, scale, bq, bk, interpret)
 
 

@@ -10,13 +10,16 @@ from jax.sharding import Mesh
 
 
 def factorize_mesh(
-    n_devices: int, want: Sequence[str] = ("pp", "sp", "tp", "dp")
+    n_devices: int, want: Sequence[str] = ("dp",)
 ) -> Dict[str, int]:
     """Split ``n_devices`` into axis sizes, preferring to give each axis in
     ``want`` (priority order) a factor of 2 before growing any axis further.
 
-    E.g. 8 → {pp:2, sp:2, tp:2, dp:1}; 16 → {pp:2, sp:2, tp:2, dp:2};
-    4 → {pp:2, sp:2, tp:1, dp:1}; 1 → all 1.
+    The default is pure data parallelism (``{dp: n_devices}``): this is a
+    data-parallel framework first (the reference's only strategy, SURVEY
+    §2.7), so 8 chips with no explicit request should mean dp=8.  Callers
+    that want a multi-axis mesh pass ``want`` explicitly, e.g.
+    ``want=("dp", "tp", "sp", "pp")`` → 16 → {dp:2, tp:2, sp:2, pp:2}.
     """
     sizes = {ax: 1 for ax in want}
     remaining = n_devices
@@ -55,8 +58,7 @@ def make_training_mesh(
     n = n_devices or len(devices)
     devices = devices[:n]
     if axis_sizes is None:
-        axis_sizes = factorize_mesh(n)
-        axis_sizes.setdefault("dp", 1)
+        axis_sizes = factorize_mesh(n)  # default: pure dp ({dp: n})
     shape = [axis_sizes.get(ax, 1) for ax in axis_order]
     total = int(np.prod(shape))
     if total != n:

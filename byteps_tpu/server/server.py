@@ -88,7 +88,10 @@ class _KeyState:
         self.recv_count = 0
         self.store_version = 0
         self.pushed_total = 0
-        self.pending_pulls: List[Tuple[int, socket.socket, threading.Lock, int]] = []
+        # (version, conn, send_lock, seq, wants_compressed, rowsparse_req)
+        self.pending_pulls: List[
+            Tuple[int, socket.socket, threading.Lock, int, bool, Optional[bytes]]
+        ] = []
         self.init_waiters: List[Tuple[socket.socket, threading.Lock, int]] = []
         self.dtype: Optional[np.dtype] = None
         self.compressor_kwargs: Dict[str, str] = {}

@@ -8,6 +8,7 @@ the cluster is mid-training, not waiting (a deadlock fixed in round 1).
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -520,3 +521,57 @@ class TestEngineServerGenerationReinit:
         eng.submit("g.resize", x, average=False, priority=0, version=0, handle=3)
         assert client.inits == first * 2, "generation bump must re-init"
         get_registry().clear()
+
+
+class TestRebuildRetrySupersede:
+    def test_rollback_book_cancels_pending_rebuild_retry(self):
+        """A failed server-set rebuild schedules a delayed retry; if the
+        resize is then ROLLED BACK (a newer book matching the live set —
+        which spawns no rebuild), the retry must cancel instead of
+        applying the stale topology over the correct one."""
+        import socket as socket_mod
+
+        from byteps_tpu.comm.ps_client import PSClient
+
+        pc = PSClient.__new__(PSClient)
+        pc._stop = threading.Event()
+        pc._rebuild_lock = threading.Lock()
+        pc._applied_token = 0
+        pc._book_token = 0
+        pc._servers = []
+        pc._server_addrs = [("127.0.0.1", 1)]  # the "current" (old) set
+        pc.num_servers = 1
+        pc.server_generation = 0
+        pc.zero_copy_pulls = 0
+
+        # reserve a port and keep it CLOSED so the first rebuild fails
+        probe = socket_mod.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+
+        # token 1: resize book to the unreachable server → fails, retries
+        pc._book_token = 1
+        pc._rebuild_servers(1, [("127.0.0.1", port)], token=1)
+        assert pc._applied_token == 0 and pc._server_addrs == [("127.0.0.1", 1)]
+
+        # now the retry COULD succeed (server comes up)…
+        srv = socket_mod.socket()
+        srv.bind(("127.0.0.1", port))
+        srv.listen(4)
+        try:
+            # …but token 2 — a rollback book matching the live set —
+            # arrives first (the sched thread spawns a rebuild for EVERY
+            # book; the matching one marks applied without reconnecting)
+            pc._book_token = 2
+            pc._rebuild_servers(1, [("127.0.0.1", 1)], token=2)
+            assert pc._applied_token == 2
+            assert pc.server_generation == 0, "no-op book must not churn"
+
+            time.sleep(3.5)  # past the 2s retry window
+            assert pc._applied_token == 2, "stale retry must not apply"
+            assert pc._server_addrs == [("127.0.0.1", 1)]
+            assert pc.server_generation == 0
+        finally:
+            pc._stop.set()
+            srv.close()

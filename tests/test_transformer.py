@@ -49,11 +49,26 @@ def _run_steps(cfg, mesh, n_steps=3, batch=4, lr=0.1, seed=0):
 
 
 class TestMeshFactorization:
-    def test_factorize(self):
-        assert factorize_mesh(8) == {"pp": 2, "sp": 2, "tp": 2, "dp": 1}
-        assert factorize_mesh(16) == {"pp": 2, "sp": 2, "tp": 2, "dp": 2}
-        assert factorize_mesh(1) == {"pp": 1, "sp": 1, "tp": 1, "dp": 1}
-        assert factorize_mesh(4) == {"pp": 2, "sp": 2, "tp": 1, "dp": 1}
+    def test_factorize_default_is_pure_dp(self):
+        # a data-parallel framework's default mesh is all-dp (VERDICT r3 #7)
+        assert factorize_mesh(8) == {"dp": 8}
+        assert factorize_mesh(1) == {"dp": 1}
+
+    def test_factorize_multi_axis(self):
+        want = ("dp", "tp", "sp", "pp")
+        assert factorize_mesh(8, want) == {"dp": 2, "tp": 2, "sp": 2, "pp": 1}
+        assert factorize_mesh(16, want) == {"dp": 2, "tp": 2, "sp": 2, "pp": 2}
+        assert factorize_mesh(4, want) == {"dp": 2, "tp": 2, "sp": 1, "pp": 1}
+
+    def test_default_training_mesh_is_dp(self):
+        import jax
+
+        from byteps_tpu.parallel.mesh_utils import make_training_mesh
+
+        n = len(jax.devices())
+        mesh = make_training_mesh()
+        assert mesh.shape["dp"] == n
+        assert mesh.shape["tp"] == mesh.shape["pp"] == mesh.shape["sp"] == 1
 
 
 class TestRingAttention:
