@@ -53,6 +53,9 @@ class TransformerConfig:
     causal: bool = False  # BERT-style bidirectional by default
     moe: bool = False
     n_experts: int = 8
+    # experts per token: 2 = GShard-style with renormalized gates (the
+    # quality default), 1 = cheaper Switch-style routing
+    moe_top_k: int = 2
     capacity_factor: float = 2.0
     # capacity factor for GENERATION prefill.  None (default) = no-drop
     # serving capacity (cf = n_experts, capacity = token count): prompt
@@ -320,6 +323,7 @@ def _moe_block(cfg: TransformerConfig, x, lp, sp: int,
         axis_name="sp" if sp > 1 else None,
         axis_size=sp,
         capacity_factor=capacity_factor,
+        top_k=cfg.moe_top_k,
     ).reshape(b_, s_, d_)
     return x + y.astype(x.dtype), g
 

@@ -1,9 +1,11 @@
-"""Expert parallelism: dense top-1 MoE with all-to-all dispatch.
+"""Expert parallelism: dense top-k MoE with all-to-all dispatch.
 
 New scope beyond reference parity (SURVEY §2.7).  GShard-style dense
 formulation — routing is expressed as einsums with one-hot dispatch masks
 so everything is static-shaped for XLA, and tokens travel to their expert's
-rank via ``lax.all_to_all`` over the expert axis.
+rank via ``lax.all_to_all`` over the expert axis.  Top-2 (the GShard /
+Switch-paper default for quality) routes each token to its two best
+experts with renormalized gates; top-1 keeps the cheaper Switch behavior.
 
 Expert grouping follows DeepSpeed-MoE: the expert axis can be any mesh
 axis (we reuse ``sp`` in the default training mesh) — each rank in the
@@ -29,8 +31,9 @@ def moe_mlp(
     axis_name: Optional[str],
     axis_size: int,
     capacity_factor: float = 2.0,
+    top_k: int = 1,
 ) -> jax.Array:
-    """Top-1 routed expert MLP.
+    """Top-k routed expert MLP (k=1 Switch-style, k=2 GShard-style).
 
     x:        (T, D) local tokens (flattened batch*seq)
     router_w: (D, E) global router
@@ -43,21 +46,52 @@ def moe_mlp(
     t, d = x.shape
     e_local = w1.shape[0]
     e_total = e_local * max(1, axis_size)
+    top_k = max(1, min(top_k, e_total))
 
     logits = x @ router_w  # (T, E)
     gates = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(gates, axis=-1)  # (T,)
-    gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=-1)[:, 0]
 
-    capacity = max(1, int(capacity_factor * t / e_total))
-    onehot = jax.nn.one_hot(expert_idx, e_total, dtype=x.dtype)  # (T, E)
-    # position of each token within its expert's queue
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (T, E)
-    keep = (pos < capacity) * onehot  # drop overflow
-    pos_oh = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32), capacity, dtype=x.dtype)
-    # dispatch tensor: (T, E, C)
-    dispatch = keep[:, :, None] * pos_oh[:, None, :]
-    combine = dispatch * gate_val[:, None, None]
+    # per-expert queue slots scale with k (each token occupies k queues),
+    # but never beyond t: a token picks each expert at most once, so the
+    # no-drop bound stays t even for top-2 (prefill sizing relies on this)
+    capacity = max(1, min(int(capacity_factor * top_k * t / e_total), t))
+
+    # iterated argmax: choice i masks out choices < i (static unroll — k
+    # is a compile-time constant, so XLA sees straight-line einsum code).
+    # Bookkeeping masks/positions are float32 regardless of compute dtype:
+    # a bfloat16 cumsum is only exact to 256, and positions past that
+    # would collide queue slots and silently blend tokens.
+    masks, gate_vals = [], []
+    remaining = gates
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)  # (T,)
+        oh = jax.nn.one_hot(idx, e_total, dtype=jnp.float32)
+        masks.append(oh)
+        gate_vals.append(jnp.sum(gates * oh.astype(gates.dtype), axis=-1))
+        remaining = remaining * (1.0 - oh.astype(remaining.dtype))
+
+    if top_k > 1:
+        # GShard renormalization: the k selected gates sum to 1 per token
+        denom = sum(gate_vals) + 1e-9
+        weights = [gv / denom for gv in gate_vals]
+    else:
+        weights = gate_vals
+
+    # positions: choice-i tokens queue AFTER all choice-<i assignments of
+    # the same expert (GShard's locations2 = cumsum(mask2) + sum(mask1))
+    dispatch = jnp.zeros((t, e_total, capacity), x.dtype)
+    combine = jnp.zeros((t, e_total, capacity), x.dtype)
+    prev_counts = jnp.zeros((e_total,), jnp.float32)
+    for oh, wv in zip(masks, weights):
+        pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh + prev_counts[None, :] * oh
+        keep = (pos < capacity) * oh  # drop overflow
+        pos_oh = jax.nn.one_hot(
+            jnp.sum(pos, axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32
+        )
+        d_i = (keep[:, :, None] * pos_oh[:, None, :]).astype(x.dtype)  # (T, E, C)
+        dispatch = dispatch + d_i
+        combine = combine + d_i * wv[:, None, None]
+        prev_counts = prev_counts + jnp.sum(oh, axis=0)
 
     # gather tokens per expert slot: (E_total, C, D); global expert
     # e = rank*e_local + local_idx, so contiguous dim-0 chunks map to ranks

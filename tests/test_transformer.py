@@ -216,6 +216,93 @@ class TestMoE:
         losses, _ = _run_steps(cfg, _mesh(), n_steps=6, batch=4, lr=0.05)
         assert losses[-1] < losses[0]
 
+    def test_moe_top1_still_supported(self):
+        cfg = tiny_test(moe=True, n_experts=4, moe_top_k=1, causal=True)
+        losses, _ = _run_steps(cfg, _mesh(sp=2), n_steps=6, batch=4, lr=0.05)
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_moe_top2_full_capacity_is_gate_mixture(self):
+        """With E=2 experts and top_k=2 at no-drop capacity, every token
+        visits both experts and the output must equal the softmax-gated
+        mixture of the two expert MLPs (renormalized top-2 gates over 2
+        experts == the full softmax)."""
+        import jax.numpy as jnp
+
+        from byteps_tpu.parallel.moe import moe_mlp
+
+        rng = np.random.default_rng(7)
+        t, d, f, e = 10, 6, 12, 2
+        x = jnp.asarray(rng.normal(size=(t, d)), jnp.float32)
+        router = jnp.asarray(rng.normal(size=(d, e)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(e, d, f)) * 0.3, jnp.float32)
+        b1 = jnp.asarray(rng.normal(size=(e, f)) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(e, f, d)) * 0.3, jnp.float32)
+        b2 = jnp.asarray(rng.normal(size=(e, d)) * 0.1, jnp.float32)
+
+        y = moe_mlp(
+            x, router, w1, b1, w2, b2, axis_name=None, axis_size=1,
+            capacity_factor=float(e), top_k=2,
+        )
+
+        gates = np.asarray(jax.nn.softmax(x @ router, axis=-1))
+        expect = np.zeros((t, d), np.float32)
+        for ei in range(e):
+            h = np.asarray(jax.nn.gelu(x @ w1[ei] + b1[ei]))
+            out = h @ np.asarray(w2[ei]) + np.asarray(b2[ei])
+            expect += gates[:, ei : ei + 1] * out
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-4)
+
+    def test_moe_bf16_positions_exact_past_256(self):
+        """Queue positions must be exact beyond 256 even when the compute
+        dtype is bfloat16 (a bf16 cumsum saturates at 256 — collided
+        slots would silently blend tokens)."""
+        from byteps_tpu.parallel.moe import moe_mlp
+
+        rng = np.random.default_rng(3)
+        t, d, f, e = 320, 4, 8, 2
+        x32 = jnp.asarray(rng.normal(size=(t, d)), jnp.float32)
+        router = jnp.asarray(rng.normal(size=(d, e)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(e, d, f)) * 0.2, jnp.float32)
+        b1 = jnp.zeros((e, f), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(e, f, d)) * 0.2, jnp.float32)
+        b2 = jnp.zeros((e, d), jnp.float32)
+
+        def run(dt):
+            return np.asarray(
+                moe_mlp(
+                    x32.astype(dt), router.astype(dt), w1.astype(dt),
+                    b1.astype(dt), w2.astype(dt), b2.astype(dt),
+                    axis_name=None, axis_size=1,
+                    capacity_factor=float(e), top_k=2,
+                )
+            ).astype(np.float32)
+
+        y32, y16 = run(jnp.float32), run(jnp.bfloat16)
+        # bf16 arithmetic error is small per element; slot collisions
+        # (wrongly blended tokens) would blow far past this tolerance
+        np.testing.assert_allclose(y16, y32, rtol=0.15, atol=0.05)
+
+    def test_moe_top2_respects_capacity(self):
+        """Overflowing tokens of a saturated expert are dropped, never
+        written past the expert's queue (static shapes)."""
+        from byteps_tpu.parallel.moe import moe_mlp
+
+        rng = np.random.default_rng(0)
+        t, d, f, e = 16, 4, 8, 4
+        # router biased so one expert wins for every token
+        router = np.zeros((d, e), np.float32)
+        router[:, 0] = 10.0
+        x = jnp.asarray(np.abs(rng.normal(size=(t, d))), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(e, d, f)) * 0.3, jnp.float32)
+        b1 = jnp.zeros((e, f), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(e, f, d)) * 0.3, jnp.float32)
+        b2 = jnp.zeros((e, d), jnp.float32)
+        y = moe_mlp(
+            x, jnp.asarray(router), w1, b1, w2, b2, axis_name=None,
+            axis_size=1, capacity_factor=0.5, top_k=2,
+        )
+        assert np.isfinite(np.asarray(y)).all()
+
     def test_moe_cached_decode_matches_single(self):
         """KV-cached decode with MoE: experts sharded over sp, layers over
         pp, batch over dp — tokens must match the single-device cached
