@@ -90,7 +90,9 @@ def moe_mlp(
         )
         d_i = (keep[:, :, None] * pos_oh[:, None, :]).astype(x.dtype)  # (T, E, C)
         dispatch = dispatch + d_i
-        combine = combine + d_i * wv[:, None, None]
+        # wv cast to x.dtype: a float32 weight would silently promote the
+        # whole (T, E, C) combine tensor (gates need no exact bookkeeping)
+        combine = combine + d_i * wv.astype(x.dtype)[:, None, None]
         prev_counts = prev_counts + jnp.sum(oh, axis=0)
 
     # gather tokens per expert slot: (E_total, C, D); global expert
