@@ -81,6 +81,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bps_native_server_set_num_workers.restype = None
         lib.bps_native_server_stop.argtypes = [c.c_int32]
         lib.bps_native_server_stop.restype = None
+    if hasattr(lib, "bps_native_server_start_unix"):
+        lib.bps_native_server_start_unix.argtypes = [
+            c.c_char_p, c.c_int32, c.c_int32, c.c_int32,
+        ]
+        lib.bps_native_server_start_unix.restype = c.c_int32
     return lib
 
 
@@ -99,10 +104,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None  # corrupt/partial .so → pure-Python fallbacks
-    if not hasattr(lib, "bps_native_server_start") and autobuild:
-        # stale library from before ps_server.cc existed: rebuild, then
-        # load via a temp COPY — dlopen dedups by path/inode, so reloading
-        # the original path can hand back the old mapping
+    if not hasattr(lib, "bps_native_server_start_unix") and autobuild:
+        # stale library from before the newest server entry points: rebuild,
+        # then load via a temp COPY — dlopen dedups by path/inode, so
+        # reloading the original path can hand back the old mapping
         _try_build()
         try:
             import shutil
@@ -114,7 +119,7 @@ def _load() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy(_LIB_PATH, tmp.name)
             fresh = ctypes.CDLL(tmp.name)
-            if hasattr(fresh, "bps_native_server_start"):
+            if hasattr(fresh, "bps_native_server_start_unix"):
                 lib = fresh
         except OSError:
             pass

@@ -688,12 +688,10 @@ class NativePSServer:
         import os as _os
 
         van = _os.environ.get("BYTEPS_VAN", "tcp")
-        if van != "tcp":
-            # the C++ engine owns a TCP listener; silently ignoring the
-            # knob would run a different transport than the user asked for
+        if van not in ("tcp", "uds", "shm"):
             raise RuntimeError(
-                f"BYTEPS_VAN={van!r} is Python-server only; the native "
-                "engine (BYTEPS_SERVER_NATIVE=1) speaks framed TCP"
+                f"BYTEPS_VAN={van!r} unknown; native engine speaks "
+                "tcp | uds | shm"
             )
         from byteps_tpu.native import get_lib
 
@@ -703,11 +701,42 @@ class NativePSServer:
                 "native server requested but libbyteps_tpu.so unavailable "
                 "(make -C byteps_tpu/native)"
             )
+        if van != "tcp" and not hasattr(lib, "bps_native_server_start_unix"):
+            raise RuntimeError(
+                f"BYTEPS_VAN={van!r} needs a rebuilt native lib "
+                "(make -C byteps_tpu/native)"
+            )
         self._lib = lib
         self.cfg = cfg
-        self.host = host
-        self.port = lib.bps_native_server_start(0, cfg.num_worker, int(cfg.enable_async))
-        if self.port < 0:
+        self._uds_path: Optional[str] = None
+        if van == "tcp":
+            self.host = host
+            self.port = lib.bps_native_server_start(
+                0, cfg.num_worker, int(cfg.enable_async)
+            )
+            self._id = self.port
+        else:
+            # same published-address scheme as the Python server's vans:
+            # clients dial the right transport from the address alone
+            import tempfile
+            import uuid
+
+            from byteps_tpu.comm.van import SHM_PREFIX, UNIX_PREFIX, _check_shm_arch
+
+            if van == "shm":
+                _check_shm_arch()
+            base = _os.environ.get("BYTEPS_SOCKET_PATH", tempfile.gettempdir())
+            path = _os.path.join(
+                base, f"byteps_native_{_os.getpid()}_{uuid.uuid4().hex[:8]}.sock"
+            )
+            self._id = lib.bps_native_server_start_unix(
+                path.encode(), cfg.num_worker, int(cfg.enable_async),
+                int(van == "shm"),
+            )
+            self._uds_path = path
+            self.host = (SHM_PREFIX if van == "shm" else UNIX_PREFIX) + path
+            self.port = 0
+        if self._id < 0:
             raise RuntimeError("bps_native_server_start failed")
         self.rank: Optional[int] = None
         self.num_workers = cfg.num_worker
@@ -721,7 +750,7 @@ class NativePSServer:
         """Adopt a resized worker population in the C++ engine (the beat
         thread calls this on RESIZE_SEQ books, as for the Python server)."""
         self.num_workers = n
-        self._lib.bps_native_server_set_num_workers(self.port, n)
+        self._lib.bps_native_server_set_num_workers(self._id, n)
 
     def start(self, register: bool = True) -> None:
         if register:
@@ -729,11 +758,11 @@ class NativePSServer:
             PSServer._register_with_scheduler(self)  # type: ignore[arg-type]
             # the scheduler's address book wins over launch-time env
             # (PSServer adopts book["num_workers"]; mirror it in the engine)
-            self._lib.bps_native_server_set_num_workers(self.port, self.num_workers)
+            self._lib.bps_native_server_set_num_workers(self._id, self.num_workers)
 
     def stop(self) -> None:
         self._stop.set()
-        self._lib.bps_native_server_stop(self.port)
+        self._lib.bps_native_server_stop(self._id)
         close_socket(self._sched_conn)
 
 

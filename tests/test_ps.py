@@ -22,25 +22,33 @@ from byteps_tpu.comm.rendezvous import Scheduler
 from byteps_tpu.server.server import NativePSServer, PSServer
 
 
-@pytest.fixture(params=["python", "native", "python-uds", "python-shm"])
+@pytest.fixture(
+    params=[
+        "python", "native", "python-uds", "python-shm",
+        "native-uds", "native-shm",
+    ]
+)
 def fake_cluster(request, monkeypatch):
     """Scheduler + 1 server in-process; this process becomes the worker.
-    Parametrized over the Python server, the C++ native data plane, and
-    the Python server behind the UDS and shared-memory vans — every PS
-    test runs against all engine/transport combinations."""
-    if request.param == "native":
-        from byteps_tpu.native import HAVE_NATIVE
+    Parametrized over the full engine × transport matrix: the Python and
+    C++ engines each behind the tcp, uds, and shm vans — every PS test
+    runs against every combination (the native-shm column is the no-GIL
+    engine composed with the zero-copy transport, VERDICT r3 #3)."""
+    engine, _, van = request.param.partition("-")
+    if engine == "native":
+        from byteps_tpu.native import HAVE_NATIVE, get_lib
 
         if not HAVE_NATIVE:
             pytest.skip("native lib not built")
-    if request.param == "python-uds":
-        monkeypatch.setenv("BYTEPS_VAN", "uds")
-    if request.param == "python-shm":
+        if van and not hasattr(get_lib(), "bps_native_server_start_unix"):
+            pytest.skip("native lib predates unix/shm listener")
+    if van == "shm":
         import platform
 
         if platform.machine() not in ("x86_64", "AMD64", "i686"):
             pytest.skip("shm van requires x86-64 (TSO store ordering)")
-        monkeypatch.setenv("BYTEPS_VAN", "shm")
+    if van:
+        monkeypatch.setenv("BYTEPS_VAN", van)
     sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
     sched.start()
     monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
@@ -50,7 +58,7 @@ def fake_cluster(request, monkeypatch):
     monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
 
     scfg = Config.from_env()
-    srv = NativePSServer(scfg) if request.param == "native" else PSServer(scfg)
+    srv = NativePSServer(scfg) if engine == "native" else PSServer(scfg)
     t = threading.Thread(target=srv.start, daemon=True)  # registration blocks on barrier
     t.start()
     yield {"scheduler": sched, "server": srv}

@@ -23,11 +23,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 import numpy as np
 
 
-def bench_van(van: str, mbytes: float, rounds: int) -> dict:
+def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python") -> dict:
     from byteps_tpu.common.config import Config
     from byteps_tpu.comm.ps_client import PSClient
     from byteps_tpu.comm.rendezvous import Scheduler
-    from byteps_tpu.server.server import PSServer
+    from byteps_tpu.server.server import NativePSServer, PSServer
 
     os.environ["BYTEPS_VAN"] = van
     sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
@@ -40,7 +40,7 @@ def bench_van(van: str, mbytes: float, rounds: int) -> dict:
         "BYTEPS_FORCE_DISTRIBUTED": "1",
     })
     cfg = Config.from_env()
-    srv = PSServer(cfg)
+    srv = NativePSServer(cfg) if engine == "native" else PSServer(cfg)
     threading.Thread(target=srv.start, daemon=True).start()
     client = PSClient(cfg, node_uid="vb")
     client.connect()
@@ -82,6 +82,7 @@ def bench_van(van: str, mbytes: float, rounds: int) -> dict:
     mb = 2 * mbytes * rounds
     return {
         "van": van,
+        "engine": engine,
         "mb_per_s": round(mb / dt, 1),
         "round_ms": round(dt / rounds * 1e3, 2),
         "zero_copy_pulls": zero_copy,
@@ -90,12 +91,98 @@ def bench_van(van: str, mbytes: float, rounds: int) -> dict:
     }
 
 
+def bench_raw_socket(mbytes: float, rounds: int) -> dict:
+    """Upper bound: the same payload ping-ponged over a bare loopback TCP
+    socket with no framing, demux, or KV logic — how much of the wire the
+    van's Python hot path keeps (VERDICT r3 #5)."""
+    import socket
+
+    n = int(mbytes * 1e6)
+    payload = bytearray(np.random.default_rng(0).bytes(n))
+    buf = bytearray(n)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def echo():
+        conn, _ = srv.accept()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        b = bytearray(n)
+        view = memoryview(b)
+        try:
+            while True:
+                got = 0
+                while got < n:
+                    r = conn.recv_into(view[got:], n - got)
+                    if not r:
+                        return
+                    got += r
+                conn.sendall(b)
+        except OSError:
+            return
+
+    t = threading.Thread(target=echo, daemon=True)
+    t.start()
+    cli = socket.create_connection(srv.getsockname())
+    cli.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    view = memoryview(buf)
+
+    def round_once():
+        cli.sendall(payload)
+        got = 0
+        while got < n:
+            r = cli.recv_into(view[got:], n - got)
+            if not r:
+                raise RuntimeError("raw echo died")
+            got += r
+
+    for _ in range(2):
+        round_once()
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        round_once()
+    dt = time.perf_counter() - t0
+    cli.close()
+    srv.close()
+    # memcpy bound for context (the shm van's theoretical ceiling)
+    a = np.frombuffer(bytes(payload), np.uint8).copy()
+    t0 = time.perf_counter()
+    for _ in range(10):
+        b = a.copy()
+    memcpy_mb_s = 10 * mbytes / (time.perf_counter() - t0)
+    del b
+    return {
+        "van": "raw-tcp-loopback",
+        "engine": "none",
+        "mb_per_s": round(2 * mbytes * rounds / dt, 1),
+        "round_ms": round(dt / rounds * 1e3, 2),
+        "mbytes_payload": mbytes,
+        "memcpy_mb_per_s": round(memcpy_mb_s, 1),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mbytes", type=float, default=8.0)
     ap.add_argument("--rounds", type=int, default=20)
     ap.add_argument("--vans", default="tcp,uds,shm")
+    ap.add_argument("--engines", default="python,native",
+                    help="server data planes to cross with the vans")
+    ap.add_argument("--raw", action="store_true",
+                    help="also measure the bare-socket upper bound")
     args = ap.parse_args()
+    if args.raw:
+        print(json.dumps(bench_raw_socket(args.mbytes, args.rounds)))
+    engines = [e.strip() for e in args.engines.split(",") if e.strip()]
+    native_unix = False
+    if "native" in engines:
+        from byteps_tpu.native import HAVE_NATIVE, get_lib
+
+        if not HAVE_NATIVE:
+            print(json.dumps({"engine": "native", "skipped": "lib not built"}))
+            engines = [e for e in engines if e != "native"]
+        else:
+            native_unix = hasattr(get_lib(), "bps_native_server_start_unix")
     for van in args.vans.split(","):
         van = van.strip()
         if van == "shm":
@@ -104,7 +191,14 @@ def main() -> None:
             if platform.machine() not in ("x86_64", "AMD64", "i686"):
                 print(json.dumps({"van": van, "skipped": "needs x86-64 TSO"}))
                 continue
-        print(json.dumps(bench_van(van, args.mbytes, args.rounds)))
+        for engine in engines:
+            if engine == "native" and van != "tcp" and not native_unix:
+                print(json.dumps({
+                    "van": van, "engine": engine,
+                    "skipped": "stale native lib (no unix/shm listener)",
+                }))
+                continue
+            print(json.dumps(bench_van(van, args.mbytes, args.rounds, engine)))
 
 
 if __name__ == "__main__":
