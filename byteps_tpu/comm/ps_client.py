@@ -38,9 +38,25 @@ _ZERO_COPIED = object()
 
 
 class _ServerConn:
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(self, host: str, port: int, streams: int = 1) -> None:
         self.sock = connect(host, port)
         self.send_lock = threading.Lock()
+        # striped lanes (BYTEPS_TCP_STREAMS, tcp only): extra parallel
+        # connections to the same server, each framed message riding ONE
+        # lane chosen by key — per-key FIFO is preserved absolutely while
+        # distinct partitions fan out over independent kernel streams (the
+        # RDMA/UCX multi-lane van analogue, reference setup.py:312-330).
+        # Lane 0 doubles as the control lane (init/register/liveness).
+        self.stripes = [(self.sock, self.send_lock)]
+        if streams > 1 and not host.startswith(("unix://", "shm+unix://")):
+            try:
+                for _ in range(streams - 1):
+                    self.stripes.append((connect(host, port), threading.Lock()))
+            except (ConnectionError, OSError):
+                for sock, _ in self.stripes[1:]:
+                    close_socket(sock)
+                close_socket(self.sock)
+                raise
         self.cb_lock = threading.Lock()
         self.callbacks: Dict[int, Callable[[Message], None]] = {}
         #: seq → caller-owned buffer the response payload is received INTO
@@ -49,6 +65,17 @@ class _ServerConn:
         self.next_seq = 0
         self.recv_thread: Optional[threading.Thread] = None
         self.dead = False  # set once the recv loop exits; guarded by cb_lock
+
+    def stripe_for(self, key: int):
+        """(sock, send_lock) lane for a key — stable, so same-key requests
+        stay ordered on one stream even when pipelined (async mode)."""
+        return self.stripes[key % len(self.stripes)]
+
+    def close_all(self) -> None:
+        """Close every lane: one lane dying poisons the whole connection
+        (a partially-striped server link would strand keyed requests)."""
+        for sock, _ in self.stripes:
+            close_socket(sock)
 
     def alloc_seq(
         self,
@@ -160,11 +187,8 @@ class PSClient:
         self.is_recovery = book.get("is_recovery", False)
         self._server_addrs = [tuple(s) for s in book["servers"]]
         for host, port in self._server_addrs:
-            sc = _ServerConn(host, port)
-            sc.recv_thread = threading.Thread(
-                target=self._recv_loop, args=(sc,), daemon=True
-            )
-            sc.recv_thread.start()
+            sc = _ServerConn(host, port, streams=self.cfg.tcp_streams)
+            self._start_recv_loops(sc)
             self._servers.append(sc)
         # scheduler receiver for barrier responses
         t = threading.Thread(target=self._sched_recv_loop, daemon=True)
@@ -186,7 +210,7 @@ class PSClient:
     def close(self) -> None:
         self._stop.set()
         for sc in self._servers:
-            close_socket(sc.sock)
+            sc.close_all()
         close_socket(self._sched)
         self._servers = []
 
@@ -320,15 +344,12 @@ class PSClient:
                     # further connect timeouts; the newer book's rebuild is
                     # blocked on us and owns the truth
                     for sc in fresh:
-                        close_socket(sc.sock)
+                        sc.close_all()
                     return
                 try:
                     for host, port in new_addrs[len(fresh):]:
-                        sc = _ServerConn(host, port)
-                        sc.recv_thread = threading.Thread(
-                            target=self._recv_loop, args=(sc,), daemon=True
-                        )
-                        sc.recv_thread.start()
+                        sc = _ServerConn(host, port, streams=self.cfg.tcp_streams)
+                        self._start_recv_loops(sc)
                         fresh.append(sc)
                     break
                 except OSError as e:
@@ -346,7 +367,7 @@ class PSClient:
                             "— retrying in %.0fs", e, retry_delay
                         )
                         for sc in fresh:
-                            close_socket(sc.sock)
+                            sc.close_all()
 
                         def _retry():
                             if self._stop.wait(retry_delay):
@@ -363,7 +384,7 @@ class PSClient:
                 # a newer book arrived while we were blocked in connects;
                 # its unconditionally-spawned rebuild owns the truth
                 for sc in fresh:
-                    close_socket(sc.sock)
+                    sc.close_all()
                 return
             old, self._servers = self._servers, fresh
             self._server_addrs = list(new_addrs)
@@ -371,7 +392,7 @@ class PSClient:
             self.server_generation += 1
             self._applied_token = token
         for sc in old:
-            close_socket(sc.sock)  # recv loop exits → mark_dead fails pendings
+            sc.close_all()  # recv loops exit → mark_dead fails pendings
 
     @staticmethod
     def _blocking_request(sc: _ServerConn, make_msg, errmsg: str) -> Message:
@@ -394,14 +415,26 @@ class PSClient:
             raise ConnectionError(errmsg)
         return box[0]
 
-    def _recv_loop(self, sc: _ServerConn) -> None:
+    def _start_recv_loops(self, sc: _ServerConn) -> None:
+        """One receiver per lane; all lanes demux into the shared seq-keyed
+        callback table (responses come back on the lane that carried the
+        request — the server answers per-connection)."""
+        threads = [
+            threading.Thread(target=self._recv_loop, args=(sc, sock), daemon=True)
+            for sock, _ in sc.stripes
+        ]
+        sc.recv_thread = threads[0]
+        for t in threads:
+            t.start()
+
+    def _recv_loop(self, sc: _ServerConn, sock) -> None:
         from byteps_tpu.comm.transport import recv_header, recv_into
 
         try:
             while not self._stop.is_set():
                 try:
                     op, status, flags, seq, key, cmd, version, length = (
-                        recv_header(sc.sock)
+                        recv_header(sock)
                     )
                     # the callback is popped only AFTER the payload is
                     # fully received: dying mid-payload must leave it for
@@ -411,12 +444,12 @@ class PSClient:
                         # zero-copy: the aggregated payload lands directly
                         # in the caller's result buffer — no intermediate
                         # bytes object, no frombuffer+slice copy
-                        recv_into(sc.sock, sink)
+                        recv_into(sock, sink)
                         payload = _ZERO_COPIED
                         self.zero_copy_pulls += 1
                     else:
                         payload = (
-                            _recv_exact(sc.sock, length) if length else b""
+                            _recv_exact(sock, length) if length else b""
                         )
                 except (ConnectionError, OSError):
                     return
@@ -429,8 +462,11 @@ class PSClient:
                         )
                     )
         finally:
-            # a dead server connection must FAIL every pending request
-            # (cb(None)), not leave its callers blocked in synchronize()
+            # one lane dying poisons the whole striped connection: close
+            # every lane (wakes the sibling receivers) and FAIL every
+            # pending request (cb(None)) — callers must never hang in
+            # synchronize() on a half-dead link
+            sc.close_all()
             for cb in sc.mark_dead():
                 try:
                     cb(None)
@@ -511,8 +547,9 @@ class PSClient:
         )
         if seq < 0:  # connection died; on_error already fired
             return
+        sock, lock = sc.stripe_for(key)
         send_message(
-            sc.sock,
+            sock,
             Message(
                 Op.PUSH,
                 key=key,
@@ -521,7 +558,7 @@ class PSClient:
                 cmd=get_command_type(request_type, dtype_id),
                 version=version,
             ),
-            sc.send_lock,
+            lock,
         )
 
     def pull(
@@ -551,8 +588,9 @@ class PSClient:
         )
         if seq < 0:  # connection died; on_error already fired
             return
+        sock, lock = sc.stripe_for(key)
         send_message(
-            sc.sock,
+            sock,
             Message(
                 Op.PULL,
                 key=key,
@@ -561,7 +599,7 @@ class PSClient:
                 cmd=get_command_type(request_type, dtype_id),
                 version=version,
             ),
-            sc.send_lock,
+            lock,
         )
 
     def register_compressor(self, key: int, kwargs: Dict[str, str]) -> None:

@@ -84,6 +84,13 @@ class Config:
     # --- failure detection (ps-lite heartbeats, SURVEY §5.3) ---
     heartbeat_interval: float = 5.0  # BYTEPS_HEARTBEAT_INTERVAL; 0 disables
 
+    # --- transport (ps-lite van lanes) ---
+    # parallel TCP connections per server, partitions striped across them
+    # by key — the implementable analogue of the reference's RDMA/UCX
+    # multi-lane vans (setup.py:312-330) for DCN-class cross-host links
+    # where one stream cannot fill the pipe.  1 = single stream (default).
+    tcp_streams: int = 1  # BYTEPS_TCP_STREAMS
+
     # --- debug / trace (global.cc:113-124) ---
     log_level: str = "WARNING"
     trace_on: bool = False
@@ -148,6 +155,7 @@ class Config:
             heartbeat_interval=float(
                 os.environ.get("BYTEPS_HEARTBEAT_INTERVAL", "5") or "5"
             ),
+            tcp_streams=max(1, _env_int("BYTEPS_TCP_STREAMS", 1)),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),

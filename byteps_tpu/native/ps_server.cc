@@ -272,6 +272,7 @@ struct FdConn : Conn {
     uint8_t* p = (uint8_t*)buf;
     while (n) {
       ssize_t r = ::recv(fd, p, n, 0);
+      if (r < 0 && errno == EINTR) continue;  // signal, not a dead stream
       if (r <= 0) return false;
       p += r;
       n -= (size_t)r;
@@ -400,6 +401,7 @@ struct ShmConn : Conn {
     uint8_t* p = (uint8_t*)buf;
     while (n) {
       ssize_t r = ::recv(cfd, p, n, 0);
+      if (r < 0 && errno == EINTR) continue;  // signal, not a dead stream
       if (r <= 0) return false;
       p += r;
       n -= (size_t)r;

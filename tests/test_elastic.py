@@ -534,6 +534,7 @@ class TestRebuildRetrySupersede:
         from byteps_tpu.comm.ps_client import PSClient
 
         pc = PSClient.__new__(PSClient)
+        pc.cfg = Config.from_env()
         pc._stop = threading.Event()
         pc._rebuild_lock = threading.Lock()
         pc._applied_token = 0

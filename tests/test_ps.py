@@ -836,3 +836,94 @@ class TestZeroCopyVan:
         out = bps.push_pull(x, name="zc.mv", average=False)
         np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
         bps.shutdown()
+
+
+class TestStripedTcpVan:
+    """BYTEPS_TCP_STREAMS>1: partitions stripe across parallel TCP
+    connections per server (the multi-lane RDMA/UCX van analogue,
+    reference setup.py:312-330)."""
+
+    @pytest.mark.parametrize("server_kind", ["python", "native"])
+    def test_partitioned_multi_round_over_stripes(
+        self, server_kind, monkeypatch
+    ):
+        if server_kind == "native":
+            from byteps_tpu.native import HAVE_NATIVE
+
+            if not HAVE_NATIVE:
+                pytest.skip("native lib not built")
+        monkeypatch.setenv("BYTEPS_TCP_STREAMS", "4")
+        # small partitions → many keys → every lane carries traffic
+        monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "4096")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        scfg = Config.from_env()
+        srv = NativePSServer(scfg) if server_kind == "native" else PSServer(scfg)
+        threading.Thread(target=srv.start, daemon=True).start()
+        try:
+            import byteps_tpu as bps
+
+            bps.init()
+            assert bps.size() == 1
+            from byteps_tpu.core.state import get_state
+
+            client = get_state().ps_client
+            assert len(client._servers[0].stripes) == 4
+            import jax.numpy as jnp
+
+            x = np.arange(20000, dtype=np.float32)  # ~20 partitions
+            for r in range(3):
+                out = bps.push_pull(jnp.asarray(x) * (r + 1), name="g.striped")
+                np.testing.assert_allclose(np.asarray(out), x * (r + 1))
+            bps.shutdown()
+        finally:
+            srv.stop()
+            sched.stop()
+
+    def test_stripes_die_together(self, monkeypatch):
+        """Killing the server mid-flight must fail pending handles (not
+        hang) even with multiple lanes — one dead lane poisons all."""
+        monkeypatch.setenv("BYTEPS_TCP_STREAMS", "3")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        scfg = Config.from_env()
+        srv = PSServer(scfg)
+        threading.Thread(target=srv.start, daemon=True).start()
+        try:
+            from byteps_tpu.comm.ps_client import PSClient
+
+            client = PSClient(Config.from_env(), node_uid="striped-death")
+            client.connect()
+            sc = client._servers[0]
+            assert len(sc.stripes) == 3
+            client.init_tensor(7, 256, 0)
+            # kill ONE lane: its recv loop must poison the whole striped
+            # connection (close_all + mark_dead), not leave a half-dead
+            # link that strands keys hashed to the dead lane
+            from byteps_tpu.comm.transport import close_socket as _close
+
+            _close(sc.stripes[1][0])
+            deadline = time.monotonic() + 10
+            while not sc.dead and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert sc.dead, "one dead lane must mark the whole conn dead"
+            failed = threading.Event()
+            client.push(
+                7, np.zeros(256, np.float32).tobytes(), 0, 1,
+                cb=lambda *a: None, on_error=failed.set,
+            )
+            assert failed.wait(5), "push on dead conn must fail, not hang"
+            client.close()
+        finally:
+            srv.stop()
+            sched.stop()

@@ -23,13 +23,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 import numpy as np
 
 
-def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python") -> dict:
+def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python",
+              streams: int = 1, n_keys: int = 1) -> dict:
     from byteps_tpu.common.config import Config
     from byteps_tpu.comm.ps_client import PSClient
     from byteps_tpu.comm.rendezvous import Scheduler
     from byteps_tpu.server.server import NativePSServer, PSServer
 
     os.environ["BYTEPS_VAN"] = van
+    os.environ["BYTEPS_TCP_STREAMS"] = str(streams)
     sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
     sched.start()
     os.environ.update({
@@ -45,15 +47,20 @@ def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python") -> d
     client = PSClient(cfg, node_uid="vb")
     client.connect()
 
-    n = int(mbytes * 1e6) // 4
-    payload = np.random.default_rng(0).normal(size=n).astype(np.float32)
-    result = np.empty(n, dtype=np.float32)
-    sink = memoryview(result).cast("B")
-    client.init_tensor(1, n, 0)
+    n = int(mbytes * 1e6) // 4 // n_keys
+    keys = list(range(1, n_keys + 1))
+    payloads = {
+        k: np.random.default_rng(k).normal(size=n).astype(np.float32)
+        for k in keys
+    }
+    results = {k: np.empty(n, dtype=np.float32) for k in keys}
+    sinks = {k: memoryview(results[k]).cast("B") for k in keys}
+    for k in keys:
+        client.init_tensor(k, n, 0)
 
     def round_once(version: int) -> None:
         done = threading.Event()
-        state = [2]
+        state = [2 * len(keys)]
         lock = threading.Lock()
 
         def dec(*_a):
@@ -62,8 +69,10 @@ def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python") -> d
                 if state[0] == 0:
                     done.set()
 
-        client.push(1, payload.data.cast("B"), 0, version, cb=dec)
-        client.pull(1, version, dec, sink=sink)
+        for k in keys:
+            client.push(k, payloads[k].data.cast("B"), 0, version, cb=dec)
+        for k in keys:
+            client.pull(k, version, dec, sink=sinks[k])
         if not done.wait(60):
             raise RuntimeError(f"van {van} round timed out")
 
@@ -83,10 +92,12 @@ def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python") -> d
     return {
         "van": van,
         "engine": engine,
+        "streams": streams,
+        "keys": n_keys,
         "mb_per_s": round(mb / dt, 1),
         "round_ms": round(dt / rounds * 1e3, 2),
         "zero_copy_pulls": zero_copy,
-        "total_pulls": rounds + 2,
+        "total_pulls": (rounds + 2) * n_keys,
         "mbytes_payload": mbytes,
     }
 
@@ -170,6 +181,10 @@ def main() -> None:
                     help="server data planes to cross with the vans")
     ap.add_argument("--raw", action="store_true",
                     help="also measure the bare-socket upper bound")
+    ap.add_argument("--keys", type=int, default=1,
+                    help="split the payload across N keys")
+    ap.add_argument("--streams", default="1",
+                    help="comma list of BYTEPS_TCP_STREAMS values (tcp only)")
     args = ap.parse_args()
     if args.raw:
         print(json.dumps(bench_raw_socket(args.mbytes, args.rounds)))
@@ -191,6 +206,10 @@ def main() -> None:
             if platform.machine() not in ("x86_64", "AMD64", "i686"):
                 print(json.dumps({"van": van, "skipped": "needs x86-64 TSO"}))
                 continue
+        stream_counts = (
+            [int(s.strip()) for s in args.streams.split(",")]
+            if van == "tcp" else [1]
+        )
         for engine in engines:
             if engine == "native" and van != "tcp" and not native_unix:
                 print(json.dumps({
@@ -198,7 +217,11 @@ def main() -> None:
                     "skipped": "stale native lib (no unix/shm listener)",
                 }))
                 continue
-            print(json.dumps(bench_van(van, args.mbytes, args.rounds, engine)))
+            for streams in stream_counts:
+                print(json.dumps(bench_van(
+                    van, args.mbytes, args.rounds, engine,
+                    streams=streams, n_keys=args.keys,
+                )))
 
 
 if __name__ == "__main__":
