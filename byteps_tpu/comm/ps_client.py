@@ -47,8 +47,10 @@ class _ServerConn:
         # distinct partitions fan out over independent kernel streams (the
         # RDMA/UCX multi-lane van analogue, reference setup.py:312-330).
         # Lane 0 doubles as the control lane (init/register/liveness).
+        from byteps_tpu.comm.van import SHM_PREFIX, UNIX_PREFIX
+
         self.stripes = [(self.sock, self.send_lock)]
-        if streams > 1 and not host.startswith(("unix://", "shm+unix://")):
+        if streams > 1 and not host.startswith((UNIX_PREFIX, SHM_PREFIX)):
             try:
                 for _ in range(streams - 1):
                     self.stripes.append((connect(host, port), threading.Lock()))
@@ -64,7 +66,20 @@ class _ServerConn:
         self.sinks: Dict[int, memoryview] = {}
         self.next_seq = 0
         self.recv_thread: Optional[threading.Thread] = None
-        self.dead = False  # set once the recv loop exits; guarded by cb_lock
+        self.dead = False  # set once the LAST recv loop exits; cb_lock-guarded
+        # receiver loops still running; the last one to exit runs the
+        # mark_dead drain (see lane_exited)
+        self._live_lanes = len(self.stripes)
+
+    def lane_exited(self) -> bool:
+        """Account one receiver loop's exit; True when it was the last.
+        Only the LAST lane may drain callbacks: a sibling lane can still be
+        mid-recv_into, writing a response payload into a caller's
+        zero-copy sink — draining early would hand the caller a 'failed'
+        buffer another thread is still filling."""
+        with self.cb_lock:
+            self._live_lanes -= 1
+            return self._live_lanes <= 0
 
     def stripe_for(self, key: int):
         """(sock, send_lock) lane for a key — stable, so same-key requests
@@ -463,15 +478,17 @@ class PSClient:
                     )
         finally:
             # one lane dying poisons the whole striped connection: close
-            # every lane (wakes the sibling receivers) and FAIL every
-            # pending request (cb(None)) — callers must never hang in
-            # synchronize() on a half-dead link
+            # every lane (wakes the sibling receivers).  The DRAIN — fail
+            # every pending request with cb(None) so callers never hang in
+            # synchronize() — runs only on the LAST lane to exit: sibling
+            # receivers may still be writing into callers' zero-copy sinks
             sc.close_all()
-            for cb in sc.mark_dead():
-                try:
-                    cb(None)
-                except Exception:  # noqa: BLE001
-                    pass
+            if sc.lane_exited():
+                for cb in sc.mark_dead():
+                    try:
+                        cb(None)
+                    except Exception:  # noqa: BLE001
+                        pass
 
     # --- key routing -----------------------------------------------------
 

@@ -31,13 +31,11 @@ from __future__ import annotations
 
 import mmap
 import os
-import struct
 import tempfile
 import time
 import uuid
 
 _HDR = 64
-_U64 = struct.Struct("<Q")
 
 
 def _shm_dir() -> str:
@@ -82,13 +80,23 @@ class ShmRing:
             os.close(fd)
         self.capacity = total - _HDR
         self._view = memoryview(self._mm)
+        # Counter access MUST be a single 8-byte load/store: CPython's
+        # struct pack_into with a standard ('<Q') format writes the value
+        # BYTE BY BYTE, so a cross-process reader (incl. the C++ engine's
+        # atomic loads) can observe a torn intermediate counter, compute a
+        # wildly inflated avail/free, and run the ring off its own data
+        # (found as BAD MAGIC / zero-header desyncs under multi-worker
+        # load).  A native-format ('Q') cast memoryview stores via one
+        # 8-byte memcpy — a single aligned mov on x86-64, which the shm
+        # van already requires (little-endian, TSO).
+        self._ctr = self._view[:16].cast("Q")  # [0]=head, [1]=tail
 
     # -- counter accessors ------------------------------------------------
     def _head(self) -> int:
-        return _U64.unpack_from(self._mm, 0)[0]
+        return self._ctr[0]
 
     def _tail(self) -> int:
-        return _U64.unpack_from(self._mm, 8)[0]
+        return self._ctr[1]
 
     def _closed(self) -> bool:
         return self._mm[16] != 0
@@ -138,7 +146,7 @@ class ShmRing:
             try:
                 self._view[_HDR + pos : _HDR + pos + chunk] = src[off : off + chunk]
                 # publish AFTER the payload bytes are in place
-                _U64.pack_into(self._mm, 0, head + chunk)
+                self._ctr[0] = head + chunk
             except ValueError:
                 raise ConnectionError("shm ring closed") from None
             off += chunk
@@ -168,7 +176,7 @@ class ShmRing:
                 chunk = min(avail, want, self.capacity - pos)
                 try:
                     dst[:chunk] = self._view[_HDR + pos : _HDR + pos + chunk]
-                    _U64.pack_into(self._mm, 8, tail + chunk)
+                    self._ctr[1] = tail + chunk
                 except ValueError:
                     return 0
                 return chunk
@@ -189,6 +197,7 @@ class ShmRing:
     def close(self) -> None:
         self.mark_closed()
         try:
+            self._ctr.release()
             self._view.release()
             self._mm.close()
         except (BufferError, ValueError):

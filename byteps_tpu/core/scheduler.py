@@ -18,6 +18,7 @@ scheduling insight (OSDI'20 §4; mxnet/__init__.py:52-74).
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 from byteps_tpu.common.types import QueueType, TensorTableEntry
@@ -74,13 +75,23 @@ class ScheduledQueue:
         return True
 
     def get_task(self, timeout: Optional[float] = None) -> Optional[TensorTableEntry]:
-        """Pop the highest-priority eligible task; None on timeout."""
+        """Pop the highest-priority eligible task; None on timeout.
+
+        Re-waits the remaining budget after a wakeup that finds nothing
+        eligible (spurious, or an ineligible add) — a single wait would
+        hand the stage loop a None and cost a full idle poll tick."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
-            task = self._pop_eligible()
-            if task is not None:
-                return task
-            self._cv.wait(timeout)
-            return self._pop_eligible()
+            while True:
+                task = self._pop_eligible()
+                if task is not None:
+                    return task
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
 
     def _pop_eligible(self) -> Optional[TensorTableEntry]:
         for i, t in enumerate(self._tasks):

@@ -799,6 +799,7 @@ class NativeServer {
     while (!stop_.load()) {
       Header h;
       if (!conn->recv_exact(&h, sizeof(h)) || h.magic != kMagic) break;
+
       uint32_t seq = ntohl(h.seq);
       uint64_t key = be64toh(h.key);
       uint32_t cmd = ntohl(h.cmd);

@@ -150,9 +150,10 @@ class _EngineQueue:
             self._cv.notify()
 
     def get(self, timeout: Optional[float] = None):
+        # wait_for (not a single wait): a spurious wakeup must re-wait the
+        # remaining budget, not cost a whole idle poll tick of tail latency
         with self._cv:
-            if not self._heap:
-                self._cv.wait(timeout)
+            self._cv.wait_for(lambda: bool(self._heap), timeout)
             if not self._heap:
                 return None
             return heapq.heappop(self._heap)[2]

@@ -50,7 +50,9 @@ def run_round(client: PSClient, keys, payloads, version: int) -> None:
         client.push(key, payload, 0, version, cb=done)
     for key in keys:
         client.pull(key, version, done)
-    if not remaining.wait(60):
+    # generous: on the 1-core CI/dev box a stray jax-importing process can
+    # deschedule every subprocess for tens of seconds at once
+    if not remaining.wait(120):
         raise RuntimeError("round timed out")
 
 
@@ -198,15 +200,53 @@ def measure_multiproc(n_workers: int, n_servers: int, args) -> float:
             [_sys.executable, me, "--worker-role",
              "--keys", str(args.keys), "--mbytes", str(args.mbytes),
              "--rounds", str(args.rounds)],
-            env={**env, "DMLC_ROLE": "worker", "BYTEPS_NODE_UID": f"w{i}"},
+            env={**env, "DMLC_ROLE": "worker", "BYTEPS_NODE_UID": f"w{i}",
+                 "PYTHONFAULTHANDLER": "1"},
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for i in range(n_workers)
     ]
-    outs = [w.communicate(timeout=600)[0] for w in workers]
+    outs: list = []
+    hung = False
+    for w in workers:
+        try:
+            outs.append(w.communicate(timeout=600)[0])
+        except subprocess.TimeoutExpired:
+            # dump every live worker's Python stacks (faulthandler on
+            # SIGABRT) so a hang leaves a diagnosis, not a bare timeout
+            hung = True
+            import signal
+
+            for lw in workers[len(outs):]:
+                if lw.poll() is None:
+                    try:
+                        lw.send_signal(signal.SIGABRT)
+                    except OSError:
+                        pass
+            try:
+                outs.append(w.communicate(timeout=15)[0])
+            except subprocess.TimeoutExpired:
+                w.kill()
+                outs.append(w.communicate()[0])
     for s in servers:
         s.terminate()
+    for s in servers:
+        try:
+            s.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            s.kill()
     sched.stop()
+    if hung:
+        for lw in workers:
+            if lw.poll() is None:
+                lw.kill()
+        dumps = "\n\n".join(
+            f"--- worker {i} ---\n{(out or '')[-3000:]}"
+            for i, out in enumerate(outs)
+        )
+        raise RuntimeError(
+            f"scaling round hung at {n_workers} workers; stacks:\n{dumps}"
+        )
     medians = []
     for i, (w, out) in enumerate(zip(workers, outs)):
         if w.returncode != 0:
@@ -227,6 +267,8 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--native", action="store_true",
                     help="use the C++ server data plane")
+    ap.add_argument("--van", default="tcp", choices=["tcp", "uds", "shm"],
+                    help="transport van for the PS data plane")
     ap.add_argument("--multiproc", action="store_true",
                     help="worker/server subprocesses instead of threads "
                     "(real parallelism; the recorded-artifact mode)")
@@ -238,6 +280,7 @@ def main() -> None:
         worker_main(args)
         return
 
+    os.environ["BYTEPS_VAN"] = args.van
     worker_counts = [int(w) for w in args.workers.split(",")]
     per_worker = int(args.mbytes * 1e6)
     results = {}
@@ -266,6 +309,9 @@ def main() -> None:
         "unit": "ratio",
         "vs_baseline": round(retention[top] / 0.85, 4),  # >=85% north star
         "extra": {
+            "van": args.van,
+            "engine": "native" if args.native else "python",
+            "multiproc": bool(args.multiproc),
             "round_time_s": {str(n): round(t, 4) for n, t in results.items()},
             "aggregate_mb_per_s": {str(n): round(t, 2) for n, t in thr.items()},
             "retention": {str(n): round(e, 4) for n, e in retention.items()},
