@@ -17,6 +17,8 @@ tmpfs-backed, mirroring the reference's ``shm_open``):
     u64 head    @ 0   total bytes ever written (producer-owned)
     u64 tail    @ 8   total bytes ever read (consumer-owned)
     u8  closed  @ 16  either side sets 1 to tear down
+    u8  rd_park @ 17  consumer is parked waiting for data (doorbell me)
+    u8  wr_park @ 18  producer is parked waiting for space (doorbell me)
     pad to 64B        (cache-line separation of the counters)
     data        @ 64  capacity = file size − 64
 
@@ -25,6 +27,21 @@ connection lock).  Counters are monotonically increasing 8-byte aligned
 stores: on x86-64's TSO memory model the data-then-head publication
 order is preserved without fences, which is the same contract the
 reference's lock-free queues rely on.
+
+Stall handoff is doorbell-driven (virtio-style suppressed
+notifications): a side that finds the ring empty/full spins briefly,
+then sets its park flag and sleeps in select() on the van's CONTROL
+socket; the peer, after publishing a counter, checks the flag and —
+only when someone is parked — writes one doorbell byte to the control
+socket, waking the sleeper instantly.  The bulk path stays
+syscall-free; the park timeout (``_PARK_S``) is the backstop for two
+lossy cases, each costing one park tick, never a hang: (a) the TSO
+store→load race where both sides miss each other (producer:
+publish-then-read-flag; parker: set-flag-then-recheck — x86 allows
+both to see stale values), and (b) doorbell steal — both directions
+share one control socket, so when a process has a reader AND a writer
+parked at once, whichever drains the socket first can swallow the
+other's wakeup byte.
 """
 
 from __future__ import annotations
@@ -36,18 +53,14 @@ import time
 import uuid
 
 _HDR = 64
+#: park backstop: lost-doorbell worst case latency; 20Hz idle wake rate
+_PARK_S = 0.05
+#: brief pre-park spin: cheap for back-to-back traffic, avoids flag churn
+_SPINS = 10
 
 
 def _shm_dir() -> str:
     return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
-
-
-def _stall_cap(stalls: int) -> float:
-    """Backoff ceiling for ring waits: 1ms while traffic is recent (first
-    message after a pause pays ≤1ms), 10ms once the connection has been
-    idle a while (~100 stalls) so parked reader threads wake at ~100Hz,
-    not ~1kHz, per idle connection."""
-    return 1e-2 if stalls > 100 else 1e-3
 
 
 def create_ring_file(size: int, tag: str = "") -> str:
@@ -90,6 +103,9 @@ class ShmRing:
         # 8-byte memcpy — a single aligned mov on x86-64, which the shm
         # van already requires (little-endian, TSO).
         self._ctr = self._view[:16].cast("Q")  # [0]=head, [1]=tail
+        #: van-provided doorbell: one byte on the control socket to wake a
+        #: parked peer; None = fall back to sleep-polling (tests)
+        self.kick = None
 
     # -- counter accessors ------------------------------------------------
     def _head(self) -> int:
@@ -107,6 +123,43 @@ class ShmRing:
         except ValueError:  # already unmapped
             pass
 
+    def _peer_parked(self, flag_off: int) -> bool:
+        try:
+            return self._mm[flag_off] != 0
+        except ValueError:
+            return False
+
+    def _set_park(self, flag_off: int, value: int) -> None:
+        try:
+            self._mm[flag_off] = value
+        except ValueError:
+            pass
+
+    def _kick_peer(self, flag_off: int) -> None:
+        """Doorbell the peer if (and only if) it declared itself parked —
+        the common no-contention case stays syscall-free."""
+        if self.kick is not None and self._peer_parked(flag_off):
+            self.kick()
+
+    def _stall(self, flag_off: int, parked: bool, stalls: int, wait):
+        """One step of the park protocol shared by both ring directions:
+        spin (yield the CPU — producer and consumer may share a core),
+        then declare the park flag and recheck once, then sleep on the
+        control socket.  Returns (parked, alive); alive=False means the
+        wait saw the peer die."""
+        if stalls <= _SPINS:
+            os.sched_yield()
+            return parked, True
+        if not parked:
+            # park: declare it, RECHECK (the peer kicks only if it saw
+            # the flag), then sleep on the control socket
+            self._set_park(flag_off, 1)
+            return True, True
+        if wait is not None:
+            return parked, wait(_PARK_S)
+        time.sleep(_PARK_S)
+        return parked, True
+
     # -- producer side ----------------------------------------------------
     def write(self, data, wait=None) -> None:
         """Block until all of ``data`` is in the ring (socket sendall
@@ -120,36 +173,40 @@ class ShmRing:
             src = src.cast("B")
         off = 0
         n = src.nbytes
-        sleep = 2e-5
         stalls = 0
-        while off < n:
-            try:
-                head, tail = self._head(), self._tail()
-            except ValueError:  # our own side already closed/unmapped
-                raise ConnectionError("shm ring closed") from None
-            free = self.capacity - (head - tail)
-            if free == 0:
-                if self._closed():
-                    raise ConnectionError("shm ring peer closed")
-                if wait is not None:
-                    if not wait(sleep):
+        parked = False
+        try:
+            while off < n:
+                try:
+                    head, tail = self._head(), self._tail()
+                except ValueError:  # our own side already closed/unmapped
+                    raise ConnectionError("shm ring closed") from None
+                free = self.capacity - (head - tail)
+                if free == 0:
+                    if self._closed():
                         raise ConnectionError("shm ring peer closed")
-                else:
-                    time.sleep(sleep)
-                stalls += 1
-                sleep = min(sleep * 2, _stall_cap(stalls))
-                continue
-            sleep = 2e-5
-            stalls = 0
-            pos = head % self.capacity
-            chunk = min(free, n - off, self.capacity - pos)
-            try:
-                self._view[_HDR + pos : _HDR + pos + chunk] = src[off : off + chunk]
-                # publish AFTER the payload bytes are in place
-                self._ctr[0] = head + chunk
-            except ValueError:
-                raise ConnectionError("shm ring closed") from None
-            off += chunk
+                    stalls += 1
+                    parked, alive = self._stall(18, parked, stalls, wait)
+                    if not alive:
+                        raise ConnectionError("shm ring peer closed")
+                    continue
+                if parked:
+                    parked = False
+                    self._set_park(18, 0)
+                stalls = 0
+                pos = head % self.capacity
+                chunk = min(free, n - off, self.capacity - pos)
+                try:
+                    self._view[_HDR + pos : _HDR + pos + chunk] = src[off : off + chunk]
+                    # publish AFTER the payload bytes are in place
+                    self._ctr[0] = head + chunk
+                except ValueError:
+                    raise ConnectionError("shm ring closed") from None
+                off += chunk
+                self._kick_peer(17)  # wake a parked consumer
+        finally:
+            if parked:
+                self._set_park(18, 0)
         if self._closed():
             raise ConnectionError("shm ring peer closed")
 
@@ -162,37 +219,41 @@ class ShmRing:
         if dst.nbytes and dst.format != "B":
             dst = dst.cast("B")
         want = nbytes or dst.nbytes
-        sleep = 2e-5
         stalls = 0
         dead = False
-        while True:
-            try:
-                head, tail = self._head(), self._tail()
-            except ValueError:  # our own side already closed/unmapped
-                return 0
-            avail = head - tail
-            if avail:
-                pos = tail % self.capacity
-                chunk = min(avail, want, self.capacity - pos)
+        parked = False
+        try:
+            while True:
                 try:
-                    dst[:chunk] = self._view[_HDR + pos : _HDR + pos + chunk]
-                    self._ctr[1] = tail + chunk
-                except ValueError:
+                    head, tail = self._head(), self._tail()
+                except ValueError:  # our own side already closed/unmapped
                     return 0
-                return chunk
-            if dead:
-                return 0
-            if self._closed() or (wait is not None and not wait(sleep)):
-                # peer closed/died — but bytes may have landed between
-                # the avail check above and noticing the death; loop one
-                # more time so a final response written just before the
-                # peer exited is still delivered
-                dead = True
-                continue
-            if wait is None:
-                time.sleep(sleep)
-            stalls += 1
-            sleep = min(sleep * 2, _stall_cap(stalls))
+                avail = head - tail
+                if avail:
+                    if parked:
+                        parked = False
+                        self._set_park(17, 0)
+                    pos = tail % self.capacity
+                    chunk = min(avail, want, self.capacity - pos)
+                    try:
+                        dst[:chunk] = self._view[_HDR + pos : _HDR + pos + chunk]
+                        self._ctr[1] = tail + chunk
+                    except ValueError:
+                        return 0
+                    self._kick_peer(18)  # wake a producer parked on full
+                    return chunk
+                if dead:
+                    return 0
+                if self._closed():
+                    dead = True  # drain once more: a final response may
+                    continue     # have landed just before the peer exited
+                stalls += 1
+                parked, alive = self._stall(17, parked, stalls, wait)
+                if not alive:
+                    dead = True
+        finally:
+            if parked:
+                self._set_park(17, 0)
 
     def close(self) -> None:
         self.mark_closed()

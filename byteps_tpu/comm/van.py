@@ -111,6 +111,7 @@ class ShmConnection:
             assert tx is None and rx is None
         else:
             sock.setblocking(False)
+            tx.kick = rx.kick = self._kick
 
     def _ensure_handshake(self) -> None:
         if self._rx is not None:
@@ -141,21 +142,38 @@ class ShmConnection:
                 except OSError:
                     pass
             self._sock.setblocking(False)
+            tx.kick = rx.kick = self._kick
             self._tx, self._rx = tx, rx
 
-    def _peer_gone(self) -> bool:
+    def _kick(self) -> None:
+        """Doorbell: one byte on the control socket wakes the peer's
+        parked select() instantly (shm_ring.py park protocol).  A full
+        socket buffer or dead peer is fine — the first means wakeups are
+        already pending, the second is detected by the waiter."""
         try:
-            return self._sock.recv(1) == b""  # EOF: peer process exited
+            self._sock.send(b"\x01")
+        except (BlockingIOError, InterruptedError, OSError):
+            pass
+
+    def _peer_gone(self) -> bool:
+        """Drain every pending doorbell byte; True on EOF (peer exited)."""
+        try:
+            while True:
+                b = self._sock.recv(4096)
+                if b == b"":
+                    return True  # EOF: peer process exited
+                if len(b) < 4096:
+                    return False
         except (BlockingIOError, InterruptedError):
             return False
         except OSError:
             return True
 
     def _wait(self, timeout: float) -> bool:
-        """Ring stall wait: sleep in select() on the control socket so a
-        dead peer (kernel-closed fd → readable EOF) ends the wait at
-        once instead of on the next poll tick.  Returns False when the
-        peer is gone."""
+        """Ring park wait: sleep in select() on the control socket —
+        woken instantly by the peer's doorbell byte or by a dead peer
+        (kernel-closed fd → readable EOF).  Returns False when the peer
+        is gone."""
         import select
 
         try:

@@ -32,6 +32,7 @@
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <map>
@@ -41,6 +42,8 @@
 #include <thread>
 #include <tuple>
 #include <vector>
+
+#include "wire.h"
 
 // from reducer.cc / compressor.cc (same shared object)
 extern "C" {
@@ -62,27 +65,32 @@ int32_t bps_dithering_decompress(const uint8_t* in, int64_t n, int32_t s,
 
 namespace {
 
-constexpr uint8_t kMagic = 0xB5;
-enum Opcode : uint8_t {
-  kInit = 10,
-  kPush = 11,
-  kPull = 12,
-  kRegisterCompressor = 13,
-  kPing = 20,
-  kShutdown = 21,
-};
+// BYTEPS_NATIVE_DEBUG=1: stderr trace of connection lifecycle decisions
+// (handshake failures, desyncs, death detection) — the C++ analogue of
+// BYTEPS_SERVER_DEBUG on the Python engine.
+bool native_debug() {
+  static int v = [] {
+    const char* e = getenv("BYTEPS_NATIVE_DEBUG");
+    return (e && atoi(e) != 0) ? 1 : 0;
+  }();
+  return v != 0;
+}
+#define NDBG(...)                                  \
+  do {                                             \
+    if (native_debug()) {                          \
+      fprintf(stderr, "[byteps-native] " __VA_ARGS__); \
+      fputc('\n', stderr);                         \
+    }                                              \
+  } while (0)
 
-#pragma pack(push, 1)
-struct Header {
-  uint8_t magic, op, status, flags;
-  uint32_t seq;
-  uint64_t key;
-  uint32_t cmd;
-  uint32_t version;
-  uint64_t length;
-};
-#pragma pack(pop)
-static_assert(sizeof(Header) == 32, "header must be 32 bytes");
+using bps_wire::Header;
+using bps_wire::kMagic;
+using bps_wire::kInit;
+using bps_wire::kPush;
+using bps_wire::kPull;
+using bps_wire::kRegisterCompressor;
+using bps_wire::kPing;
+using bps_wire::kShutdown;
 
 int dtype_size(int32_t dt) {
   switch (dt) {
@@ -346,6 +354,15 @@ class ShmRing {
   bool mapped() const { return base_ != nullptr; }
   uint8_t* data() { return base_ + 64; }
   size_t cap() const { return cap_; }
+  // park flags (shm_ring.py doorbell protocol): @17 consumer parked,
+  // @18 producer parked; the publishing side doorbells the control
+  // socket only when the peer declared itself parked
+  bool peer_parked(int off) const {
+    return base_ && __atomic_load_n(base_ + off, __ATOMIC_ACQUIRE) != 0;
+  }
+  void set_park(int off, uint8_t v) {
+    if (base_) __atomic_store_n(base_ + off, v, __ATOMIC_RELEASE);
+  }
 
  private:
   uint8_t* base_ = nullptr;
@@ -382,16 +399,18 @@ struct ShmConn : Conn {
     std::string names[2];
     for (auto& name : names) {
       uint16_t ln_be;
-      if (!ctl_recv(&ln_be, 2)) return false;
+      if (!ctl_recv(&ln_be, 2)) { NDBG("shm handshake: len recv failed"); return false; }
       uint16_t ln = ntohs(ln_be);
-      if (ln == 0 || ln > 4096) return false;
+      if (ln == 0 || ln > 4096) { NDBG("shm handshake: bad name len %u", ln); return false; }
       name.resize(ln);
-      if (!ctl_recv(&name[0], ln)) return false;
+      if (!ctl_recv(&name[0], ln)) { NDBG("shm handshake: name recv failed"); return false; }
     }
     timeval tv0{0, 0};
     setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv0, sizeof(tv0));
-    if (!rx.open_path(names[0].c_str()) || !tx.open_path(names[1].c_str()))
+    if (!rx.open_path(names[0].c_str()) || !tx.open_path(names[1].c_str())) {
+      NDBG("shm handshake: ring open failed (%s / %s)", names[0].c_str(), names[1].c_str());
       return false;
+    }
     for (auto& name : names) ::unlink(name.c_str());
     ready.store(true, std::memory_order_release);
     return true;
@@ -409,47 +428,87 @@ struct ShmConn : Conn {
     return true;
   }
 
-  // Ring-stall wait: brief exponential nanosleep backoff (40µs → 1.28ms,
-  // the Python ring's active cadence — on a shared core the peer needs
-  // the CPU to make progress), then park in poll() on the control socket:
-  // a kernel wait that costs zero CPU per idle connection AND wakes
-  // instantly on peer death (EOF), with a 1ms→10ms tick bounding how
-  // late ring progress is noticed (shm_ring.py's _stall_cap cadence).
-  bool wait_stall(int& stalls) {
-    ++stalls;
-    if (stalls <= 6) {
-      timespec ts{0, 20'000L << stalls};  // 40µs … 1.28ms
-      nanosleep(&ts, nullptr);
-      return !dead.load();
-    }
+  // Doorbell: one byte on the control socket wakes the peer's parked
+  // select()/poll() instantly (shm_ring.py park protocol).  Failure is
+  // fine: a full buffer means wakeups are already pending, a dead peer
+  // is detected by the waiter.
+  void kick() {
+    char b = 1;
+    (void)::send(cfd, &b, 1, MSG_DONTWAIT | MSG_NOSIGNAL);
+  }
+
+  // Park on the control socket: woken by the peer's doorbell byte or by
+  // its death (EOF).  The 50ms timeout backstops the two lossy cases —
+  // the TSO publish-then-read-flag / set-flag-then-recheck race, and
+  // doorbell steal (both directions share one control socket, so when
+  // this process has a reader AND a writer parked at once, whichever
+  // drains the socket first can swallow the other's wakeup byte).  A
+  // lost doorbell costs one tick, not a hang.  Returns false when the
+  // peer is gone.
+  bool park_wait() {
     pollfd p{cfd, POLLIN, 0};
-    int r = ::poll(&p, 1, stalls > 100 ? 10 : 1);
+    int r = ::poll(&p, 1, 50);
     if (r > 0) {
-      char b;
-      ssize_t got = ::recv(cfd, &b, 1, MSG_DONTWAIT);
-      if (got == 0) return false;  // EOF: peer process exited
-      if (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return false;
+      char buf[4096];
+      for (;;) {  // drain every pending doorbell
+        ssize_t got = ::recv(cfd, buf, sizeof buf, MSG_DONTWAIT);
+        if (got == 0) { NDBG("park_wait: control EOF (peer exited)"); return false; }
+        if (got < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+            break;
+          NDBG("park_wait: control recv errno=%d", errno);
+          return false;
+        }
+        if (got < (ssize_t)sizeof buf) break;
+      }
     }
     return !dead.load();
+  }
+
+  // One stall step of the park protocol, shared by both ring directions
+  // (flag_off: our park flag — 17 consumer, 18 producer).  Spin-yield,
+  // then declare the flag and recheck once, then sleep on the control
+  // socket.  Returns false when the wait saw the peer die; the caller
+  // owns the exit action (recv drains once more, send fails).
+  bool stall_step(ShmRing& r, int flag_off, bool& parked, int& stalls) {
+    if (++stalls <= 10) {
+      sched_yield();  // back-to-back traffic lands within a few yields
+      return true;
+    }
+    if (!parked) {
+      parked = true;
+      r.set_park(flag_off, 1);
+      return true;  // one recheck with the flag visible to the peer
+    }
+    return park_wait();
   }
 
   bool recv_exact(void* buf, size_t n) override {
     if (!ensure_ready()) return false;
     uint8_t* p = (uint8_t*)buf;
-    bool dying = false;
+    bool dying = false, parked = false;
     int stalls = 0;
     while (n) {
       uint64_t head = rx.head(), tail = rx.tail();
       uint64_t avail = head - tail;
       if (avail == 0) {
-        if (dying) return false;
-        if (rx.closed() || dead.load() || !wait_stall(stalls)) {
+        if (dying) {
+          if (parked) rx.set_park(17, 0);
+          return false;
+        }
+        if (rx.closed() || dead.load()) {
           // peer closed/died — drain once more: bytes may have landed
           // between the avail check and noticing the death
+          NDBG("recv_exact: dying (closed=%d dead=%d)", (int)rx.closed(), (int)dead.load());
           dying = true;
+          continue;
         }
+        if (!stall_step(rx, 17, parked, stalls)) dying = true;
         continue;
+      }
+      if (parked) {
+        parked = false;
+        rx.set_park(17, 0);
       }
       stalls = 0;
       size_t pos = (size_t)(tail % rx.cap());
@@ -457,6 +516,7 @@ struct ShmConn : Conn {
                                         rx.cap() - pos);
       std::memcpy(p, rx.data() + pos, chunk);
       rx.publish_tail(tail + chunk);
+      if (rx.peer_parked(18)) kick();  // wake a producer parked on full
       p += chunk;
       n -= chunk;
     }
@@ -466,13 +526,26 @@ struct ShmConn : Conn {
   bool send_all(const void* buf, size_t n) override {
     if (!ensure_ready()) return false;
     const uint8_t* p = (const uint8_t*)buf;
+    bool parked = false;
     int stalls = 0;
     while (n) {
       uint64_t head = tx.head(), tail = tx.tail();
       uint64_t free_b = tx.cap() - (head - tail);
       if (free_b == 0) {
-        if (tx.closed() || dead.load() || !wait_stall(stalls)) return false;
+        if (tx.closed() || dead.load()) {
+          NDBG("send_all: fail (closed=%d dead=%d)", (int)tx.closed(), (int)dead.load());
+          if (parked) tx.set_park(18, 0);
+          return false;
+        }
+        if (!stall_step(tx, 18, parked, stalls)) {
+          tx.set_park(18, 0);
+          return false;
+        }
         continue;
+      }
+      if (parked) {
+        parked = false;
+        tx.set_park(18, 0);
       }
       stalls = 0;
       size_t pos = (size_t)(head % tx.cap());
@@ -480,6 +553,7 @@ struct ShmConn : Conn {
                                         tx.cap() - pos);
       std::memcpy(tx.data() + pos, p, chunk);
       tx.publish_head(head + chunk);  // release: payload visible first
+      if (tx.peer_parked(17)) kick();  // wake a parked consumer
       p += chunk;
       n -= chunk;
     }
@@ -798,7 +872,8 @@ class NativeServer {
     std::vector<uint8_t> payload;
     while (!stop_.load()) {
       Header h;
-      if (!conn->recv_exact(&h, sizeof(h)) || h.magic != kMagic) break;
+      if (!conn->recv_exact(&h, sizeof(h))) { NDBG("serve: header recv failed"); break; }
+      if (h.magic != kMagic) { NDBG("serve: BAD MAGIC 0x%02x (desync)", h.magic); break; }
 
       uint32_t seq = ntohl(h.seq);
       uint64_t key = be64toh(h.key);

@@ -1,0 +1,38 @@
+// Shared wire definitions for the framed PS protocol — single source of
+// truth for the C++ server (ps_server.cc) and worker client
+// (ps_client.cc).  Must stay byte-compatible with the Python framing in
+// byteps_tpu/comm/transport.py: 32-byte big-endian header + raw payload.
+#ifndef BYTEPS_TPU_NATIVE_WIRE_H_
+#define BYTEPS_TPU_NATIVE_WIRE_H_
+
+#include <cstdint>
+
+namespace bps_wire {
+
+constexpr uint8_t kMagic = 0xB5;
+
+// transport.py Op enum (data-plane subset the native code speaks)
+enum Opcode : uint8_t {
+  kInit = 10,
+  kPush = 11,
+  kPull = 12,
+  kRegisterCompressor = 13,
+  kPing = 20,
+  kShutdown = 21,
+};
+
+#pragma pack(push, 1)
+struct Header {
+  uint8_t magic, op, status, flags;
+  uint32_t seq;      // network order on the wire
+  uint64_t key;      // network order on the wire
+  uint32_t cmd;      // Cantor-encoded (RequestType, DataType)
+  uint32_t version;  // round / generation
+  uint64_t length;   // payload byte count
+};
+#pragma pack(pop)
+static_assert(sizeof(Header) == 32, "wire header must be 32 bytes");
+
+}  // namespace bps_wire
+
+#endif  // BYTEPS_TPU_NATIVE_WIRE_H_
