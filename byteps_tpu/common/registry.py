@@ -42,6 +42,11 @@ class TensorContext:
     # barrier against; a mismatch (elastic server resize) re-inits the
     # key on its new owning server before the next use
     server_generation: int = 0
+    # Engine instance that last ran this ctx's init barrier: the registry
+    # outlives shutdown()/init() cycles but each init() starts servers
+    # with fresh stores, so a ctx from a previous engine must re-init
+    # (-1 = never)
+    engine_epoch: int = -1
 
     @property
     def base_key(self) -> int:

@@ -22,6 +22,7 @@ scheduling core idea.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Dict, List
@@ -101,11 +102,19 @@ class PipelineEngine:
         QueueType.PULL, QueueType.DECOMPRESS, QueueType.COPYH2D,
     ]
 
+    #: monotonically increasing engine-instance id: the tensor registry
+    #: (and each ctx's ``initialized`` flag) outlives shutdown()/init()
+    #: cycles, but servers started by a LATER init() have fresh stores —
+    #: a ctx initialized under a previous engine must re-run its
+    #: init-push barrier, exactly like an elastic server resize
+    _epoch_counter = itertools.count()
+
     def __init__(self, cfg: Config, ps_client, telemetry=None, tracer=None) -> None:
         self.cfg = cfg
         self.client = ps_client
         self.telemetry = telemetry
         self.tracer = tracer
+        self._epoch = next(PipelineEngine._epoch_counter)
         self._stop = threading.Event()
         credit = cfg.scheduling_credit
         pool = max(1, cfg.threadpool_size)
@@ -287,7 +296,13 @@ class PipelineEngine:
                         "distinct name per tensor)"
                     )
             gen = getattr(self.client, "server_generation", 0)
-            if not ctx.initialized or ctx.server_generation != gen:
+            if (not ctx.initialized or ctx.server_generation != gen
+                    or ctx.engine_epoch != self._epoch):
+                # engine_epoch mismatch: the registry survived a
+                # shutdown()/init() cycle but this engine's servers are
+                # new (fresh stores) — re-run the init barrier exactly
+                # like a server resize, or the first push would hit an
+                # uninitialized key and the server would drop the conn
                 if not ctx.partitions:
                     build_partitions(ctx)
                 for part in ctx.partitions:
@@ -301,6 +316,7 @@ class PipelineEngine:
                     on_first_init()
                 ctx.initialized = True
                 ctx.server_generation = gen
+                ctx.engine_epoch = self._epoch
             ctx.version += 1
             for part in ctx.partitions:
                 if part.key not in self._seeded:
