@@ -18,6 +18,8 @@ import socket
 import threading
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from byteps_tpu.common.config import Config
 from byteps_tpu.common.hashing import assign_server
 from byteps_tpu.common.types import RequestType, get_command_type
@@ -135,6 +137,136 @@ class _ServerConn:
             self.sinks.clear()
             return cbs
 
+    def send_msg(self, msg: Message) -> None:
+        """Frame + send on the key's lane (per-key FIFO across stripes)."""
+        sock, lock = self.stripe_for(msg.key)
+        send_message(sock, msg, lock)
+
+
+class _NativeServerConn:
+    """C++ data-plane lanes behind the same surface as ``_ServerConn``.
+
+    Framing, striping, seq demux, and payload receive — including
+    zero-copy pull-into-caller-buffer — run on GIL-free native threads
+    (native/ps_client.cc; the worker-plane split of core_loops.cc:
+    538-618).  Python runs only per-completion callbacks.  Selected by
+    ``BYTEPS_NATIVE_CLIENT=1`` for tcp/uds links; the shm van keeps the
+    Python client (its bulk path is already syscall-free mmap memcpy).
+
+    Locking: ``alloc_seq`` registers the Python callback under
+    ``_lock`` in the same critical section as the native alloc, and the
+    completion hook pops under the same lock — a drain racing a fresh
+    alloc blocks until the callback is registered, so no completion can
+    ever miss its callback."""
+
+    def __init__(self, host: str, port: int, streams: int = 1,
+                 on_zero_copy=None) -> None:
+        import ctypes
+
+        from byteps_tpu.comm.van import UNIX_PREFIX
+        from byteps_tpu.native import BPSC_CALLBACK, get_lib
+
+        lib = get_lib()
+        if lib is None or not hasattr(lib, "bpsc_create"):
+            raise ConnectionError("native client library unavailable")
+        kind = 1 if host.startswith(UNIX_PREFIX) else 0
+        addr = host[len(UNIX_PREFIX):] if kind else host
+        self._lib = lib
+        self._ct = ctypes
+        self._lock = threading.Lock()
+        self._cbs: Dict[int, tuple] = {}  # seq → (cb, sink keep-alive)
+        self.dead = False
+        self._on_zero_copy = on_zero_copy
+        h = lib.bpsc_create(addr.encode(), port, kind, streams)
+        if h < 0:
+            raise ConnectionError(
+                f"native client connect failed: {host}:{port}"
+            )
+        self._h: Optional[int] = h
+        # the CFUNCTYPE object must outlive the native lanes or the
+        # trampoline is freed under a live C thread
+        self._c_cb = BPSC_CALLBACK(self._on_msg)
+        lib.bpsc_set_cb(h, self._c_cb, None)
+
+    def _on_msg(self, _ctx, op, status, flags, seq, key, cmd, version,
+                payload, length, zero_copied) -> None:
+        with self._lock:
+            if op < 0:  # drain: the connection died with this seq pending
+                self.dead = True
+            entry = self._cbs.pop(seq, None)
+        if entry is None:
+            return
+        cb = entry[0]
+        try:
+            if op < 0:
+                cb(None)
+                return
+            if zero_copied:
+                body = _ZERO_COPIED
+                if self._on_zero_copy is not None:
+                    self._on_zero_copy()
+            elif length:
+                body = self._ct.string_at(payload, length)
+            else:
+                body = b""
+            cb(Message(Op(op), key=key, payload=body, seq=seq, cmd=cmd,
+                       version=version, status=status, flags=flags))
+        except Exception:  # noqa: BLE001 — never unwind into the C lane
+            pass
+
+    def alloc_seq(self, cb, sink: Optional[memoryview] = None) -> int:
+        sink_ptr, sink_len, keep = None, 0, None
+        if sink is not None:
+            # export the caller's writable buffer; the native lane
+            # receives the response payload straight into it
+            keep = (self._ct.c_ubyte * len(sink)).from_buffer(sink)
+            sink_ptr = self._ct.addressof(keep)
+            sink_len = len(sink)
+        with self._lock:
+            if not self.dead and self._h is not None:
+                seq = self._lib.bpsc_alloc_seq(self._h, sink_ptr, sink_len)
+                if seq >= 0:
+                    self._cbs[seq] = (cb, keep)
+                    return seq
+        cb(None)  # outside the lock: callbacks run user code
+        return -1
+
+    def send_msg(self, msg: Message) -> None:
+        payload = msg.payload or b""
+        n = len(payload)
+        ptr = None
+        if n:
+            # no-copy pointer for bytes / bytearray / memoryview /
+            # ndarray payloads alike; arr keeps the buffer alive for the
+            # duration of the (synchronous) native send
+            arr = np.frombuffer(payload, dtype=np.uint8)
+            ptr = arr.ctypes.data
+        with self._lock:
+            h = self._h
+        if h is None:
+            raise ConnectionError("native connection closed")
+        rc = self._lib.bpsc_send(
+            h, int(msg.op), msg.seq, msg.key, msg.cmd, msg.version,
+            msg.flags, ptr, n,
+        )
+        if rc != 0:
+            raise ConnectionError("server connection lost (native send)")
+
+    def pop_cb(self, seq: int):
+        with self._lock:
+            entry = self._cbs.pop(seq, None)
+        return entry[0] if entry is not None else None
+
+    def close_all(self) -> None:
+        with self._lock:
+            h, self._h = self._h, None
+        if h is not None:
+            # joins the native lanes; their drain fires pending callbacks
+            # (cb(None)) before the join returns
+            self._lib.bpsc_close(h)
+        with self._lock:
+            self.dead = True
+
 
 class PSClient:
     def __init__(self, cfg: Config, node_uid: Optional[str] = None) -> None:
@@ -202,9 +334,7 @@ class PSClient:
         self.is_recovery = book.get("is_recovery", False)
         self._server_addrs = [tuple(s) for s in book["servers"]]
         for host, port in self._server_addrs:
-            sc = _ServerConn(host, port, streams=self.cfg.tcp_streams)
-            self._start_recv_loops(sc)
-            self._servers.append(sc)
+            self._servers.append(self._new_conn(host, port))
         # scheduler receiver for barrier responses
         t = threading.Thread(target=self._sched_recv_loop, daemon=True)
         t.start()
@@ -363,9 +493,7 @@ class PSClient:
                     return
                 try:
                     for host, port in new_addrs[len(fresh):]:
-                        sc = _ServerConn(host, port, streams=self.cfg.tcp_streams)
-                        self._start_recv_loops(sc)
-                        fresh.append(sc)
+                        fresh.append(self._new_conn(host, port))
                     break
                 except OSError as e:
                     if attempt == 2:
@@ -409,8 +537,31 @@ class PSClient:
         for sc in old:
             sc.close_all()  # recv loops exit → mark_dead fails pendings
 
+    def _new_conn(self, host: str, port: int):
+        """Build a server connection: the C++ data plane when
+        BYTEPS_NATIVE_CLIENT=1 and the lib speaks it (tcp/uds only —
+        the shm van's Python client is already zero-copy), else the
+        Python lanes + recv threads."""
+        from byteps_tpu.comm.van import SHM_PREFIX
+
+        if self.cfg.native_client and not host.startswith(SHM_PREFIX):
+            from byteps_tpu.native import get_lib
+
+            lib = get_lib()
+            if lib is not None and hasattr(lib, "bpsc_create"):
+                return _NativeServerConn(
+                    host, port, streams=self.cfg.tcp_streams,
+                    on_zero_copy=self._count_zero_copy,
+                )
+        sc = _ServerConn(host, port, streams=self.cfg.tcp_streams)
+        self._start_recv_loops(sc)
+        return sc
+
+    def _count_zero_copy(self) -> None:
+        self.zero_copy_pulls += 1
+
     @staticmethod
-    def _blocking_request(sc: _ServerConn, make_msg, errmsg: str) -> Message:
+    def _blocking_request(sc, make_msg, errmsg: str) -> Message:
         """Send one server request and block for its ack; raises
         ConnectionError if the connection is dead or dies while waiting
         (the alloc_seq dead-path fires the callback with None)."""
@@ -419,7 +570,7 @@ class PSClient:
         seq = sc.alloc_seq(lambda msg: (box.append(msg), done.set()))
         if seq >= 0:
             try:
-                send_message(sc.sock, make_msg(seq), sc.send_lock)
+                sc.send_msg(make_msg(seq))
             except OSError:
                 # connection died between alloc_seq and send: callers see
                 # the same ConnectionError as the dead-connection path
@@ -564,9 +715,7 @@ class PSClient:
         )
         if seq < 0:  # connection died; on_error already fired
             return
-        sock, lock = sc.stripe_for(key)
-        send_message(
-            sock,
+        sc.send_msg(
             Message(
                 Op.PUSH,
                 key=key,
@@ -574,8 +723,7 @@ class PSClient:
                 payload=payload,
                 cmd=get_command_type(request_type, dtype_id),
                 version=version,
-            ),
-            lock,
+            )
         )
 
     def pull(
@@ -605,9 +753,7 @@ class PSClient:
         )
         if seq < 0:  # connection died; on_error already fired
             return
-        sock, lock = sc.stripe_for(key)
-        send_message(
-            sock,
+        sc.send_msg(
             Message(
                 Op.PULL,
                 key=key,
@@ -615,8 +761,7 @@ class PSClient:
                 payload=payload,
                 cmd=get_command_type(request_type, dtype_id),
                 version=version,
-            ),
-            lock,
+            )
         )
 
     def register_compressor(self, key: int, kwargs: Dict[str, str]) -> None:
@@ -649,10 +794,8 @@ class PSClient:
                 seq = sc.alloc_seq(lambda msg: None)
                 if seq < 0:
                     continue  # dead server already handled by the data path
-                send_message(
-                    sc.sock,
-                    Message(Op.REGISTER_COMPRESSOR, seq=seq, payload=payload, flags=1),
-                    sc.send_lock,
+                sc.send_msg(
+                    Message(Op.REGISTER_COMPRESSOR, seq=seq, payload=payload, flags=1)
                 )
             except (ConnectionError, OSError):
                 continue  # dead server already handled by the data path

@@ -90,6 +90,11 @@ class Config:
     # multi-lane vans (setup.py:312-330) for DCN-class cross-host links
     # where one stream cannot fill the pipe.  1 = single stream (default).
     tcp_streams: int = 1  # BYTEPS_TCP_STREAMS
+    # C++ worker data plane (native/ps_client.cc): framing, demux, and
+    # payload receive on GIL-free lane threads — the core_loops.cc:538-618
+    # analogue.  Applies to tcp/uds server links when the native lib is
+    # built; the shm van keeps the Python client (mmap bulk path).
+    native_client: bool = False  # BYTEPS_NATIVE_CLIENT
 
     # --- debug / trace (global.cc:113-124) ---
     log_level: str = "WARNING"
@@ -156,6 +161,7 @@ class Config:
                 os.environ.get("BYTEPS_HEARTBEAT_INTERVAL", "5") or "5"
             ),
             tcp_streams=max(1, _env_int("BYTEPS_TCP_STREAMS", 1)),
+            native_client=_env_bool("BYTEPS_NATIVE_CLIENT"),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),

@@ -20,6 +20,16 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libbyteps_tpu.so")
 
+#: completion-callback signature of the native worker client
+#: (ps_client.cc bpsc_cb_t): (ctx, op, status, flags, seq, key, cmd,
+#: version, payload_ptr, length, zero_copied)
+BPSC_CALLBACK = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32,
+    ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_int32,
+)
+
 _lib: Optional[ctypes.CDLL] = None
 
 
@@ -86,6 +96,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             c.c_char_p, c.c_int32, c.c_int32, c.c_int32,
         ]
         lib.bps_native_server_start_unix.restype = c.c_int32
+    # native worker client data plane (ps_client.cc) — may be absent in a
+    # stale .so; the pure-Python client covers every van without it
+    if hasattr(lib, "bpsc_create"):
+        lib.bpsc_create.argtypes = [c.c_char_p, c.c_int32, c.c_int32, c.c_int32]
+        lib.bpsc_create.restype = c.c_int64
+        lib.bpsc_set_cb.argtypes = [c.c_int64, BPSC_CALLBACK, c.c_void_p]
+        lib.bpsc_set_cb.restype = None
+        lib.bpsc_alloc_seq.argtypes = [c.c_int64, c.c_void_p, c.c_uint64]
+        lib.bpsc_alloc_seq.restype = c.c_int64
+        lib.bpsc_send.argtypes = [
+            c.c_int64, c.c_int32, c.c_uint32, c.c_uint64, c.c_uint32,
+            c.c_uint32, c.c_uint32, c.c_void_p, c.c_uint64,
+        ]
+        lib.bpsc_send.restype = c.c_int32
+        lib.bpsc_close.argtypes = [c.c_int64]
+        lib.bpsc_close.restype = None
     return lib
 
 
@@ -104,10 +130,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None  # corrupt/partial .so → pure-Python fallbacks
-    if not hasattr(lib, "bps_native_server_start_unix") and autobuild:
-        # stale library from before the newest server entry points: rebuild,
-        # then load via a temp COPY — dlopen dedups by path/inode, so
-        # reloading the original path can hand back the old mapping
+    if not hasattr(lib, "bpsc_create") and autobuild:
+        # stale library from before the newest entry points (currently the
+        # native worker client): rebuild, then load via a temp COPY —
+        # dlopen dedups by path/inode, so reloading the original path can
+        # hand back the old mapping
         _try_build()
         try:
             import shutil
@@ -119,7 +146,7 @@ def _load() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy(_LIB_PATH, tmp.name)
             fresh = ctypes.CDLL(tmp.name)
-            if hasattr(fresh, "bps_native_server_start_unix"):
+            if hasattr(fresh, "bpsc_create"):
                 lib = fresh
         except OSError:
             pass

@@ -1,0 +1,372 @@
+// Native worker-side PS data plane (the C++ half of comm/ps_client.py).
+//
+// The reference's worker hot path is C++ (core_loops.cc:538-618: ZPush /
+// ZPull framing, completion demux, zero-copy into caller SArrays) with
+// Python only steering.  This file gives the TPU build the same split:
+// lane sockets, 32-byte framing, seq-table demux, and payload receive —
+// including pull-into-caller-buffer zero copy — all run on C++ threads
+// with no GIL; Python sees one completion callback per message (ctypes
+// re-acquires the GIL for the duration of the callback only).
+//
+// Scope: the worker↔server DATA lanes for the tcp and uds vans,
+// including BYTEPS_TCP_STREAMS striping (responses demux into one shared
+// seq table, per-key lane pinning preserves per-key FIFO).  The shm van
+// keeps its Python client (its bulk path is already syscall-free mmap
+// memcpy), and the scheduler link stays Python (low-rate control plane).
+//
+// Contract with comm/ps_client.py (_NativeServerConn):
+//   h   = bpsc_create(host, port, kind, streams)   kind: 0 tcp, 1 uds
+//         bpsc_set_cb(h, cb, ctx)                  BEFORE first alloc/send
+//   seq = bpsc_alloc_seq(h, sink_ptr, sink_len)    -1 => conn dead
+//         bpsc_send(h, op, seq, key, cmd, ver, flags, payload, len)
+//         bpsc_close(h)                            joins lanes, frees h
+//
+// Handles are ids into a global registry holding shared_ptrs: a send
+// racing a close (elastic server-swap failure path) resolves its id
+// before the close erases it — the object stays alive until the last
+// in-flight call returns — or after, in which case the call fails
+// cleanly instead of touching freed memory.
+//
+// Completion callback (one per response, fired from a lane thread):
+//   cb(ctx, op, status, flags, seq, key, cmd, version, payload, len, zc)
+// zc=1: payload landed in the caller's registered sink (ptr = sink).
+// Dead-connection drain fires cb with status=-1, payload=NULL for every
+// pending seq — exactly once, on the LAST lane to exit (a sibling lane
+// may still be mid-receive into a caller's zero-copy sink; see
+// _ServerConn.lane_exited for the Python statement of this rule).
+
+#include <arpa/inet.h>
+#include <endian.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "wire.h"
+
+namespace {
+
+using bps_wire::Header;
+using bps_wire::kMagic;
+
+typedef void (*bpsc_cb_t)(void* ctx, int32_t op, int32_t status,
+                          uint32_t flags, uint32_t seq, uint64_t key,
+                          uint32_t cmd, uint32_t version,
+                          const uint8_t* payload, uint64_t len,
+                          int32_t zero_copied);
+
+int connect_with_timeout(int fd, const sockaddr* sa, socklen_t slen,
+                         int timeout_ms) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  int r = ::connect(fd, sa, slen);
+  if (r < 0 && errno != EINPROGRESS) return -1;
+  if (r < 0) {
+    pollfd p{fd, POLLOUT, 0};
+    if (::poll(&p, 1, timeout_ms) <= 0) return -1;
+    int err = 0;
+    socklen_t el = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &el) < 0 || err != 0)
+      return -1;
+  }
+  fcntl(fd, F_SETFL, fl);  // back to blocking for the lane loops
+  return 0;
+}
+
+int dial(const char* host, int port, int kind) {
+  if (kind == 1) {  // uds: host is the socket path
+    int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    size_t n = strlen(host);
+    if (n >= sizeof(addr.sun_path)) {
+      ::close(fd);
+      return -1;
+    }
+    std::memcpy(addr.sun_path, host, n + 1);
+    if (connect_with_timeout(fd, (sockaddr*)&addr, sizeof(addr), 30000) < 0) {
+      ::close(fd);
+      return -1;
+    }
+    return fd;
+  }
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0 || !res) return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect_with_timeout(fd, ai->ai_addr, (socklen_t)ai->ai_addrlen,
+                             30000) == 0)
+      break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  return fd;
+}
+
+bool cli_recv_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = (uint8_t*)buf;
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;  // EOF or hard error
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+struct ClientLane {
+  int fd = -1;
+  std::mutex send_mu;
+  std::thread th;
+};
+
+struct NativeClient {
+  std::vector<std::unique_ptr<ClientLane>> lanes;
+  bpsc_cb_t cb = nullptr;
+  void* cb_ctx = nullptr;
+
+  std::mutex mu;  // seq table + lifecycle flags
+  uint32_t next_seq = 0;
+  struct Pending {
+    uint8_t* sink;
+    uint64_t sink_len;
+  };
+  std::unordered_map<uint32_t, Pending> pending;
+  bool dead = false;  // set by the LAST lane to exit (after the drain)
+  int live_lanes = 0;
+
+  ~NativeClient() {
+    for (auto& l : lanes) {
+      if (l->th.joinable()) l->th.join();
+      if (l->fd >= 0) ::close(l->fd);
+    }
+  }
+
+  void shutdown_all_fds() {
+    // shutdown (not close) wakes lane threads blocked in recv; the fds
+    // close in the destructor, after the threads are joined
+    for (auto& l : lanes)
+      if (l->fd >= 0) ::shutdown(l->fd, SHUT_RDWR);
+  }
+
+  // One lane dying poisons the whole striped connection (a partially
+  // striped link would strand keyed requests); only the LAST lane to
+  // exit drains pending callbacks — a sibling may still be receiving
+  // into a caller's zero-copy sink.
+  void lane_exit() {
+    shutdown_all_fds();
+    std::vector<uint32_t> orphans;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      if (--live_lanes > 0) return;
+      dead = true;
+      orphans.reserve(pending.size());
+      for (auto& kv : pending) orphans.push_back(kv.first);
+      pending.clear();
+    }
+    for (uint32_t seq : orphans)
+      cb(cb_ctx, -1, -1, 0, seq, 0, 0, 0, nullptr, 0, 0);
+  }
+
+  void recv_loop(ClientLane* lane) {
+    std::vector<uint8_t> scratch;
+    for (;;) {
+      Header h;
+      if (!cli_recv_exact(lane->fd, &h, sizeof(h))) break;
+      if (h.magic != kMagic) break;  // framing desync: drop the conn
+      uint32_t seq = ntohl(h.seq);
+      uint64_t key = be64toh(h.key);
+      uint64_t len = be64toh(h.length);
+      uint8_t* sink = nullptr;
+      uint64_t sink_len = 0;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        auto it = pending.find(seq);
+        if (it != pending.end()) {
+          sink = it->second.sink;
+          sink_len = it->second.sink_len;
+        }
+      }
+      const uint8_t* payload = nullptr;
+      int32_t zc = 0;
+      if (len) {
+        if (sink && sink_len == len) {
+          // zero-copy: the response lands directly in the caller's
+          // registered buffer (ZPull-into-SArray parity)
+          if (!cli_recv_exact(lane->fd, sink, len)) break;
+          payload = sink;
+          zc = 1;
+        } else {
+          scratch.resize(len);
+          if (!cli_recv_exact(lane->fd, scratch.data(), len)) break;
+          payload = scratch.data();
+        }
+      }
+      // un-register only AFTER the payload is fully received: dying
+      // mid-payload must leave the entry for the drain (cb status=-1),
+      // never lose it
+      {
+        std::lock_guard<std::mutex> g(mu);
+        pending.erase(seq);
+      }
+      cb(cb_ctx, h.op, h.status, h.flags, seq, key, ntohl(h.cmd),
+         ntohl(h.version), payload, len, zc);
+    }
+    lane_exit();
+  }
+};
+
+// Handle registry: ids never dangle — concurrent bpsc_* calls either
+// resolve the shared_ptr before bpsc_close erases it (object outlives
+// the call) or fail the lookup cleanly.
+std::mutex g_cli_mu;
+std::map<int64_t, std::shared_ptr<NativeClient>> g_clients;
+int64_t g_next_cli_id = 1;
+
+std::shared_ptr<NativeClient> cli_for(int64_t id) {
+  std::lock_guard<std::mutex> g(g_cli_mu);
+  auto it = g_clients.find(id);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t bpsc_create(const char* host, int32_t port, int32_t kind,
+                    int32_t streams) {
+  auto c = std::make_shared<NativeClient>();
+  if (streams < 1) streams = 1;
+  if (kind == 1) streams = 1;  // parity with the Python client: stripe tcp only
+  for (int i = 0; i < streams; ++i) {
+    int fd = dial(host, port, kind);
+    if (fd < 0) return -1;  // shared_ptr frees the dialed lanes
+    auto lane = std::make_unique<ClientLane>();
+    lane->fd = fd;
+    c->lanes.push_back(std::move(lane));
+  }
+  c->live_lanes = (int)c->lanes.size();
+  std::lock_guard<std::mutex> g(g_cli_mu);
+  int64_t id = g_next_cli_id++;
+  g_clients[id] = std::move(c);
+  return id;
+}
+
+void bpsc_set_cb(int64_t h, void (*cb)(void*, int32_t, int32_t, uint32_t,
+                                       uint32_t, uint64_t, uint32_t, uint32_t,
+                                       const uint8_t*, uint64_t, int32_t),
+                 void* ctx) {
+  auto c = cli_for(h);
+  if (!c) return;
+  c->cb = cb;
+  c->cb_ctx = ctx;
+  // lanes start only once the callback is in place — a response racing
+  // set_cb could otherwise fire a null pointer
+  NativeClient* cp = c.get();
+  for (auto& l : c->lanes) {
+    ClientLane* lp = l.get();
+    l->th = std::thread([cp, lp] { cp->recv_loop(lp); });
+  }
+}
+
+int64_t bpsc_alloc_seq(int64_t h, void* sink, uint64_t sink_len) {
+  auto c = cli_for(h);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  if (c->dead) return -1;
+  uint32_t seq = c->next_seq++;
+  c->pending[seq] = {(uint8_t*)sink, sink_len};
+  return (int64_t)seq;
+}
+
+int32_t bpsc_send(int64_t h, int32_t op, uint32_t seq, uint64_t key,
+                  uint32_t cmd, uint32_t version, uint32_t flags,
+                  const void* payload, uint64_t len) {
+  auto c = cli_for(h);
+  if (!c) return -1;
+  ClientLane* lane = c->lanes[key % c->lanes.size()].get();
+  Header hd;
+  hd.magic = kMagic;
+  hd.op = (uint8_t)op;
+  hd.status = 0;
+  hd.flags = (uint8_t)flags;
+  hd.seq = htonl(seq);
+  hd.key = htobe64(key);
+  hd.cmd = htonl(cmd);
+  hd.version = htonl(version);
+  hd.length = htobe64(len);
+  // scatter-gather send: header + payload leave through one writev with
+  // zero payload memcpys (transport.py sendmsg parity)
+  iovec iov[2] = {{&hd, sizeof(hd)}, {const_cast<void*>(payload), len}};
+  int iovcnt = len ? 2 : 1;
+  size_t off = 0, total = sizeof(hd) + (size_t)len;
+  std::lock_guard<std::mutex> g(lane->send_mu);
+  while (off < total) {
+    iovec cur[2];
+    int n = 0;
+    size_t skip = off;
+    for (int i = 0; i < iovcnt; ++i) {
+      if (skip >= iov[i].iov_len) {
+        skip -= iov[i].iov_len;
+        continue;
+      }
+      cur[n].iov_base = (uint8_t*)iov[i].iov_base + skip;
+      cur[n].iov_len = iov[i].iov_len - skip;
+      skip = 0;
+      ++n;
+    }
+    ssize_t w = ::writev(lane->fd, cur, n);
+    if (w < 0 && errno == EINTR) continue;
+    if (w <= 0) return -1;
+    off += (size_t)w;
+  }
+  return 0;
+}
+
+void bpsc_close(int64_t h) {
+  std::shared_ptr<NativeClient> c;
+  {
+    std::lock_guard<std::mutex> g(g_cli_mu);
+    auto it = g_clients.find(h);
+    if (it == g_clients.end()) return;  // idempotent
+    c = std::move(it->second);
+    g_clients.erase(it);
+  }
+  c->shutdown_all_fds();  // wakes lane threads; they drain and exit
+  for (auto& l : c->lanes)
+    if (l->th.joinable()) l->th.join();
+  // fds close in ~NativeClient once any in-flight bpsc_send releases
+  // its shared_ptr
+}
+
+}  // extern "C"

@@ -442,7 +442,9 @@ class TestMultiWorker:
 
 
 class TestServerDeath:
-    @pytest.mark.parametrize("server_kind", ["python", "native"])
+    @pytest.mark.parametrize(
+        "server_kind", ["python", "native", "python+nc", "native+nc"]
+    )
     def test_sigkill_server_fails_handles_not_hangs(
         self, monkeypatch, tmp_path, server_kind
     ):
@@ -452,7 +454,16 @@ class TestServerDeath:
         Exercises the dead-connection callback chain end to end
         (ps_client._recv_loop → engine._fail_task → handle status), for
         both server engines (the worker-side plumbing is engine-agnostic,
-        but the kill timing differs)."""
+        but the kill timing differs).  The ``+nc`` variants run the
+        worker on the C++ client (native/ps_client.cc last-lane drain)."""
+        server_kind, _, nc = server_kind.partition("+")
+        if nc:
+            from byteps_tpu.native import get_lib
+
+            lib = get_lib()
+            if lib is None or not hasattr(lib, "bpsc_create"):
+                pytest.skip("native client lib not built")
+            monkeypatch.setenv("BYTEPS_NATIVE_CLIENT", "1")
         if server_kind == "native":
             from byteps_tpu.native import HAVE_NATIVE
 
@@ -927,3 +938,49 @@ class TestStripedTcpVan:
         finally:
             srv.stop()
             sched.stop()
+
+
+class TestReinitCycle:
+    """shutdown() → init() against a NEW cluster must re-run every key's
+    init-push barrier: the tensor registry (and each ctx) deliberately
+    outlives init cycles for stable key replay, but a fresh cluster's
+    stores are empty — a skipped init means the first push hits an
+    uninitialized key and the server drops the connection.  Regression:
+    found by an end-to-end drive running two clusters in one process
+    (engine_epoch, core/engine.py _prepare_round)."""
+
+    @pytest.mark.parametrize("engine", ["python", "native"])
+    def test_same_name_across_two_clusters(self, engine, monkeypatch):
+        if engine == "native":
+            from byteps_tpu.native import HAVE_NATIVE
+
+            if not HAVE_NATIVE:
+                pytest.skip("native lib not built")
+
+        def one_cluster(value: float) -> None:
+            sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+            sched.start()
+            monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+            monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+            monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+            monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+            monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+            scfg = Config.from_env()
+            srv = NativePSServer(scfg) if engine == "native" else PSServer(scfg)
+            threading.Thread(target=srv.start, daemon=True).start()
+            try:
+                import byteps_tpu as bps
+
+                bps.init()
+                x = np.full(4096, value, dtype=np.float32)
+                # same tensor name both cycles — the second cluster's
+                # server has never seen it
+                out = bps.push_pull(x, name="ps.reinit_cycle")
+                np.testing.assert_allclose(np.asarray(out), x)
+                bps.shutdown()
+            finally:
+                srv.stop()
+                sched.stop()
+
+        one_cluster(1.0)
+        one_cluster(2.0)
