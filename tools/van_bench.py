@@ -23,8 +23,21 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 import numpy as np
 
 
+def _gil_contender(stop: "threading.Event") -> None:
+    """Pure-Python busy loop: monopolizes the GIL the way engine-side
+    Python work (compression staging, callback bookkeeping, framework
+    glue) does in a real job.  Under this load the Python client's recv
+    threads must win GIL slices to move bytes, while the native client's
+    lanes only touch the GIL for the per-message completion callback."""
+    x = 0
+    while not stop.is_set():
+        for _ in range(50000):
+            x += 1
+
+
 def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python",
-              streams: int = 1, n_keys: int = 1) -> dict:
+              streams: int = 1, n_keys: int = 1,
+              client_kind: str = "python", contend: bool = False) -> dict:
     from byteps_tpu.common.config import Config
     from byteps_tpu.comm.ps_client import PSClient
     from byteps_tpu.comm.rendezvous import Scheduler
@@ -32,6 +45,9 @@ def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python",
 
     os.environ["BYTEPS_VAN"] = van
     os.environ["BYTEPS_TCP_STREAMS"] = str(streams)
+    # worker-side data plane: the C++ client (native/ps_client.cc) vs the
+    # Python lanes — the VERDICT r3 #4 comparison axis
+    os.environ["BYTEPS_NATIVE_CLIENT"] = "1" if client_kind == "native" else "0"
     sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
     sched.start()
     os.environ.update({
@@ -78,10 +94,19 @@ def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python",
 
     for w in range(2):  # warmup
         round_once(w + 1)
-    t0 = time.perf_counter()
-    for r in range(rounds):
-        round_once(r + 3)
-    dt = time.perf_counter() - t0
+    stop_contender = threading.Event()
+    if contend:
+        threading.Thread(
+            target=_gil_contender, args=(stop_contender,), daemon=True
+        ).start()
+    try:
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            round_once(r + 3)
+        dt = time.perf_counter() - t0
+    finally:
+        # a leaked contender would depress every later measurement
+        stop_contender.set()
 
     zero_copy = client.zero_copy_pulls
     client.close()
@@ -92,7 +117,9 @@ def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python",
     return {
         "van": van,
         "engine": engine,
+        "client": client_kind,
         "streams": streams,
+        "contended": contend,
         "keys": n_keys,
         "mb_per_s": round(mb / dt, 1),
         "round_ms": round(dt / rounds * 1e3, 2),
@@ -179,6 +206,13 @@ def main() -> None:
     ap.add_argument("--vans", default="tcp,uds,shm")
     ap.add_argument("--engines", default="python,native",
                     help="server data planes to cross with the vans")
+    ap.add_argument("--clients", default="python",
+                    help="worker data planes: python and/or native "
+                         "(BYTEPS_NATIVE_CLIENT; tcp/uds vans only)")
+    ap.add_argument("--contend", action="store_true",
+                    help="run a GIL-monopolizing Python thread during the "
+                         "timed rounds (the engine-load scenario the "
+                         "native client exists for)")
     ap.add_argument("--raw", action="store_true",
                     help="also measure the bare-socket upper bound")
     ap.add_argument("--keys", type=int, default=1,
@@ -198,6 +232,14 @@ def main() -> None:
             engines = [e for e in engines if e != "native"]
         else:
             native_unix = hasattr(get_lib(), "bps_native_server_start_unix")
+    clients = [cl.strip() for cl in args.clients.split(",") if cl.strip()]
+    if "native" in clients:
+        from byteps_tpu.native import get_lib
+
+        lib = get_lib()
+        if lib is None or not hasattr(lib, "bpsc_create"):
+            print(json.dumps({"client": "native", "skipped": "lib not built"}))
+            clients = [cl for cl in clients if cl != "native"]
     for van in args.vans.split(","):
         van = van.strip()
         if van == "shm":
@@ -217,11 +259,20 @@ def main() -> None:
                     "skipped": "stale native lib (no unix/shm listener)",
                 }))
                 continue
-            for streams in stream_counts:
-                print(json.dumps(bench_van(
-                    van, args.mbytes, args.rounds, engine,
-                    streams=streams, n_keys=args.keys,
-                )))
+            for client in clients:
+                if client == "native" and van == "shm":
+                    print(json.dumps({
+                        "van": van, "client": client,
+                        "skipped": "shm keeps the Python client "
+                                   "(mmap bulk path is already zero-copy)",
+                    }))
+                    continue
+                for streams in stream_counts:
+                    print(json.dumps(bench_van(
+                        van, args.mbytes, args.rounds, engine,
+                        streams=streams, n_keys=args.keys,
+                        client_kind=client, contend=args.contend,
+                    )))
 
 
 if __name__ == "__main__":
