@@ -26,6 +26,8 @@ devices, these kernels handle the blocks *within* one device).
 from __future__ import annotations
 
 import functools
+import json
+import os
 from typing import Optional
 
 import jax
@@ -399,14 +401,60 @@ def _flash_lse_bwd(causal, scale, bq, bk, interpret, res, g):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+#: on-chip sweep artifact written by tools/flash_tune.py; absent until a
+#: tune has run on real hardware.  Deliberately committable: every TPU in
+#: this deployment is the same generation, so the tuned table ships like
+#: any framework's pre-tuned kernel configs (tuned_blocks' divisibility
+#: guard keeps foreign sequence lengths on safe defaults).
+_TUNED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "flash_blocks.json")
+_tuned_cache: Optional[dict] = None
+
+
+def _tuned_table() -> dict:
+    global _tuned_cache
+    if _tuned_cache is None:
+        try:
+            with open(_TUNED_PATH) as f:
+                _tuned_cache = {
+                    int(k): tuple(v)
+                    for k, v in json.load(f)["blocks"].items()
+                }
+        except (OSError, ValueError, KeyError, TypeError, AttributeError):
+            _tuned_cache = {}
+    return _tuned_cache
+
+
+def tuned_blocks(seq: int) -> tuple:
+    """Best (block_q, block_k) for this sequence length, from the on-chip
+    sweep artifact (tools/flash_tune.py → ops/flash_blocks.json).  Falls
+    back to the nearest tuned seq below whose blocks DIVIDE this seq
+    (block choice varies slowly with S, but a non-dividing block would
+    silently demote the kernel to the dense fallback), then to
+    (128, 128) — the MXU-aligned safe default.  Callers passing explicit
+    block sizes bypass this table."""
+    table = _tuned_table()
+
+    def fits(entry) -> bool:
+        bq, bk = entry
+        return seq % bq == 0 and seq % bk == 0
+
+    if seq in table and fits(table[seq]):
+        return table[seq]
+    below = [s for s in table if s < seq and fits(table[s])]
+    if below:
+        return table[max(below)]
+    return (128, 128)
+
+
 def flash_attention_lse(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> tuple:
     """Like :func:`flash_attention` but also returns the per-row logsumexp
@@ -417,8 +465,9 @@ def flash_attention_lse(
     delta term)."""
     b, h, s, dh = q.shape
     scale = scale if scale is not None else dh**-0.5
-    bq = min(block_q, s)
-    bk = min(block_k, s)
+    tq, tk = tuned_blocks(s)
+    bq = min(block_q if block_q is not None else tq, s)
+    bk = min(block_k if block_k is not None else tk, s)
     on_tpu = jax.devices()[0].platform == "tpu"
     if (s % bq or s % bk) or (not on_tpu and not interpret):
         return _dense_reference_lse(q, k, v, causal, scale)
@@ -431,8 +480,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """q/k/v: (B, H, S, dh) → (B, H, S, dh).
@@ -442,8 +491,9 @@ def flash_attention(
     """
     b, h, s, dh = q.shape
     scale = scale if scale is not None else dh**-0.5
-    bq = min(block_q, s)
-    bk = min(block_k, s)
+    tq, tk = tuned_blocks(s)
+    bq = min(block_q if block_q is not None else tq, s)
+    bk = min(block_k if block_k is not None else tk, s)
     on_tpu = jax.devices()[0].platform == "tpu"
     if (s % bq or s % bk) or (not on_tpu and not interpret):
         return _dense_reference(q, k, v, causal, scale)

@@ -133,8 +133,8 @@ def ring_flash_attention(
     axis_size: int = 1,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Ring attention whose per-hop compute is the Pallas flash kernel —

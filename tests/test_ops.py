@@ -267,3 +267,92 @@ class TestDeviceCodecs:
         norm_v = float(np.abs(grad).max())
         se = norm_v / s / np.sqrt(trials)
         np.testing.assert_allclose(mean, grad, atol=6 * se)
+
+
+class TestTunedBlocks:
+    """tuned_blocks(): the on-chip sweep artifact (flash_blocks.json)
+    feeds kernel block defaults; safe fallback when untuned."""
+
+    @staticmethod
+    def _module():
+        # ops/__init__ re-exports the flash_attention FUNCTION, which
+        # shadows the submodule in `import ... as` resolution
+        import importlib
+
+        return importlib.import_module("byteps_tpu.ops.flash_attention")
+
+    def _patch_table(self, monkeypatch, tmp_path, doc):
+        import json
+
+        fa = self._module()
+
+        path = tmp_path / "flash_blocks.json"
+        path.write_text(json.dumps(doc))
+        monkeypatch.setattr(fa, "_TUNED_PATH", str(path))
+        monkeypatch.setattr(fa, "_tuned_cache", None)
+        return fa
+
+    def test_default_when_untuned(self, monkeypatch, tmp_path):
+        fa = self._module()
+
+        monkeypatch.setattr(fa, "_TUNED_PATH", str(tmp_path / "absent.json"))
+        monkeypatch.setattr(fa, "_tuned_cache", None)
+        assert fa.tuned_blocks(512) == (128, 128)
+
+    def test_exact_and_nearest_below(self, monkeypatch, tmp_path):
+        fa = self._patch_table(
+            monkeypatch, tmp_path,
+            {"blocks": {"512": [256, 128], "2048": [256, 512]}},
+        )
+        assert fa.tuned_blocks(512) == (256, 128)
+        assert fa.tuned_blocks(1024) == (256, 128)  # nearest tuned below
+        assert fa.tuned_blocks(4096) == (256, 512)
+        assert fa.tuned_blocks(128) == (128, 128)   # nothing at/below
+
+    def test_corrupt_table_falls_back(self, monkeypatch, tmp_path):
+        fa = self._patch_table(monkeypatch, tmp_path, {"blocks": "nope"})
+        assert fa.tuned_blocks(512) == (128, 128)
+
+    def test_nondividing_entry_falls_back(self, monkeypatch, tmp_path):
+        """A nearest-below entry whose blocks do not divide the requested
+        seq must NOT be used (it would silently demote the kernel to the
+        dense fallback); the safe default applies instead."""
+        fa = self._patch_table(
+            monkeypatch, tmp_path, {"blocks": {"512": [512, 512]}}
+        )
+        assert fa.tuned_blocks(768) == (128, 128)
+        assert fa.tuned_blocks(1024) == (512, 512)
+
+    def test_kernel_resolves_table_defaults(self, monkeypatch, tmp_path):
+        """flash_attention with block_q/block_k=None resolves block sizes
+        from the table: a distinctive (32, 32) entry must reach the Pallas
+        kernel (spied via _flash, run in interpret mode so the kernel path
+        executes off-TPU) and still match the dense reference."""
+        import numpy as np
+
+        fa = self._patch_table(
+            monkeypatch, tmp_path, {"blocks": {"64": [32, 32]}}
+        )
+        seen = {}
+        orig_flash = fa._flash
+
+        def spy(q, k, v, causal, scale, bq, bk, interpret):
+            seen["blocks"] = (bq, bk)
+            return orig_flash(q, k, v, causal, scale, bq, bk, interpret)
+
+        monkeypatch.setattr(fa, "_flash", spy)
+        rng = np.random.default_rng(0)
+        q, k, v = (rng.normal(size=(1, 2, 64, 16)).astype(np.float32)
+                   for _ in range(3))
+        import jax.numpy as jnp
+
+        out = fa.flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True,
+            interpret=True,
+        )
+        assert seen["blocks"] == (32, 32), "tuned table entry must be used"
+        ref = fa._dense_reference(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), True, 16 ** -0.5
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
