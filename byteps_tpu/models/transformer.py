@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +46,10 @@ class TransformerConfig:
     vocab_size: int = 32768
     d_model: int = 1024
     n_heads: int = 16
+    # grouped-query attention: number of K/V heads (None = n_heads, i.e.
+    # classic MHA).  Query heads share KV groups of n_heads/n_kv_heads;
+    # the decode KV cache stores only n_kv_heads (the GQA memory win)
+    n_kv_heads: Optional[int] = None
     d_head: int = 64
     d_ff: int = 4096
     n_layers: int = 24
@@ -90,6 +94,15 @@ class TransformerConfig:
                 f"unknown seq_parallel_impl {self.seq_parallel_impl!r}; "
                 "expected 'ring' or 'ulysses'"
             )
+        if self.n_kv_heads is not None and self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads {self.n_heads} not divisible by n_kv_heads "
+                f"{self.n_kv_heads} (query heads share KV groups evenly)"
+            )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
     # qkv/proj bias terms (GPT-2-style checkpoints have them; BERT too)
     attn_bias: bool = False
 
@@ -132,6 +145,7 @@ def _layouts(cfg: TransformerConfig) -> Dict[str, Tuple]:
     (partition spec), (grad sync axes).  Spec axes reference the 4-D mesh
     (dp, pp, sp, tp)."""
     D, H, dh, F = cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff
+    KV = cfg.kv_heads
     L, V, S, E = cfg.n_layers, cfg.vocab_size, cfg.max_seq, cfg.n_experts
     # leading dims of layer params: (pp, layers_per_stage) — pp filled in
     # at init time when the mesh is known
@@ -147,16 +161,16 @@ def _layouts(cfg: TransformerConfig) -> Dict[str, Tuple]:
         "ln2_s": ((D,), P("pp"), ("dp", "sp", "tp")),
         "ln2_b": ((D,), P("pp"), ("dp", "sp", "tp")),
         "wq": ((D, H, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
-        "wk": ((D, H, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
-        "wv": ((D, H, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
+        "wk": ((D, KV, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
+        "wv": ((D, KV, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
         "wo": ((H, dh, D), P("pp", None, "tp", None, None), ("dp", "sp")),
     }
     if cfg.attn_bias:
         table.update(
             {
                 "wq_b": ((H, dh), P("pp", None, "tp", None), ("dp", "sp")),
-                "wk_b": ((H, dh), P("pp", None, "tp", None), ("dp", "sp")),
-                "wv_b": ((H, dh), P("pp", None, "tp", None), ("dp", "sp")),
+                "wk_b": ((KV, dh), P("pp", None, "tp", None), ("dp", "sp")),
+                "wv_b": ((KV, dh), P("pp", None, "tp", None), ("dp", "sp")),
                 # added after the tp psum, like b2
                 "wo_b": ((D,), P("pp"), ("dp", "sp", "tp")),
             }
@@ -281,6 +295,15 @@ def _qkv_proj(cfg: TransformerConfig, h, lp):
     return q, k, v
 
 
+def _repeat_kv(k, v, n_q_heads: int):
+    """Expand grouped K/V heads to the query head count (GQA): each KV
+    head serves n_q_heads/kv_heads query heads.  Identity for MHA."""
+    rep = n_q_heads // k.shape[1]
+    if rep == 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1)
+
+
 def _attn_out(cfg: TransformerConfig, attn, lp, x):
     """Shared attention output projection + tp row-parallel combine +
     residual."""
@@ -337,6 +360,7 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
         # x: (B, S_local, D)
         h = _ln(x, lp["ln1_s"], lp["ln1_b"]).astype(cdt)
         q, k, v = _qkv_proj(cfg, h, lp)
+        k, v = _repeat_kv(k, v, q.shape[1])  # GQA: groups -> query heads
         if sp == 1 and cfg.use_flash:
             from byteps_tpu.ops.flash_attention import flash_attention
 
@@ -623,17 +647,27 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         s = x.shape[1]
         h = _ln(x, lp["ln1_s"], lp["ln1_b"]).astype(cdt)
         q, k, v = _qkv_proj(cfg, h, lp)
+        # the cache holds KV heads only (the GQA decode-memory win); the
+        # attend below groups query heads over it without materializing
+        # a repeated cache
         kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), offset, axis=2)
         vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), offset, axis=2)
-        scores = jnp.einsum("bhsk,bhtk->bhst", q, kc.astype(cdt))
+        bq, hq = q.shape[0], q.shape[1]
+        hkv = kc.shape[1]
+        rep = hq // hkv
+        qg = q.reshape(bq, hkv, rep, s, cfg.d_head)
+        scores = jnp.einsum("bgrsk,bgtk->bgrst", qg, kc.astype(cdt))
         scores = scores / np.sqrt(cfg.d_head).astype(cdt)
         # query i (absolute offset+i) may see cache positions t <= offset+i
         t_idx = jnp.arange(S_max)
         i_idx = offset + jnp.arange(s)
         mask = t_idx[None, :] <= i_idx[:, None]  # (s, S_max)
-        scores = jnp.where(mask[None, None], scores, jnp.asarray(-1e30, cdt))
+        scores = jnp.where(
+            mask[None, None, None], scores, jnp.asarray(-1e30, cdt)
+        )
         attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cdt)
-        ctx = jnp.einsum("bhst,bhtk->bhsk", attn, vc.astype(cdt))
+        ctx = jnp.einsum("bgrst,bgtk->bgrsk", attn, vc.astype(cdt))
+        ctx = ctx.reshape(bq, hq, s, cfg.d_head)
         x = _attn_out(cfg, ctx, lp, x)
         if cfg.moe:
             # expert-parallel MLP: decode tokens are REPLICATED across the
@@ -705,8 +739,8 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         stage_params = {k: v[0] for k, v in params.items() if _is_layer_param(k)}
         b, s0 = tokens.shape
         L = stage_params["wq"].shape[0]  # pp-local layer count
-        h_local = stage_params["wq"].shape[2]  # tp-local head count
-        kcs = jnp.zeros((L, b, h_local, S_max, cfg.d_head), cdt)
+        kv_local = stage_params["wk"].shape[2]  # tp-local KV head count
+        kcs = jnp.zeros((L, b, kv_local, S_max, cfg.d_head), cdt)
         vcs = jnp.zeros_like(kcs)
 
         # prefill: one batched pass over the prompt
