@@ -88,6 +88,15 @@ class TransformerConfig:
     # tp-local heads divisible by sp)
     seq_parallel_impl: str = "ring"
 
+    # qkv/proj bias terms (GPT-2-style checkpoints have them; BERT too)
+    attn_bias: bool = False
+    # positional encoding: "learned" absolute table (BERT/GPT-2 style) or
+    # "rope" rotary embeddings applied to q/k (Llama/GPT-NeoX style —
+    # relative, extrapolates past max_seq, composes with ring attention
+    # because each key's rotation is baked in before KV blocks travel)
+    pos_emb: str = "learned"
+    rope_theta: float = 10000.0
+
     def __post_init__(self):
         if self.seq_parallel_impl not in ("ring", "ulysses"):
             raise ValueError(
@@ -99,12 +108,18 @@ class TransformerConfig:
                 f"n_heads {self.n_heads} not divisible by n_kv_heads "
                 f"{self.n_kv_heads} (query heads share KV groups evenly)"
             )
+        if self.pos_emb not in ("learned", "rope"):
+            raise ValueError(
+                f"unknown pos_emb {self.pos_emb!r}; expected 'learned' or 'rope'"
+            )
+        if self.pos_emb == "rope" and self.d_head % 2:
+            raise ValueError(
+                f"rope needs an even d_head, got {self.d_head}"
+            )
 
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
-    # qkv/proj bias terms (GPT-2-style checkpoints have them; BERT too)
-    attn_bias: bool = False
 
 
 def bert_large(**kw) -> TransformerConfig:
@@ -151,7 +166,10 @@ def _layouts(cfg: TransformerConfig) -> Dict[str, Tuple]:
     # at init time when the mesh is known
     table = {
         "embed": ((V, D), P(), ("dp", "pp", "sp", "tp")),
-        "pos": ((S, D), P(), ("dp", "pp", "sp", "tp")),
+    }
+    if cfg.pos_emb == "learned":
+        table["pos"] = ((S, D), P(), ("dp", "pp", "sp", "tp"))
+    table.update({
         "ln_f_s": ((D,), P(), ("dp", "pp", "sp", "tp")),
         "ln_f_b": ((D,), P(), ("dp", "pp", "sp", "tp")),
         "head": ((D, V), P(), ("dp", "pp", "sp", "tp")),
@@ -164,7 +182,7 @@ def _layouts(cfg: TransformerConfig) -> Dict[str, Tuple]:
         "wk": ((D, KV, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
         "wv": ((D, KV, dh), P("pp", None, None, "tp", None), ("dp", "sp")),
         "wo": ((H, dh, D), P("pp", None, "tp", None, None), ("dp", "sp")),
-    }
+    })
     if cfg.attn_bias:
         table.update(
             {
@@ -280,10 +298,31 @@ def _ln(x, s, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * s + b
 
 
-def _qkv_proj(cfg: TransformerConfig, h, lp):
+def _rope(x, positions, theta: float):
+    """Rotary position embedding (rotate-half convention): x (B, H, s, dh)
+    rotated per ABSOLUTE position — sequence-parallel ranks and the cached
+    decoder pass their global offsets, so rotations stay consistent when
+    KV blocks travel the ring or live in the cache."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = jnp.asarray(theta, jnp.float32) ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (s, half)
+    cos = jnp.cos(ang)[None, None]
+    sin = jnp.sin(ang)[None, None]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _qkv_proj(cfg: TransformerConfig, h, lp, positions=None):
     """Shared QKV projection (tp-local heads: wq (D, H_local, dh)) —
     used by the training stage fn AND the cached decoder so the layer
-    math can never diverge between paths."""
+    math can never diverge between paths.  ``positions``: absolute token
+    positions (s,), required when cfg.pos_emb == "rope" (q/k rotated
+    in-projection; v untouched)."""
     cdt = cfg.compute_dtype
     q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"].astype(cdt))
     k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"].astype(cdt))
@@ -292,6 +331,10 @@ def _qkv_proj(cfg: TransformerConfig, h, lp):
         q = q + lp["wq_b"].astype(cdt)[None, :, None, :]
         k = k + lp["wk_b"].astype(cdt)[None, :, None, :]
         v = v + lp["wv_b"].astype(cdt)[None, :, None, :]
+    if cfg.pos_emb == "rope":
+        assert positions is not None, "rope needs absolute positions"
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
     return q, k, v
 
 
@@ -359,7 +402,12 @@ def _make_stage_fn(cfg: TransformerConfig, mesh: Mesh):
     def layer_fn(x, lp):
         # x: (B, S_local, D)
         h = _ln(x, lp["ln1_s"], lp["ln1_b"]).astype(cdt)
-        q, k, v = _qkv_proj(cfg, h, lp)
+        s_local = x.shape[1]
+        positions = (
+            lax.axis_index("sp") * s_local + jnp.arange(s_local)
+            if cfg.pos_emb == "rope" else None
+        )
+        q, k, v = _qkv_proj(cfg, h, lp, positions)
         k, v = _repeat_kv(k, v, q.shape[1])  # GQA: groups -> query heads
         if sp == 1 and cfg.use_flash:
             from byteps_tpu.ops.flash_attention import flash_attention
@@ -483,8 +531,10 @@ def _local_forward(cfg: TransformerConfig, mesh: Mesh, params, tokens):
 
     b_local, s_local = tokens.shape
     sp_idx = lax.axis_index("sp")
-    positions = sp_idx * s_local + jnp.arange(s_local)
-    x = params["embed"][tokens] + params["pos"][positions]
+    x = params["embed"][tokens]
+    if cfg.pos_emb == "learned":
+        positions = sp_idx * s_local + jnp.arange(s_local)
+        x = x + params["pos"][positions]
     x = _vary_all(x.astype(cfg.compute_dtype), mesh)
 
     m = cfg.microbatches or pp
@@ -646,7 +696,10 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
         differs."""
         s = x.shape[1]
         h = _ln(x, lp["ln1_s"], lp["ln1_b"]).astype(cdt)
-        q, k, v = _qkv_proj(cfg, h, lp)
+        positions = (
+            offset + jnp.arange(s) if cfg.pos_emb == "rope" else None
+        )
+        q, k, v = _qkv_proj(cfg, h, lp, positions)
         # the cache holds KV heads only (the GQA decode-memory win); the
         # attend below groups query heads over it without materializing
         # a repeated cache
@@ -759,8 +812,9 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
             step_key = jax.random.fold_in(base_key, step_idx)
             return jax.random.categorical(step_key, scaled, axis=-1).astype(jnp.int32)
 
-        positions = jnp.arange(s0)
-        x = params["embed"][tokens] + params["pos"][positions]
+        x = params["embed"][tokens]
+        if cfg.pos_emb == "learned":
+            x = x + params["pos"][jnp.arange(s0)]
         # prefill: no-drop serving capacity by default (cf = n_experts ⇒
         # capacity = token count — no prompt token ever loses its MLP
         # contribution, and output is mesh-independent); opt into
@@ -777,7 +831,10 @@ def build_generate_cached(cfg: TransformerConfig, mesh: Mesh) -> Callable:
 
         def step(carry, i):
             kcs, vcs, tok, pos = carry
-            x = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cdt)
+            x = params["embed"][tok]
+            if cfg.pos_emb == "learned":
+                x = x + params["pos"][pos]
+            x = x[:, None, :].astype(cdt)
             # per-token steps: serving capacity (no drops at tiny t)
             x, kcs, vcs = full_stack(
                 stage_params, x, kcs, vcs, pos, float(cfg.n_experts)
