@@ -41,7 +41,11 @@ _ZERO_COPIED = object()
 
 class _ServerConn:
     def __init__(self, host: str, port: int, streams: int = 1) -> None:
-        self.sock = connect(host, port)
+        from byteps_tpu.comm.shaping import maybe_shape
+
+        # data-plane link: shaped when BYTEPS_VAN_DELAY_MS /
+        # BYTEPS_VAN_RATE_MBPS emulate a DCN link (shaping.py)
+        self.sock = maybe_shape(connect(host, port))
         self.send_lock = threading.Lock()
         # striped lanes (BYTEPS_TCP_STREAMS, tcp only): extra parallel
         # connections to the same server, each framed message riding ONE
@@ -55,7 +59,9 @@ class _ServerConn:
         if streams > 1 and not host.startswith((UNIX_PREFIX, SHM_PREFIX)):
             try:
                 for _ in range(streams - 1):
-                    self.stripes.append((connect(host, port), threading.Lock()))
+                    self.stripes.append(
+                        (maybe_shape(connect(host, port)), threading.Lock())
+                    )
             except (ConnectionError, OSError):
                 for sock, _ in self.stripes[1:]:
                     close_socket(sock)
@@ -542,9 +548,14 @@ class PSClient:
         BYTEPS_NATIVE_CLIENT=1 and the lib speaks it (tcp/uds only —
         the shm van's Python client is already zero-copy), else the
         Python lanes + recv threads."""
+        from byteps_tpu.comm.shaping import shaping_enabled
         from byteps_tpu.comm.van import SHM_PREFIX
 
-        if self.cfg.native_client and not host.startswith(SHM_PREFIX):
+        if shaping_enabled() and self.cfg.native_client:
+            from byteps_tpu.comm.shaping import warn_native_bypass_once
+
+            warn_native_bypass_once("ignoring BYTEPS_NATIVE_CLIENT=1")
+        elif self.cfg.native_client and not host.startswith(SHM_PREFIX):
             from byteps_tpu.native import get_lib
 
             lib = get_lib()

@@ -311,12 +311,29 @@ class PSServer:
                 return
             if conn.family == socket.AF_INET:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            from byteps_tpu.comm.shaping import maybe_shape
+
+            conn = maybe_shape(conn)  # response direction of a shaped link
             t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
+        try:
+            self._serve_conn_loop(conn, send_lock)
+        finally:
+            # close on every exit path: a plain socket would be GC'd, but
+            # a ShapedSocket is pinned by its delivery thread until
+            # close() — without this every shaped connection leaks a
+            # thread + fd.  Engine threads racing a late response into
+            # the closed conn already tolerate the OSError.
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_conn_loop(self, conn: socket.socket, send_lock) -> None:
         try:
             while not self._stop.is_set():
                 msg = recv_message(conn)
@@ -688,6 +705,14 @@ class NativePSServer:
     def __init__(self, cfg: Config, host: str = "127.0.0.1") -> None:
         import os as _os
 
+        from byteps_tpu.comm.shaping import shaping_enabled, warn_native_bypass_once
+
+        if shaping_enabled():
+            # directly-constructed native server under shaping env: honor
+            # the explicit choice but say the link will be half-shaped
+            warn_native_bypass_once(
+                "NativePSServer responses bypass the shaper (half-shaped link)"
+            )
         van = _os.environ.get("BYTEPS_VAN", "tcp")
         if van not in ("tcp", "uds", "shm"):
             raise RuntimeError(
@@ -795,7 +820,17 @@ def run_server() -> None:
     elif cfg.role == "server":
         import os
 
-        if os.environ.get("BYTEPS_SERVER_NATIVE", "0") == "1":
+        from byteps_tpu.comm.shaping import shaping_enabled, warn_native_bypass_once
+
+        if os.environ.get("BYTEPS_SERVER_NATIVE", "0") == "1" and shaping_enabled():
+            # same gate as the client side: the C++ engine's response
+            # direction would bypass the shaper, yielding a half-shaped
+            # link that "measures" a DCN that exists one way only
+            warn_native_bypass_once(
+                "ignoring BYTEPS_SERVER_NATIVE=1, using the Python engine"
+            )
+            srv = PSServer(cfg, host=cfg.node_host or "127.0.0.1")
+        elif os.environ.get("BYTEPS_SERVER_NATIVE", "0") == "1":
             srv = NativePSServer(cfg, host=cfg.node_host or "127.0.0.1")
         else:
             srv = PSServer(cfg, host=cfg.node_host or "127.0.0.1")

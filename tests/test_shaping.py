@@ -1,0 +1,210 @@
+"""Link shaping (comm/shaping.py): the DCN-emulation knob.
+
+Lower-bound timing asserts only — on the shared 1-core CI box an upper
+bound on wall time flakes, but "shaping added at least its configured
+cost" cannot be broken by contention.  Two deliberate exceptions carry
+multi-hundred-ms slack and are marked inline.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.comm.shaping import ShapedSocket, maybe_shape, shaping_params
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+class TestShapedSocket:
+    def test_rate_limits_throughput(self):
+        a, b = _pair()
+        # 2 MB at 100 MB/s ⇒ ≥ 20ms of serialization
+        shaped = ShapedSocket(a, delay_s=0.0, rate_bps=100e6, buf_bytes=1 << 22)
+        payload = b"x" * (2 << 20)
+        got = bytearray()
+
+        def rx():
+            while len(got) < len(payload):
+                chunk = b.recv(1 << 20)
+                if not chunk:
+                    return
+                got.extend(chunk)
+
+        t = threading.Thread(target=rx, daemon=True)
+        t.start()
+        t0 = time.monotonic()
+        shaped.sendall(payload)
+        t.join(timeout=10)
+        elapsed = time.monotonic() - t0
+        assert bytes(got) == payload
+        assert elapsed >= 0.016  # 80% of the 20ms serialization time
+        shaped.close()
+        b.close()
+
+    def test_delay_is_pipelined_not_blocking(self):
+        """Propagation delay postpones delivery but does NOT occupy the
+        sender: sendall returns immediately (the message rides the
+        virtual wire) and the receiver sees it one delay later."""
+        a, b = _pair()
+        shaped = ShapedSocket(a, delay_s=1.0, rate_bps=0.0, buf_bytes=1 << 20)
+        t0 = time.monotonic()
+        shaped.sendall(b"ping")
+        send_cost = time.monotonic() - t0
+        # the one deliberate upper bound in this file: enqueue-only sendall
+        # vs a 1s delay, with 0.5s of contention slack — if this flakes the
+        # sender really did sleep the propagation delay
+        assert send_cost < 0.5
+        b.settimeout(10)
+        data = b.recv(16)
+        arrival = time.monotonic() - t0
+        assert data == b"ping"
+        assert arrival >= 0.8  # 80% of the 1s propagation delay
+        shaped.close()
+        b.close()
+
+    def test_fifo_order_preserved(self):
+        a, b = _pair()
+        shaped = ShapedSocket(a, delay_s=0.005, rate_bps=500e6, buf_bytes=1 << 22)
+        msgs = [bytes([i]) * (1 + (i * 37) % 1000) for i in range(32)]
+        for m in msgs:
+            shaped.sendall(m)
+        want = b"".join(msgs)
+        got = bytearray()
+        b.settimeout(10)
+        while len(got) < len(want):
+            got.extend(b.recv(1 << 16))
+        assert bytes(got) == want
+        shaped.close()
+        b.close()
+
+    def test_backpressure_blocks_at_buffer_limit(self):
+        """Once buf_bytes are in flight the sender blocks — the kernel
+        socket-buffer analogue the scheduler benchmark relies on."""
+        a, b = _pair()
+        shaped = ShapedSocket(a, delay_s=0.0, rate_bps=10e6, buf_bytes=64 << 10)
+        drained = bytearray()
+
+        def rx():
+            b.settimeout(10)
+            while len(drained) < (1 << 20):
+                try:
+                    drained.extend(b.recv(1 << 16))
+                except OSError:
+                    return
+
+        t = threading.Thread(target=rx, daemon=True)
+        t.start()
+        t0 = time.monotonic()
+        for _ in range(16):  # 1 MB total at 10 MB/s ⇒ ≥ ~100ms serialized
+            shaped.sendall(b"z" * (64 << 10))
+        elapsed = time.monotonic() - t0
+        # all but the last buffer's worth must have waited for the wire
+        assert elapsed >= 0.07
+        t.join(timeout=10)
+        assert len(drained) == 1 << 20
+        shaped.close()
+        b.close()
+
+    def test_throughput_governed_by_rate_not_buffer_over_delay(self):
+        """Propagation delay must not occupy shaping-buffer space: with
+        rate 50 MB/s, delay 100ms, buf 256KB, pushing 2MB is
+        serialization-bound (~40ms + 100ms).  If buffered bytes were
+        held until *delivery*, throughput would cap at buf/delay =
+        2.56 MB/s and this send would take >0.8s."""
+        a, b = _pair()
+        shaped = ShapedSocket(a, delay_s=0.1, rate_bps=50e6, buf_bytes=256 << 10)
+        total = 2 << 20
+        got = bytearray()
+
+        def rx():
+            b.settimeout(10)
+            while len(got) < total:
+                got.extend(b.recv(1 << 16))
+
+        t = threading.Thread(target=rx, daemon=True)
+        t.start()
+        t0 = time.monotonic()
+        for _ in range(32):
+            shaped.sendall(b"q" * (64 << 10))
+        sender_done = time.monotonic() - t0
+        t.join(timeout=10)
+        assert len(got) == total
+        # second deliberate upper bound (≥4x slack vs the ~0.9s bug mode)
+        assert sender_done < 0.6, f"throughput capped by buf/delay: {sender_done:.3f}s"
+        shaped.close()
+        b.close()
+
+    def test_send_error_surfaces_to_caller(self):
+        a, b = _pair()
+        shaped = ShapedSocket(a, delay_s=0.01, rate_bps=0.0, buf_bytes=1 << 20)
+        b.close()
+        shaped.sendall(b"doomed " * 100000)  # delivery fails in the thread
+        with pytest.raises(ConnectionError):
+            for _ in range(200):
+                shaped.sendall(b"next")
+                time.sleep(0.005)
+        shaped.close()
+
+    def test_maybe_shape_disabled_is_identity(self, monkeypatch):
+        monkeypatch.delenv("BYTEPS_VAN_DELAY_MS", raising=False)
+        monkeypatch.delenv("BYTEPS_VAN_RATE_MBPS", raising=False)
+        a, b = _pair()
+        assert maybe_shape(a) is a
+        a.close()
+        b.close()
+
+    def test_params_parse(self, monkeypatch):
+        monkeypatch.setenv("BYTEPS_VAN_DELAY_MS", "2.5")
+        monkeypatch.setenv("BYTEPS_VAN_RATE_MBPS", "100")
+        delay_s, rate_bps, buf = shaping_params()
+        assert delay_s == pytest.approx(0.0025)
+        assert rate_bps == pytest.approx(100e6)
+        assert buf == 256 * 1024
+
+
+class TestShapedCluster:
+    def test_push_pull_correct_and_delayed_through_shaped_van(self, monkeypatch):
+        """Full PS path over a shaped tcp van: results stay exact and a
+        round-trip costs at least the configured 2×delay."""
+        monkeypatch.setenv("BYTEPS_VAN_DELAY_MS", "40")
+        monkeypatch.setenv("BYTEPS_VAN_RATE_MBPS", "500")
+        # shaping must override the native client (which would silently
+        # bypass the shaped Python lanes) — the rtt floor below proves it
+        monkeypatch.setenv("BYTEPS_NATIVE_CLIENT", "1")
+        from byteps_tpu.common.config import Config
+        from byteps_tpu.comm.rendezvous import Scheduler
+        from byteps_tpu.server.server import PSServer
+
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+        try:
+            import byteps_tpu as bps
+
+            bps.init()
+            x = np.arange(256, dtype=np.float32)
+            out = bps.push_pull(x, name="shaped.t")  # includes init round
+            np.testing.assert_allclose(np.asarray(out), x)
+            t0 = time.monotonic()
+            out = bps.push_pull(x + 1, name="shaped.t")
+            rtt = time.monotonic() - t0
+            np.testing.assert_allclose(np.asarray(out), x + 1)
+            # push (40ms) + pull response (40ms), 80% margin
+            assert rtt >= 0.064, f"shaped round-trip too fast: {rtt:.4f}s"
+            bps.shutdown()
+        finally:
+            srv.stop()
+            sched.stop()
