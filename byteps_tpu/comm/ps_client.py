@@ -41,8 +41,20 @@ _ZERO_COPIED = object()
 
 class _ServerConn:
     def __init__(self, host: str, port: int, streams: int = 1) -> None:
-        from byteps_tpu.comm.shaping import maybe_shape
+        from byteps_tpu.comm.shaping import (
+            maybe_shape,
+            shaping_enabled,
+            warn_native_bypass_once,
+        )
 
+        if streams > 1 and shaping_enabled():
+            # each stripe would get its OWN virtual wire, silently scaling
+            # the emulated link to N x BYTEPS_VAN_RATE_MBPS — a shaped
+            # link models one wire, so striping is forced off
+            warn_native_bypass_once(
+                "ignoring BYTEPS_TCP_STREAMS>1 (a shaped link is one wire)"
+            )
+            streams = 1
         # data-plane link: shaped when BYTEPS_VAN_DELAY_MS /
         # BYTEPS_VAN_RATE_MBPS emulate a DCN link (shaping.py)
         self.sock = maybe_shape(connect(host, port))

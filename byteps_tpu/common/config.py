@@ -67,6 +67,10 @@ class Config:
     # --- pipeline tuning ---
     partition_bytes: int = 4096000  # BYTEPS_PARTITION_BYTES (global.cc:42,134)
     scheduling_credit: int = 0  # BYTEPS_SCHEDULING_CREDIT (scheduled_queue.cc:35); 0 = unlimited
+    # queue discipline: "priority" = (priority desc, key asc) — the OSDI'20
+    # scheduler; "fifo" = strict arrival order, the ablation baseline
+    # (equivalent to the reference built without scheduling)
+    scheduling: str = "priority"  # BYTEPS_SCHEDULING
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES (global.cc:43,137)
     threadpool_size: int = 4  # BYTEPS_THREADPOOL_SIZE (global.cc:216)
 
@@ -148,6 +152,7 @@ class Config:
             ),
             partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4096000),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
+            scheduling=os.environ.get("BYTEPS_SCHEDULING", "priority"),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             threadpool_size=_env_int("BYTEPS_THREADPOOL_SIZE", 4),
             key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),

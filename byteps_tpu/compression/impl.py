@@ -111,9 +111,7 @@ class RandomKCompressor(Compressor):
             ln = lib.bps_randomk_compress(_ptr(grad), n, k, self.s0, self.s1, _ptr(out))
             return out[:ln].tobytes()
         rng = XorShift128Plus(self.s0, self.s1)
-        idx = np.fromiter(
-            (rng.next() % n for _ in range(k)), dtype=np.int64, count=k
-        ).astype(np.int32)
+        idx = (rng.fill(k) % np.uint64(n)).astype(np.int32)
         rec = np.empty(k, dtype=[("i", "<i4"), ("v", "<f4")])
         rec["i"] = idx
         rec["v"] = grad[idx]
@@ -157,7 +155,7 @@ class DitheringCompressor(Compressor):
         if norm == 0.0:
             norm = 1.0
         rng = XorShift128Plus(self.s0, self.s1)
-        u = np.fromiter((rng.uniform() for _ in range(n)), dtype=np.float64, count=n)
+        u = rng.uniform_fill(n)
         s = self.s
         p = np.abs(grad.astype(np.float64)) / norm
         if self.natural:

@@ -77,53 +77,70 @@ def translate_compression_params(params: Optional[Dict]) -> Dict[str, str]:
     return out
 
 
+def parse_codec_config(kwargs: Dict[str, str], size: int) -> Optional[Dict]:
+    """Normalize a declared tensor's compression kwargs.
+
+    THE single parser of the byteps_* keys and their user-facing aliases
+    — shared by :func:`create_compressor` (host chains, worker + server)
+    and :func:`byteps_tpu.core.device_codec.device_codec_for` (device
+    adapters), so the two factories can never drift on what a config
+    means.  Returns None when no compressor is configured."""
+    kwargs = {str(k): str(v) for k, v in kwargs.items()}
+    ctype = kwargs.get("byteps_compressor_type") or kwargs.get("compressor")
+    if not ctype:
+        return None
+    return {
+        "ctype": ctype,
+        "seed": int(float(kwargs.get("byteps_seed", kwargs.get("seed", "0")))),
+        "k": _parse_k(kwargs, size),
+        "scaling": kwargs.get(
+            "byteps_compressor_onebit_scaling", kwargs.get("scaling", "False")
+        ).lower() in ("true", "1"),
+        "natural": kwargs.get("byteps_dithering_partition", "0")
+        in ("1", "natural"),
+        "l2": kwargs.get("byteps_dithering_normalize", "0") in ("1", "l2"),
+        "ef": kwargs.get("byteps_ef_type") or kwargs.get("ef") or "",
+        "momentum": kwargs.get("byteps_momentum_type")
+        or kwargs.get("momentum") or "",
+        "momentum_mu": float(kwargs.get("byteps_momentum_mu", "0.9")),
+    }
+
+
 def create_compressor(
     kwargs: Dict[str, str], size: int, server: bool = False
 ) -> Optional[Compressor]:
     """Build the decorator chain for a declared tensor; None when no
     compressor is configured."""
-    kwargs = {str(k): str(v) for k, v in kwargs.items()}
-    ctype = kwargs.get("byteps_compressor_type") or kwargs.get("compressor")
-    if not ctype:
+    cfg = parse_codec_config(kwargs, size)
+    if cfg is None:
         return None
-    seed = int(float(kwargs.get("byteps_seed", kwargs.get("seed", "0"))))
+    ctype = cfg["ctype"]
 
     if ctype == "onebit":
-        scaling = kwargs.get(
-            "byteps_compressor_onebit_scaling", kwargs.get("scaling", "False")
-        ).lower() in ("true", "1")
-        codec: Compressor = OneBitCompressor(size, scaling=scaling)
+        codec: Compressor = OneBitCompressor(size, scaling=cfg["scaling"])
     elif ctype == "topk":
-        codec = TopKCompressor(size, _parse_k(kwargs, size))
+        codec = TopKCompressor(size, cfg["k"])
     elif ctype == "randomk":
-        codec = RandomKCompressor(size, _parse_k(kwargs, size), seed=seed)
+        codec = RandomKCompressor(size, cfg["k"], seed=cfg["seed"])
     elif ctype == "dithering":
         codec = DitheringCompressor(
             size,
-            k=_parse_k(kwargs, size),
-            partition="natural"
-            if kwargs.get("byteps_dithering_partition", "0") in ("1", "natural")
-            else "linear",
-            normalize="l2"
-            if kwargs.get("byteps_dithering_normalize", "0") in ("1", "l2")
-            else "max",
-            seed=seed,
+            k=cfg["k"],
+            partition="natural" if cfg["natural"] else "linear",
+            normalize="l2" if cfg["l2"] else "max",
+            seed=cfg["seed"],
         )
     else:
         raise ValueError(f"unknown compressor type {ctype!r}")
 
-    ef = kwargs.get("byteps_ef_type") or kwargs.get("ef")
-    if ef:
-        if ef != "vanilla":
-            raise ValueError(f"unknown error-feedback type {ef!r}")
+    if cfg["ef"]:
+        if cfg["ef"] != "vanilla":
+            raise ValueError(f"unknown error-feedback type {cfg['ef']!r}")
         codec = VanillaErrorFeedback(codec)
 
-    if not server:
-        mom = kwargs.get("byteps_momentum_type") or kwargs.get("momentum")
-        if mom:
-            if mom != "nesterov":
-                raise ValueError(f"unknown momentum type {mom!r}")
-            mu = float(kwargs.get("byteps_momentum_mu", "0.9"))
-            codec = NesterovMomentum(codec, mu=mu)
+    if not server and cfg["momentum"]:
+        if cfg["momentum"] != "nesterov":
+            raise ValueError(f"unknown momentum type {cfg['momentum']!r}")
+        codec = NesterovMomentum(codec, mu=cfg["momentum_mu"])
 
     return codec

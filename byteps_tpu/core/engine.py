@@ -49,11 +49,12 @@ class _Job:
     __slots__ = (
         "name", "ctx", "flat", "result", "dtype_id", "average", "handle",
         "pending", "lock", "shape", "np_dtype", "is_jax", "version", "t0",
-        "rowsparse",
+        "rowsparse", "device_parts",
     )
 
     def __init__(self, name, ctx, flat, result, dtype_id, average, handle,
-                 pending, shape, np_dtype, is_jax, version, rowsparse=None):
+                 pending, shape, np_dtype, is_jax, version, rowsparse=None,
+                 device_parts=None):
         self.name = name
         self.ctx = ctx
         self.flat = flat
@@ -71,6 +72,10 @@ class _Job:
         # row-sparse jobs: {"push_payload": bytes, "pull_req": bytes}
         # (kRowSparsePushPull, common.h:267-271)
         self.rowsparse = rowsparse
+        # device-codec jobs: offset → decoded jax.Array per partition;
+        # assembled on DEVICE in _finalize (the result never round-trips
+        # through the host uncompressed)
+        self.device_parts = device_parts
 
 
 class _StripedStage:
@@ -130,24 +135,32 @@ class PipelineEngine:
         # the allowance.
         self._push_ready = ReadyTable(ready_count=1, name="push")
         self._seeded: set = set()  # keys whose gate this engine has seeded
+        disc = cfg.scheduling
         self.queues: Dict[QueueType, Any] = {
-            QueueType.COPYD2H: ScheduledQueue(QueueType.COPYD2H),
+            QueueType.COPYD2H: ScheduledQueue(QueueType.COPYD2H, discipline=disc),
             QueueType.COMPRESS: _StripedStage(QueueType.COMPRESS, pool),
             QueueType.PUSH: ScheduledQueue(
                 QueueType.PUSH,
                 credit_bytes=credit,
                 ready_table=self._push_ready,
                 version_gated=True,
+                discipline=disc,
             ),
-            QueueType.PULL: ScheduledQueue(QueueType.PULL),
+            QueueType.PULL: ScheduledQueue(QueueType.PULL, discipline=disc),
             QueueType.DECOMPRESS: _StripedStage(QueueType.DECOMPRESS, pool),
-            QueueType.COPYH2D: ScheduledQueue(QueueType.COPYH2D),
+            QueueType.COPYH2D: ScheduledQueue(QueueType.COPYH2D, discipline=disc),
         }
         self._threads: List[threading.Thread] = []
         self._init_lock = threading.Lock()
         # per-key stateful codec chains (per-partition compressor
         # instantiation, operations.cc:283-414)
         self._compressors: Dict[int, object] = {}
+        # per-key DEVICE codec adapters (core/device_codec.py): for bare
+        # codec chains on jax inputs, COMPRESS runs on-device BEFORE the
+        # D2H so the host boundary moves the compressed payload — the
+        # inversion of the reference's CPU-post-staging compress
+        # (core_loops.cc:498-536; SURVEY §7's genuine TPU improvement)
+        self._device_codecs: Dict[int, object] = {}
         self._compression_lr: float = 1.0
         self._lr_sent_to_servers: float = 1.0
 
@@ -242,11 +255,21 @@ class PipelineEngine:
             self._maybe_setup_compression(ctx, np_dtype, flat.size * np_dtype.itemsize)
 
         self._prepare_round(ctx, dtype_id, flat.size, build_partitions, on_first_init)
-        result = np.empty(flat.shape, dtype=np_dtype)
+        # jax input + bare codec chain ⇒ the device path: compress before
+        # D2H, decode after H2D, assemble the result on device — no host
+        # result buffer is ever written, so don't allocate one (the whole
+        # point is that the gradient never exists uncompressed on host)
+        on_device = (
+            is_jax
+            and bool(ctx.partitions)
+            and all(p.key in self._device_codecs for p in ctx.partitions)
+        )
+        result = None if on_device else np.empty(flat.shape, dtype=np_dtype)
         job = _Job(
             name, ctx, flat, result, dtype_id, average, handle,
             pending=len(ctx.partitions), shape=np.shape(tensor),
             np_dtype=np_dtype, is_jax=is_jax, version=ctx.version,
+            device_parts={} if on_device else None,
         )
         compressed = ctx.partitions and ctx.partitions[0].key in self._compressors
         stages = self.STAGES_COMPRESSED if compressed else self.STAGES
@@ -410,6 +433,11 @@ class PipelineEngine:
             # a chain created after set_compression_lr must still honor it
             self._apply_lr_to_chain(codec, self._compression_lr)
             self.client.register_compressor(part.key, ctx.kwargs)
+            from byteps_tpu.core.device_codec import device_codec_for
+
+            dc = device_codec_for(ctx.kwargs, part.length)
+            if dc is not None:
+                self._device_codecs[part.key] = dc
         self._maybe_send_lr()
 
     def _reship_compressors(self, ctx) -> None:
@@ -467,7 +495,15 @@ class PipelineEngine:
             # core_loops.cc:37-67) — the race-diagnosis tool
             from byteps_tpu.common import logging as bpslog
 
-            if finished in (QueueType.DECOMPRESS, QueueType.COPYH2D) or (
+            if job.device_parts is not None and finished in (
+                QueueType.DECOMPRESS, QueueType.COPYH2D,
+            ):
+                # device-codec jobs never write job.result — the decoded
+                # partition lives on device; sample it (device_get) rather
+                # than the uninitialized host buffer
+                part = job.device_parts.get(task.offset)
+                buf = None if part is None else np.asarray(part)
+            elif finished in (QueueType.DECOMPRESS, QueueType.COPYH2D) or (
                 finished == QueueType.PULL and task.compressed is None
             ):
                 # pull-side stages: sample what came BACK.  For compressed
@@ -535,6 +571,18 @@ class PipelineEngine:
         torch/ops.cc:78-91), reshape, hand back."""
         from byteps_tpu.core.state import get_state
 
+        if job.device_parts is not None:
+            # device-codec path: partitions were decoded ON device — the
+            # assembly (concat/average/reshape) stays there too, so the
+            # aggregated gradient never exists uncompressed on the host
+            import jax.numpy as jnp
+
+            parts = [job.device_parts[off] for off in sorted(job.device_parts)]
+            out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            if job.average:
+                out = out / self.client.num_workers
+            get_state().handles.mark_done(job.handle, out.reshape(job.shape))
+            return
         out = job.result
         if job.average and np.issubdtype(job.np_dtype, np.floating):
             out = out / self.client.num_workers
@@ -555,8 +603,19 @@ class PipelineEngine:
         THIS stage thread, one partition at a time, so the PUSH thread is
         already sending early partitions over DCN while later partitions
         are still coming off the device (and while the caller's next jitted
-        step runs).  numpy inputs take a zero-copy slice view."""
+        step runs).  numpy inputs take a zero-copy slice view.
+
+        Device-codec jobs invert the reference's order (compress AFTER
+        staging, core_loops.cc:498-536): the Pallas/jnp packer runs on the
+        DEVICE slice first, and what crosses the device→host boundary here
+        is the compressed payload — 32× less for onebit."""
         job: _Job = task.context
+        if job.device_parts is not None:
+            dc = self._device_codecs[task.key]
+            sl = job.flat[task.offset : task.offset + task.length]
+            task.compressed = dc.compress(sl)  # D2H of the packed payload
+            self._proceed(task)
+            return
         sl = job.flat[task.offset : task.offset + task.length]
         task.cpubuff = sl if isinstance(sl, np.ndarray) else np.asarray(sl)
         self._proceed(task)
@@ -567,6 +626,11 @@ class PipelineEngine:
         _StripedStage) pins each key to one thread, so a key's stateful
         EF/momentum buffers never race across rounds while different keys
         compress in parallel."""
+        if task.compressed is not None:
+            # already packed on device in COPYD2H; stage is a pass-through
+            # so traces keep the reference pipeline shape
+            self._proceed(task)
+            return
         codec = self._compressors[task.key]
         task.compressed = codec.compress(task.cpubuff)
         self._proceed(task)
@@ -670,8 +734,19 @@ class PipelineEngine:
 
     def _decompress_once(self, task: TensorTableEntry) -> None:
         """DECOMPRESS stage: decode the pulled merged payload
-        (core_loops.cc:620-648)."""
+        (core_loops.cc:620-648).
+
+        Device-codec jobs decode on DEVICE: the compressed payload is what
+        crosses host→device (jnp.asarray inside the adapter), and the
+        decoded partition stays on device for _finalize's assembly."""
         job: _Job = task.context
+        if job.device_parts is not None:
+            dc = self._device_codecs[task.key]
+            part = dc.decompress(task.compressed, task.length)
+            with job.lock:
+                job.device_parts[task.offset] = part
+            self._proceed(task)
+            return
         codec = self._compressors[task.key]
         arr = codec.decompress(task.compressed, task.length)
         job.result[task.offset : task.offset + task.length] = arr[: task.length]

@@ -33,7 +33,16 @@ class ScheduledQueue:
         ready_table: Optional[ReadyTable] = None,
         itemsize: int = 4,
         version_gated: bool = False,
+        discipline: str = "priority",
     ) -> None:
+        if discipline not in ("priority", "fifo"):
+            raise ValueError(
+                f"BYTEPS_SCHEDULING={discipline!r} unknown; use priority|fifo"
+            )
+        #: "fifo" = strict arrival order — the ablation baseline proving the
+        #: priority scheduler's wall-clock win (OVERLAP artifact); matches a
+        #: reference build with scheduling disabled
+        self.discipline = discipline
         self.queue_type = queue_type
         self.credit_enabled = credit_bytes > 0
         self._credits = credit_bytes
@@ -57,10 +66,15 @@ class ScheduledQueue:
         import bisect
 
         with self._cv:
-            # (priority desc, key asc) — scheduled_queue.cc:82-102;
-            # bisect keeps insertion O(log n) compare + O(n) shift instead
-            # of re-sorting the whole queue per task
-            bisect.insort(self._tasks, task, key=lambda t: (-t.priority, t.key))
+            if self.discipline == "fifo":
+                self._tasks.append(task)
+            else:
+                # (priority desc, key asc) — scheduled_queue.cc:82-102;
+                # bisect keeps insertion O(log n) compare + O(n) shift
+                # instead of re-sorting the whole queue per task
+                bisect.insort(
+                    self._tasks, task, key=lambda t: (-t.priority, t.key)
+                )
             self._cv.notify_all()
 
     def _eligible(self, task: TensorTableEntry) -> bool:

@@ -589,8 +589,30 @@ def _local_loss(cfg: TransformerConfig, mesh: Mesh, params, tokens, targets):
 # ---------------------------------------------------------------------------
 
 
+def validate_mesh(cfg: TransformerConfig, mesh: Mesh) -> None:
+    """Config×mesh checks that can only run once the mesh is known.
+
+    wq is tp-sharded on the query-head dim and wk/wv on the KV-head dim,
+    so both head counts must divide tp — otherwise the failure surfaces
+    later as an opaque shard_map/NamedSharding error instead of naming
+    the bad config (ADVICE r4)."""
+    tp = mesh.shape.get("tp", 1)
+    if cfg.n_heads % tp:
+        raise ValueError(
+            f"n_heads {cfg.n_heads} not divisible by tp={tp}: wq is "
+            "tp-sharded on the head dim"
+        )
+    if cfg.kv_heads % tp:
+        raise ValueError(
+            f"n_kv_heads {cfg.kv_heads} not divisible by tp={tp}: wk/wv "
+            "are tp-sharded on the KV-head dim — use more KV heads or a "
+            "smaller tp axis (GQA groups cannot span tp shards)"
+        )
+
+
 def shard_params(params: Dict[str, np.ndarray], cfg: TransformerConfig, mesh: Mesh):
     """device_put the host params with their NamedShardings."""
+    validate_mesh(cfg, mesh)
     specs = param_specs(cfg)
     return {
         k: jax.device_put(v, NamedSharding(mesh, specs[k]))
@@ -603,6 +625,7 @@ def build_forward(cfg: TransformerConfig, mesh: Mesh) -> Callable:
 
     Single-chip friendly: with a 1-device mesh all collectives degenerate.
     """
+    validate_mesh(cfg, mesh)
     specs = param_specs(cfg)
     pp = mesh.shape.get("pp", 1)
 
@@ -922,6 +945,7 @@ def build_train_step(
     reference, generalized to a 4-D mesh).  The optimizer update runs on
     the sharded views under GSPMD propagation outside the shard_map.
     """
+    validate_mesh(cfg, mesh)
     specs = param_specs(cfg)
 
     def loss_and_grad(params, tokens, targets):

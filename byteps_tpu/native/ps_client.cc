@@ -241,6 +241,15 @@ struct NativeClient {
       }
       cb(cb_ctx, h.op, h.status, h.flags, seq, key, ntohl(h.cmd),
          ntohl(h.version), payload, len, zc);
+      // a rare oversized non-zero-copy response must not pin its high-
+      // water mark per lane for the connection's lifetime (ADVICE r4):
+      // the callback consumed the payload synchronously, so release the
+      // scratch now (the common big-payload path is zero-copy and never
+      // touches scratch at all)
+      constexpr size_t kScratchKeep = size_t(1) << 20;
+      if (scratch.capacity() > kScratchKeep) {
+        std::vector<uint8_t>().swap(scratch);
+      }
     }
     lane_exit();
   }

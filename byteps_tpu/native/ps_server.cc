@@ -409,6 +409,11 @@ struct ShmConn : Conn {
     setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv0, sizeof(tv0));
     if (!rx.open_path(names[0].c_str()) || !tx.open_path(names[1].c_str())) {
       NDBG("shm handshake: ring open failed (%s / %s)", names[0].c_str(), names[1].c_str());
+      // unlink on the failure path too: once the names arrived the files
+      // are ours to reap — the client's own mapping stays alive, but a
+      // half-open here would otherwise leak 2x16MB in /dev/shm until
+      // client-process cleanup (ADVICE r4)
+      for (auto& name : names) ::unlink(name.c_str());
       return false;
     }
     for (auto& name : names) ::unlink(name.c_str());

@@ -221,3 +221,4 @@ def broadcast_object(obj: Any, root_rank: int = 0, name: str = "obj") -> Any:
 
 
 from byteps_tpu.torch import parallel  # noqa: E402,F401  (bps.parallel.DistributedDataParallel)
+from byteps_tpu.torch.cross_barrier import CrossBarrier  # noqa: E402,F401

@@ -1,0 +1,156 @@
+"""Cross-barrier pipelined optimizer for torch modules.
+
+Parity with the reference's ``byteps/torch/cross_barrier.py:28-382``: the
+per-step global barrier between backward and the optimizer is removed —
+
+- a post-accumulate-grad hook on every parameter launches one async
+  push_pull the moment that gradient materializes during backward
+  (priority = −declaration order, so FRONT-layer gradients are
+  communicated first — the OSDI'20 scheduling insight),
+- a forward *pre*-hook on every parameterized module blocks only until
+  THAT module's gradients have arrived and its parameters are updated
+  (reference ``_register_forward_hooks``/``pre_forward_hook``), so step
+  N+1's front layers start computing while step N's back-layer
+  gradients are still on the wire.
+
+The per-parameter sgd/adam/rmsprop update math is shared with the
+framework-agnostic ``byteps_tpu.cross_barrier`` implementation (the
+reference re-implements the three optimizers the same way,
+cross_barrier.py:236-382); torch CPU tensors expose zero-copy numpy
+views, so the update runs in numpy and lands in ``p.data`` in place.
+
+    model = Net()
+    opt = bps.torch.CrossBarrier(model, opt_name="sgd", lr=0.1)
+    for x, y in loader:
+        loss = loss_fn(model(x), y)   # pre-hooks wait per-module
+        loss.backward()               # grad hooks launch comm
+    opt.step()                        # final full barrier
+
+Omitting ``opt.step()`` inside the loop is the point: the barrier is
+per-module and implicit in the next forward.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+import torch
+
+from byteps_tpu.api import declare_tensor
+from byteps_tpu.api import push_pull_async as _push_pull_async
+from byteps_tpu.api import synchronize as _synchronize
+from byteps_tpu.cross_barrier import _OPTS
+
+
+class CrossBarrier:
+    """Per-parameter pipelined optimizer over async push_pull handles.
+
+    ``opt_name``: sgd | adam | rmsprop (the three the reference
+    re-implements per-parameter).  ``average=True`` divides the summed
+    gradient by the number of workers before the update.
+    """
+
+    _instances = 0  # PS keys are instance-scoped (GAN / teacher-student)
+
+    def __init__(
+        self,
+        model: torch.nn.Module,
+        opt_name: str = "sgd",
+        average: bool = True,
+        **opt_kwargs,
+    ) -> None:
+        if opt_name not in _OPTS:
+            raise ValueError(
+                f"unsupported optimizer {opt_name!r}; use one of {list(_OPTS)}"
+            )
+        self.model = model
+        self.opt = _OPTS[opt_name](**opt_kwargs)
+        self.average = average
+        self._iid = CrossBarrier._instances
+        CrossBarrier._instances += 1
+
+        named = [(n, p) for n, p in model.named_parameters() if p.requires_grad]
+        #: declaration order: priority = −index ⇒ front layers first
+        self._order: Dict[int, int] = {id(p): i for i, (n, p) in enumerate(named)}
+        self._names: Dict[int, str] = {
+            id(p): f"CrossBarrier.{self._iid}.{n}" for n, p in named
+        }
+        self._params: List[torch.nn.Parameter] = [p for _, p in named]
+        self._handles: Dict[int, int] = {}  # id(p) → engine handle
+        for p in self._params:
+            declare_tensor(self._names[id(p)])
+            p.register_post_accumulate_grad_hook(self._launch)
+        # forward pre-hook per parameterized module: wait for THIS
+        # module's parameters only (reference pre_forward_hook,
+        # cross_barrier.py:188-222)
+        for mod in model.modules():
+            if any(True for _ in mod.parameters(recurse=False)):
+                mod.register_forward_pre_hook(self._pre_forward(mod))
+
+    # --- backward side ----------------------------------------------------
+    def _launch(self, p: torch.nn.Parameter) -> None:
+        pid = id(p)
+        if pid in self._handles:
+            # an unconsumed handle for this param (e.g. two backwards
+            # without a forward): apply it first so nothing is dropped
+            self._wait(p)
+        # COPY the gradient: the engine's numpy path is zero-copy down to
+        # the PUSH sendmsg, so handing it p.grad's own buffer would race
+        # the async send against autograd re-accumulating into (or the
+        # user zeroing) that same buffer — the staging copy the reference
+        # also pays (COPYD2H, core_loops.cc:378-443)
+        grad = p.grad.detach().numpy().reshape(-1).copy()
+        self._handles[pid] = _push_pull_async(
+            grad,
+            name=self._names[pid],
+            average=self.average,
+            priority=-self._order[pid],
+        )
+
+    # --- forward side -----------------------------------------------------
+    def _pre_forward(self, mod: torch.nn.Module):
+        def hook(module, args):
+            for p in mod.parameters(recurse=False):
+                self._wait(p)
+        return hook
+
+    def _wait(self, p: torch.nn.Parameter) -> None:
+        handle = self._handles.pop(id(p), None)
+        if handle is None:
+            return
+        avg = np.asarray(_synchronize(handle), dtype=np.float32)
+        name = self._names[id(p)]
+        with torch.no_grad():
+            # view(-1) (not reshape) so a non-contiguous param fails loudly
+            # instead of silently updating a copy
+            flat = p.data.view(-1).numpy()  # zero-copy CPU view
+            flat[:] = self.opt.update(name, flat, avg)
+            # the applied gradient is consumed: zero it HERE so the next
+            # backward's post-accumulate hook sees a fresh gradient even
+            # when the canonical loop (no zero_grad call) is used —
+            # otherwise torch accumulates and step N pushes a running sum
+            if p.grad is not None:
+                p.grad.zero_()
+
+    # --- barrier ----------------------------------------------------------
+    def step(self) -> None:
+        """Full barrier: apply every outstanding update (what the plain
+        DistributedOptimizer does every step — the ablation baseline)."""
+        for p in self._params:
+            self._wait(p)
+
+    def zero_grad(self) -> None:
+        """Optional — _wait already zeroes each gradient as it applies it,
+        so the canonical loop needs no zero_grad.  When called anyway,
+        outstanding handles are applied first (a drain): zeroing under an
+        in-flight push is never safe to expose."""
+        for p in self._params:
+            self._wait(p)
+            if p.grad is not None:
+                p.grad.detach_()
+                p.grad.zero_()
+
+    def outstanding(self) -> int:
+        """Number of gradients still in flight (test/teardown aid)."""
+        return len(self._handles)

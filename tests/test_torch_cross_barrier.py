@@ -1,0 +1,137 @@
+"""torch CrossBarrier (torch/cross_barrier.py) — the per-module
+pipelined optimizer, reference byteps/torch/cross_barrier.py parity.
+
+Local mode (1 worker ⇒ push_pull identity): training through the
+cross-barrier path must match a plain torch SGD trajectory.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import byteps_tpu as bps
+from byteps_tpu.torch.cross_barrier import CrossBarrier
+
+
+def _mlp(seed=0, width=16, depth=3):
+    torch.manual_seed(seed)
+    layers = []
+    for _ in range(depth):
+        layers += [torch.nn.Linear(width, width), torch.nn.Tanh()]
+    layers.append(torch.nn.Linear(width, 1))
+    return torch.nn.Sequential(*layers)
+
+
+def _batch(seed=1, n=32, width=16):
+    g = torch.Generator().manual_seed(seed)
+    x = torch.randn(n, width, generator=g)
+    y = torch.randn(n, 1, generator=g)
+    return x, y
+
+
+class TestTorchCrossBarrier:
+    def test_sgd_trajectory_matches_plain_torch(self):
+        """3 steps of cross-barrier SGD == 3 steps of torch.optim.SGD on
+        an identical twin model (no barrier in the loop: the next
+        forward's pre-hooks supply the per-module waits)."""
+        bps.init()
+        model = _mlp(seed=7)
+        twin = _mlp(seed=7)
+        opt = CrossBarrier(model, "sgd", lr=0.05)
+        topt = torch.optim.SGD(twin.parameters(), lr=0.05)
+        x, y = _batch()
+        # the canonical loop: NO zero_grad — _wait zeroes each gradient
+        # as it applies it, so nothing accumulates across steps
+        for _ in range(3):
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+
+            tloss = torch.nn.functional.mse_loss(twin(x), y)
+            topt.zero_grad()
+            tloss.backward()
+            topt.step()
+        opt.step()  # final barrier applies the last backward's updates
+        for p, tp in zip(model.parameters(), twin.parameters()):
+            np.testing.assert_allclose(
+                p.detach().numpy(), tp.detach().numpy(), rtol=1e-5, atol=1e-6
+            )
+        assert opt.outstanding() == 0
+        bps.shutdown()
+
+    def test_momentum_and_loss_decreases(self):
+        bps.init()
+        model = _mlp(seed=3)
+        opt = CrossBarrier(model, "sgd", lr=0.05, momentum=0.9)
+        x, y = _batch(seed=5)
+        losses = []
+        for _ in range(12):
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            losses.append(float(loss.detach()))
+            loss.backward()
+        opt.step()
+        assert losses[-1] < losses[0] * 0.93, losses  # steady descent
+        bps.shutdown()
+
+    def test_forward_prehook_consumes_handles(self):
+        """After a backward, handles are outstanding; the next forward
+        alone (no step()) must consume every one via the pre-hooks."""
+        bps.init()
+        model = _mlp(seed=1)
+        opt = CrossBarrier(model, "sgd", lr=0.01)
+        x, y = _batch(seed=2)
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        assert opt.outstanding() == len(list(model.parameters()))
+        model(x)  # forward pre-hooks wait + apply per module
+        assert opt.outstanding() == 0
+        bps.shutdown()
+
+    def test_adam_runs_and_updates(self):
+        bps.init()
+        model = _mlp(seed=2)
+        before = [p.detach().clone() for p in model.parameters()]
+        opt = CrossBarrier(model, "adam", lr=0.01)
+        x, y = _batch(seed=3)
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        changed = [
+            not torch.equal(p.detach(), b)
+            for p, b in zip(model.parameters(), before)
+        ]
+        assert all(changed)
+        bps.shutdown()
+
+    def test_unknown_optimizer_raises(self):
+        with pytest.raises(ValueError, match="unsupported optimizer"):
+            CrossBarrier(_mlp(), "lamb")
+
+
+class TestFifoDiscipline:
+    def test_fifo_queue_pops_arrival_order(self):
+        from byteps_tpu.common.types import QueueType, TensorTableEntry
+        from byteps_tpu.core.scheduler import ScheduledQueue
+
+        q = ScheduledQueue(QueueType.PUSH, discipline="fifo")
+        for i, prio in enumerate([0, -5, 3, -1]):
+            q.add_task(TensorTableEntry(tensor_name=f"t{i}", key=i, priority=prio))
+        got = [q.get_task(timeout=0.1).key for _ in range(4)]
+        assert got == [0, 1, 2, 3]  # arrival order, priorities ignored
+
+    def test_priority_queue_pops_priority_order(self):
+        from byteps_tpu.common.types import QueueType, TensorTableEntry
+        from byteps_tpu.core.scheduler import ScheduledQueue
+
+        q = ScheduledQueue(QueueType.PUSH, discipline="priority")
+        for i, prio in enumerate([0, -5, 3, -1]):
+            q.add_task(TensorTableEntry(tensor_name=f"t{i}", key=i, priority=prio))
+        got = [q.get_task(timeout=0.1).key for _ in range(4)]
+        assert got == [2, 0, 3, 1]  # priority desc
+
+    def test_unknown_discipline_raises(self):
+        from byteps_tpu.common.types import QueueType
+        from byteps_tpu.core.scheduler import ScheduledQueue
+
+        with pytest.raises(ValueError, match="BYTEPS_SCHEDULING"):
+            ScheduledQueue(QueueType.PUSH, discipline="lifo")
