@@ -13,3 +13,13 @@ from byteps_tpu.parallel.mesh_utils import (
     make_training_mesh,
 )
 from byteps_tpu.parallel.ring_attention import ring_attention
+
+
+def __getattr__(name):
+    # lazy: hybrid imports byteps_tpu (the api surface), which imports this
+    # package — a top-level import here would cycle
+    if name == "HybridDataParallel":
+        from byteps_tpu.parallel.hybrid import HybridDataParallel
+
+        return HybridDataParallel
+    raise AttributeError(name)
