@@ -288,7 +288,15 @@ class ShmVan(Van):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(timeout)
         sock.connect(path)
-        size = int(os.environ.get("BYTEPS_SHM_RING_BYTES", str(16 << 20)))
+        # default 512KB (was 16MB): payloads larger than the ring stream
+        # through it with cheap park/kick handoffs, so capacity buys
+        # nothing — while SMALL rings keep the working set in cache/TLB.
+        # Measured (SCALING_r05.json r5_findings.ring_size): the 8w×8srv
+        # cell cycled 64 conns × 2 × 16MB = 2GB of wrap-around pages and
+        # ran at 274 MB/s aggregate; with 512KB rings the same cell runs
+        # at 704 MB/s, and even a single pair moving 8MB payloads is ~8%
+        # faster (2979 vs 2762 MB/s, van_bench).
+        size = int(os.environ.get("BYTEPS_SHM_RING_BYTES", str(512 << 10)))
         created = []
         tx = rx = None
         try:
@@ -308,7 +316,7 @@ class ShmVan(Van):
             sock.settimeout(None)
             return ShmConnection(sock, tx=tx, rx=rx)
         except Exception:
-            # a half-built connection must not orphan 2×16MB in /dev/shm
+            # a half-built connection must not orphan its two rings in /dev/shm
             for ring in (tx, rx):
                 if ring is not None:
                     ring.close()

@@ -411,7 +411,7 @@ struct ShmConn : Conn {
       NDBG("shm handshake: ring open failed (%s / %s)", names[0].c_str(), names[1].c_str());
       // unlink on the failure path too: once the names arrived the files
       // are ours to reap — the client's own mapping stays alive, but a
-      // half-open here would otherwise leak 2x16MB in /dev/shm until
+      // half-open here would otherwise leak both ring files in /dev/shm until
       // client-process cleanup (ADVICE r4)
       for (auto& name : names) ::unlink(name.c_str());
       return false;

@@ -24,3 +24,22 @@ class TestScalingHarness:
         assert 0.1 < rec["value"] < 3.0
         assert "round_time_s" in rec["extra"]
         assert rec["extra"]["round_time_s"]["1"] > 0
+
+    def test_committed_r05_artifact_meets_verdict_bars(self):
+        """SCALING_r05.json (built by tools/run_scaling_r05.sh +
+        assemble_scaling_r05.py on a quiet box) must carry the VERDICT r4
+        #6 done-criteria: native-shm ≥ native-tcp in absolute MB/s at
+        every N, and 8-worker retention ≥ 0.5."""
+        import os
+
+        path = "/root/repo/SCALING_r05.json"
+        assert os.path.exists(path), "SCALING_r05.json not committed"
+        d = json.load(open(path))
+        cells = {c["label"]: c for c in d["configs"]}
+        for topo in ("scaledsrv", "2srv"):
+            shm = cells[f"native-shm-{topo}"]["aggregate_mb_per_s"]
+            tcp = cells[f"native-tcp-{topo}"]["aggregate_mb_per_s"]
+            for n in ("1", "2", "4", "8"):
+                assert shm[n] >= tcp[n], (topo, n, shm[n], tcp[n])
+        assert d["headline"]["retention_8w"] >= 0.5
+        assert cells["native-shm-scaledsrv"]["retention_vs_1w"]["8"] >= 0.5

@@ -1,0 +1,113 @@
+"""Assemble SCALING_r05.json from run_scaling_r05.sh's cell lines."""
+
+import json
+import sys
+
+cells_path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/scaling_r05_cells.jsonl"
+out_path = sys.argv[2] if len(sys.argv) > 2 else "SCALING_r05.json"
+
+cells = {}
+failed = []
+with open(cells_path) as f:
+    for line in f:
+        d = json.loads(line)
+        if d.get("failed"):
+            failed.append(d["label"])
+        else:
+            cells[d["label"]] = d["result"]
+if failed:
+    raise SystemExit(f"refusing to assemble: failed cells {failed} "
+                     f"(see the run log); re-run those cells first")
+
+REQUIRED = ["native-shm-scaledsrv", "native-tcp-scaledsrv",
+            "native-shm-2srv", "native-tcp-2srv",
+            "python-shm-2srv", "python-tcp-2srv"]
+missing = [c for c in REQUIRED if c not in cells]
+if missing:
+    raise SystemExit(f"missing cells: {missing}")
+
+# median the headline cell's samples (by 8-worker aggregate throughput)
+head_labels = ["native-shm-2srv", "native-shm-2srv-rep2", "native-shm-2srv-rep3"]
+head_runs = [cells[x] for x in head_labels if x in cells]
+head_runs.sort(key=lambda r: r["extra"]["aggregate_mb_per_s"]["8"])
+headline = head_runs[len(head_runs) // 2]
+agg8 = [r["extra"]["aggregate_mb_per_s"]["8"] for r in head_runs]
+
+configs = []
+for label in REQUIRED:
+    r = headline if label == "native-shm-2srv" else cells[label]
+    e = r["extra"]
+    configs.append({
+        "label": label,
+        "engine": e["engine"],
+        "van": e["van"],
+        "servers": e["servers"],
+        "aggregate_mb_per_s": e["aggregate_mb_per_s"],
+        "round_time_s": e["round_time_s"],
+        "retention_vs_1w": e["retention"],
+        **({"reps": len(head_runs), "rep_agg8_mb_per_s": agg8}
+           if label == "native-shm-2srv" else {}),
+    })
+
+ret8 = headline["extra"]["retention"]["8"]
+scaled_shm8 = cells["native-shm-scaledsrv"]["extra"]["aggregate_mb_per_s"]["8"]
+out = {
+    "metric": "pushpull_throughput_retention_multiproc",
+    "definition": (
+        "aggregate PS-plane MB/s at N subprocess workers vs 1 worker on a "
+        "1-CPU-core loopback fake cluster (chip watcher paused). N workers "
+        "push N x the bytes on a FIXED cpu budget, so flat (1.0) means the "
+        "protocol adds no superlinear overhead as the cluster grows; on "
+        "real multi-host hardware (per-node CPUs) this lower-bounds the "
+        "reference's scaling-efficiency metric (~90% @ 256 GPUs, "
+        f"README.md:38-46). The headline cell is the median of "
+        f"{len(head_runs)} runs."
+    ),
+    "payload_mbytes_per_worker": 4.0,
+    "rounds": 8,
+    "headline": {
+        "config": "native-shm-2srv (2 fixed servers, 512KB rings)",
+        "retention_8w": ret8,
+        "aggregate_mb_per_s": headline["extra"]["aggregate_mb_per_s"],
+    },
+    "r5_findings": {
+        "ring_size": (
+            "The r4 shm-slower-than-tcp inversion was ring working-set "
+            "size: 16MB/direction rings across 64 worker-server "
+            "connections cycle ~2GB of wrap-around pages through one "
+            "core's cache/TLB. Default now 512KB (BYTEPS_SHM_RING_BYTES): "
+            "the 8w scaled-servers cell went from 274 MB/s (r4) to "
+            f"{scaled_shm8:.0f} MB/s, and even single-pair 8MB bulk "
+            "gained ~8% (2979 vs 2762 MB/s, van_bench). Payloads larger "
+            "than the ring stream through it, so capacity bought nothing."
+        ),
+        "server_topology": (
+            "The remaining superlinear term was server-process count: "
+            "the r4 matrix scaled servers WITH workers (the reference's "
+            "multi-host recommendation), so the 8w cell ran 17 processes "
+            "on one core and paid context-switch + connection overhead "
+            "that grows with the topology. With the per-core-realistic 2 "
+            f"fixed servers the 8w retention is {ret8:.2f} (median of "
+            f"{len(head_runs)}; reps {sorted(agg8)}) vs ~0.5 scaled. On "
+            "real hardware every server has its own CPUs; both shapes "
+            "are recorded."
+        ),
+        "memcpy_bound": (
+            "This box moves 12.8 GB/s single-core memcpy (12.7 GB/s f32 "
+            "sum-into). The 1-worker shm cell already runs at ~80% of "
+            "that bound counting the data plane's byte-moves "
+            "(ring write + ring read + sum + response ring + sink); the "
+            "8w scaled-servers residual is bandwidth-utilization loss to "
+            "context switching across 17 processes, not protocol bytes."
+        ),
+    },
+    "configs": configs,
+    "prior_rounds": {"r4_headline_8w": {
+        "native-shm-scaledsrv": 0.3424, "native-tcp-scaledsrv": 0.5313}},
+}
+with open(out_path, "w") as f:
+    json.dump(out, f, indent=1)
+print(json.dumps({
+    "headline_retention_8w": ret8,
+    "cells": {c["label"]: c["retention_vs_1w"]["8"] for c in configs},
+}))

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-5 scaling matrix (SCALING_r05.json builder). Run with the chip
+# watcher PAUSED — the cells are CPU-budget measurements.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/scaling_r05_cells.jsonl}
+LOG=${OUT%.jsonl}.log
+: > "$OUT"
+: > "$LOG"
+run() {  # run <label> -- args...
+  label=$1; shift
+  [ "$1" = "--" ] && shift
+  echo "[scaling_r05] $label ..." >&2
+  line=$(timeout 500 python tools/scaling_bench.py \
+      --multiproc --workers 1,2,4,8 --rounds 8 "$@" 2>>"$LOG" | tail -1)
+  rc=$?
+  if [ $rc -ne 0 ] || [ -z "$line" ]; then
+    # a dead/hung cell must be VISIBLE, never a silent malformed line:
+    # the assembler refuses flagged cells and names them
+    echo "[scaling_r05] CELL FAILED: $label rc=$rc (stderr in $LOG)" >&2
+    echo "{\"label\": \"$label\", \"failed\": true, \"rc\": $rc}" >> "$OUT"
+    return
+  fi
+  echo "{\"label\": \"$label\", \"result\": $line}" >> "$OUT"
+}
+run native-shm-scaledsrv  -- --native --van shm
+run native-shm-2srv       -- --native --van shm --servers 2
+run native-tcp-scaledsrv  -- --native --van tcp
+run native-tcp-2srv       -- --native --van tcp --servers 2
+run python-shm-2srv       -- --van shm --servers 2
+run python-tcp-2srv       -- --van tcp --servers 2
+# two more samples of the headline cell for a median
+run native-shm-2srv-rep2  -- --native --van shm --servers 2
+run native-shm-2srv-rep3  -- --native --van shm --servers 2
+echo "[scaling_r05] done -> $OUT (stderr: $LOG)" >&2
