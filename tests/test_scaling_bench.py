@@ -32,7 +32,7 @@ class TestScalingHarness:
         every N, and 8-worker retention ≥ 0.5."""
         import os
 
-        path = "/root/repo/SCALING_r05.json"
+        path = os.path.join(os.path.dirname(__file__), "..", "SCALING_r05.json")
         assert os.path.exists(path), "SCALING_r05.json not committed"
         d = json.load(open(path))
         cells = {c["label"]: c for c in d["configs"]}

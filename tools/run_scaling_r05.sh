@@ -11,7 +11,9 @@ run() {  # run <label> -- args...
   label=$1; shift
   [ "$1" = "--" ] && shift
   echo "[scaling_r05] $label ..." >&2
-  line=$(timeout 500 python tools/scaling_bench.py \
+  # pipefail inside the substitution: rc must be python/timeout's exit
+  # status, not tail's (tail exits 0 even when the bench died)
+  line=$(set -o pipefail; timeout 500 python tools/scaling_bench.py \
       --multiproc --workers 1,2,4,8 --rounds 8 "$@" 2>>"$LOG" | tail -1)
   rc=$?
   if [ $rc -ne 0 ] || [ -z "$line" ]; then

@@ -82,6 +82,34 @@ def main() -> None:
             _ = [np.asarray(o) for o in outs]
         return (time.perf_counter() - t0) / rounds
 
+    def run_tf_fused() -> float:
+        import tensorflow as tf
+        from byteps_tpu.tensorflow.ops import push_pull_group_fused
+
+        ts = [tf.constant(g) for g in grads]
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            outs = push_pull_group_fused(ts, names, average=False)
+            _ = [np.asarray(o) for o in outs]
+        return (time.perf_counter() - t0) / rounds
+
+    def run_in_function(fn) -> float:
+        """Keras-real mode: the sync inside ONE tf.function — in-graph
+        ops compile away, py_function host hops remain per call."""
+        import tensorflow as tf
+
+        ts = [tf.constant(g) for g in grads]
+
+        @tf.function
+        def step():
+            return fn(ts, names, average=False)
+
+        _ = [np.asarray(o) for o in step()]  # trace once
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            _ = [np.asarray(o) for o in step()]
+        return (time.perf_counter() - t0) / rounds
+
     # short warmups (tensor declaration, trace caches) — the measured
     # loops amortize any residual cold cost over 30 rounds
     for _ in range(3):
@@ -98,9 +126,15 @@ def main() -> None:
         )]
         [np.asarray(o) for o in bps_tf.push_pull_group(
             warm, names[:2], average=False)]
+        from byteps_tpu.tensorflow.ops import push_pull_group_fused as _ppf
+        [np.asarray(o) for o in _ppf(warm, names[:2], average=False)]
     core_s = run_core()
     per_op_s = run_tf_per_op()
     grouped_s = run_tf_grouped()
+    fused_s = run_tf_fused()
+    from byteps_tpu.tensorflow.ops import push_pull_group_fused as _ppf
+    grouped_fn_s = run_in_function(bps_tf.push_pull_group)
+    fused_fn_s = run_in_function(_ppf)
     bps.shutdown()
 
     print(json.dumps({
@@ -111,13 +145,20 @@ def main() -> None:
         "core_ms": round(core_s * 1e3, 2),
         "tf_per_op_ms": round(per_op_s * 1e3, 2),
         "tf_grouped_ms": round(grouped_s * 1e3, 2),
+        "tf_fused_ms": round(fused_s * 1e3, 2),
+        "tf_grouped_in_function_ms": round(grouped_fn_s * 1e3, 2),
+        "tf_fused_in_function_ms": round(fused_fn_s * 1e3, 2),
         "per_op_overhead_x": round(per_op_s / core_s, 2),
         "grouped_overhead_x": round(grouped_s / core_s, 2),
+        "fused_overhead_x": round(fused_s / core_s, 2),
         "notes": (
             "local mode on the CPU mesh: the reduce is an identity psum, so "
             "deltas are pure wrapping cost; tf-per-op pays one py_function "
             "host hop per tensor, push_pull_group batches all tensors into "
-            "one hop (the mitigation the plugin ships)"
+            "one hop; push_pull_group_fused additionally concats per dtype "
+            "IN-GRAPH so the hop marshals/submits one tensor per dtype — "
+            "the shipped default for the gradient-sync path "
+            "(BYTEPS_TF_FUSION=0 restores per-tensor keys)"
         ),
     }))
 
