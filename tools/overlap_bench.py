@@ -203,6 +203,13 @@ def main() -> None:
              "BYTEPS_PARTITION_BYTES": nopart_bytes},
             dict(barrier_each_step=False),
         ),
+        # every mechanism off at once — what a naive PS worker would do;
+        # full vs none is the compounded value of the whole OSDI stack
+        "none": (
+            {**shaped, "BYTEPS_SCHEDULING": "fifo",
+             "BYTEPS_PARTITION_BYTES": nopart_bytes},
+            dict(barrier_each_step=True),
+        ),
     }
 
     all_steps = {name: [] for name in configs}
@@ -235,6 +242,7 @@ def main() -> None:
         "priority_beats_fifo": med["full"] < med["fifo"],
         "crossbarrier_beats_barrier": med["full"] < med["nobarrier"],
         "partitioning_beats_nopart": med["full"] < med["nopart"],
+        "full_stack_beats_none": med["full"] < med["none"],
     }
     out = {
         "what": "wall-clock training step time, shaped fake cluster "
@@ -249,6 +257,7 @@ def main() -> None:
         "speedup_vs_fifo": med["fifo"] / med["full"],
         "speedup_vs_nobarrier": med["nobarrier"] / med["full"],
         "speedup_vs_nopart": med["nopart"] / med["full"],
+        "speedup_vs_none": med["none"] / med["full"],
         "verdicts": verdicts,
     }
     line = json.dumps(out)

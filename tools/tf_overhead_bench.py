@@ -38,6 +38,7 @@ def main() -> None:
 
     import byteps_tpu as bps
     from byteps_tpu import tensorflow as bps_tf
+    from byteps_tpu.tensorflow.ops import push_pull_group_fused
 
     bps.init()
 
@@ -84,7 +85,6 @@ def main() -> None:
 
     def run_tf_fused() -> float:
         import tensorflow as tf
-        from byteps_tpu.tensorflow.ops import push_pull_group_fused
 
         ts = [tf.constant(g) for g in grads]
         t0 = time.perf_counter()
@@ -126,15 +126,14 @@ def main() -> None:
         )]
         [np.asarray(o) for o in bps_tf.push_pull_group(
             warm, names[:2], average=False)]
-        from byteps_tpu.tensorflow.ops import push_pull_group_fused as _ppf
-        [np.asarray(o) for o in _ppf(warm, names[:2], average=False)]
+        [np.asarray(o) for o in push_pull_group_fused(
+            warm, names[:2], average=False)]
     core_s = run_core()
     per_op_s = run_tf_per_op()
     grouped_s = run_tf_grouped()
     fused_s = run_tf_fused()
-    from byteps_tpu.tensorflow.ops import push_pull_group_fused as _ppf
     grouped_fn_s = run_in_function(bps_tf.push_pull_group)
-    fused_fn_s = run_in_function(_ppf)
+    fused_fn_s = run_in_function(push_pull_group_fused)
     bps.shutdown()
 
     print(json.dumps({
