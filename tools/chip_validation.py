@@ -118,6 +118,54 @@ def check_onebit_device() -> None:
           f"(n={n}, words byte-exact, scale within 1e-6)")
 
 
+def check_device_codec_pipeline() -> None:
+    """r5 item: the ENGINE's device-codec path on real TPU — a jax Array
+    through a fake-cluster push_pull with an onebit config must compress
+    on the chip (Pallas packer) before D2H and decode on-chip after H2D,
+    matching the host-path result on a sibling key."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.server.server import PSServer
+
+    os.environ["BYTEPS_MIN_COMPRESS_BYTES"] = "0"
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    os.environ.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1", "DMLC_PS_ROOT_PORT": str(sched.port),
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    srv = PSServer(Config.from_env())
+    threading.Thread(target=srv.start, daemon=True).start()
+    import byteps_tpu as bps
+
+    bps.init()
+    n = 32 * 1024 * 4  # multiple of 32*1024: the Pallas packer engages
+    x = np.random.default_rng(9).normal(size=n).astype(np.float32)
+    for name in ("chipdc.dev", "chipdc.host"):
+        bps.declare_tensor(
+            name, byteps_compressor_type="onebit",
+            byteps_compressor_onebit_scaling="True",
+        )
+    out_dev = bps.push_pull(jnp.asarray(x), name="chipdc.dev", average=False)
+    out_host = np.asarray(bps.push_pull(x, name="chipdc.host", average=False))
+    assert isinstance(out_dev, jax.Array)
+    from byteps_tpu.core.state import get_state
+
+    assert get_state().engine._device_codecs, "device codec path not engaged"
+    np.testing.assert_allclose(np.asarray(out_dev), out_host, rtol=1e-5, atol=1e-7)
+    bps.shutdown()
+    srv.stop()
+    sched.stop()
+    print(f"engine device-codec pipeline on chip OK (n={n}, "
+          "device payload == host payload result)")
+
+
 def check_decode_throughput() -> None:
     import jax
     import jax.numpy as jnp
@@ -175,6 +223,7 @@ def main() -> int:
     check_flash_forward()
     check_flash_backward()
     check_onebit_device()
+    check_device_codec_pipeline()
     if not args.skip_decode:
         check_decode_throughput()
     print("ALL CHIP VALIDATIONS PASSED — also run: python bench.py")
