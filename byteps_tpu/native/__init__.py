@@ -22,13 +22,24 @@ _LIB_PATH = os.path.join(_DIR, "libbyteps_tpu.so")
 
 #: completion-callback signature of the native worker client
 #: (ps_client.cc bpsc_cb_t): (ctx, op, status, flags, seq, key, cmd,
-#: version, payload_ptr, length, zero_copied)
+#: version, payload_ptr, length, zero_copied).  Since r5 this fires ONLY
+#: as the batched-delivery doorbell (op=-2, other args zero); records
+#: are then pulled in bulk via ``bpsc_drain``.
 BPSC_CALLBACK = ctypes.CFUNCTYPE(
     None,
     ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32,
     ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_int32,
 )
+
+#: DrainRec mirror (ps_client.cc — change both together).  64-bit fields
+#: first so the C struct has no padding holes; one trailing pad int.
+DRAIN_REC_DTYPE = np.dtype([
+    ("key", "<u8"), ("len", "<u8"), ("off", "<u8"),
+    ("op", "<i4"), ("status", "<i4"), ("flags", "<u4"), ("seq", "<u4"),
+    ("cmd", "<u4"), ("version", "<u4"), ("zc", "<i4"), ("_pad", "<i4"),
+])
+assert DRAIN_REC_DTYPE.itemsize == 56
 
 _lib: Optional[ctypes.CDLL] = None
 
@@ -112,6 +123,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bpsc_send.restype = c.c_int32
         lib.bpsc_close.argtypes = [c.c_int64]
         lib.bpsc_close.restype = None
+        if hasattr(lib, "bpsc_drain"):
+            lib.bpsc_drain.argtypes = [
+                c.c_int64, c.c_void_p, c.c_int64, c.c_void_p, c.c_uint64,
+            ]
+            lib.bpsc_drain.restype = c.c_int64
     return lib
 
 

@@ -22,7 +22,7 @@ def _have_native_client() -> bool:
     from byteps_tpu.native import get_lib
 
     lib = get_lib()
-    return lib is not None and hasattr(lib, "bpsc_create")
+    return lib is not None and hasattr(lib, "bpsc_drain")
 
 
 pytestmark = pytest.mark.skipif(
@@ -153,6 +153,36 @@ class TestNativeClientDeath:
             assert fired.is_set(), "alloc on dead conn fires cb(None) at once"
         finally:
             conn.close_all()
+            srv_sock.close()
+
+    def test_close_with_pending_fires_callbacks_not_hangs(self):
+        """close_all while requests are in flight must deliver cb(None)
+        for every pending seq — with batched delivery the doorbell/drain
+        contract dies once bpsc_close removes the handle, so the C++
+        close path flushes the queue through the per-record trampoline
+        (r5 review finding: without it, a _blocking_request waiter at
+        close hangs forever)."""
+        from byteps_tpu.comm.ps_client import _NativeServerConn
+        from byteps_tpu.comm.transport import Message, Op, listen
+
+        srv_sock, port = listen("127.0.0.1", 0)
+        conn = _NativeServerConn("127.0.0.1", port, streams=1)
+        peer, _ = srv_sock.accept()
+        try:
+            results = []
+            evs = [threading.Event(), threading.Event()]
+            s1 = conn.alloc_seq(lambda m: (results.append(m), evs[0].set()))
+            s2 = conn.alloc_seq(lambda m: (results.append(m), evs[1].set()))
+            conn.send_msg(Message(Op.PULL, key=1, seq=s1))
+            conn.send_msg(Message(Op.PULL, key=2, seq=s2))
+            # the fake server never responds; close with both pending
+            conn.close_all()
+            assert evs[0].wait(10) and evs[1].wait(10), \
+                "close must fail pending callbacks, not strand them"
+            assert results == [None, None]
+            assert conn.dead
+        finally:
+            peer.close()
             srv_sock.close()
 
     def test_response_lands_in_sink_zero_copy(self):

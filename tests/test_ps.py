@@ -461,7 +461,7 @@ class TestServerDeath:
             from byteps_tpu.native import get_lib
 
             lib = get_lib()
-            if lib is None or not hasattr(lib, "bpsc_create"):
+            if lib is None or not hasattr(lib, "bpsc_drain"):
                 pytest.skip("native client lib not built")
             monkeypatch.setenv("BYTEPS_NATIVE_CLIENT", "1")
         if server_kind == "native":

@@ -237,7 +237,7 @@ def main() -> None:
         from byteps_tpu.native import get_lib
 
         lib = get_lib()
-        if lib is None or not hasattr(lib, "bpsc_create"):
+        if lib is None or not hasattr(lib, "bpsc_drain"):
             print(json.dumps({"client": "native", "skipped": "lib not built"}))
             clients = [cl for cl in clients if cl != "native"]
     for van in args.vans.split(","):
