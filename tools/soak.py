@@ -1,0 +1,188 @@
+"""Randomized composition soak for the PS plane (bug-finder, not a CI
+test).
+
+Nothing in tests/ composes ALL the moving parts at once: elastic
+suspend/resume with changing server counts, compression (host AND
+device-codec paths), link shaping, partitioning, row-sparse, async
+handles, and priorities — under one engine across many generations.
+This tool does, with a seedable RNG and correctness checks on every
+round (1 worker ⇒ push_pull is identity; any mismatch or hang is a
+found bug).
+
+    python tools/soak.py --seconds 300 [--seed 7] [--shaped]
+
+Exit 0 = survived with all invariants held; any exception/timeout is a
+reproducible failure (seed printed).  The r4 torn-counter and r4
+re-init-cycle bugs are exactly the class this harness hunts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=120.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shaped", action="store_true",
+                    help="run under BYTEPS_VAN_DELAY_MS/RATE shaping")
+    ap.add_argument("--van", default="tcp", choices=["tcp", "uds", "shm"])
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(args.seed)
+    if args.shaped:
+        os.environ["BYTEPS_VAN_DELAY_MS"] = "2"
+        os.environ["BYTEPS_VAN_RATE_MBPS"] = "200"
+    os.environ["BYTEPS_VAN"] = args.van
+    os.environ["BYTEPS_MIN_COMPRESS_BYTES"] = "0"
+    os.environ["BYTEPS_PARTITION_BYTES"] = "4096"
+    os.environ["BYTEPS_HEARTBEAT_INTERVAL"] = "0.2"
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.server.server import PSServer
+
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    os.environ.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(sched.port),
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    servers = [PSServer(Config.from_env())]
+    threading.Thread(target=servers[0].start, daemon=True).start()
+
+    import byteps_tpu as bps
+
+    bps.init()
+    import jax.numpy as jnp
+
+    stats = {"rounds": 0, "resizes": 0, "compressed": 0, "device": 0,
+             "rowsparse": 0, "async": 0}
+    declared: dict = {}
+    t_end = time.monotonic() + args.seconds
+    step = 0
+    try:
+        while time.monotonic() < t_end:
+            step += 1
+            roll = rng.random()
+            if roll < 0.04 and stats["rounds"] > 3:
+                # elastic resize: 1<->2 servers through suspend/resume —
+                # the resuming worker's register carries the new count;
+                # on scale-down the SCHEDULER shutdowns the dropped server
+                want = 2 if len(servers) == 1 else 1
+                bps.suspend()
+                os.environ["DMLC_NUM_SERVER"] = str(want)
+                if want == 2:
+                    # the resuming worker's register announces the new
+                    # topology (and PARKS until server 2 joins) — it must
+                    # reach the scheduler BEFORE the new server dials in,
+                    # or that server is refused as an over-capacity join
+                    rt = threading.Thread(
+                        target=lambda: bps.resume(num_servers=2), daemon=True
+                    )
+                    rt.start()
+                    for _ in range(200):
+                        if sched.num_servers == 2:
+                            break
+                        time.sleep(0.05)
+                    srv = PSServer(Config.from_env())
+                    servers.append(srv)
+                    threading.Thread(target=srv.start, daemon=True).start()
+                    rt.join(30)
+                    if rt.is_alive():
+                        raise RuntimeError("resume parked forever at scale-up")
+                else:
+                    bps.resume(num_servers=1)
+                    dropped = servers.pop()
+                    for _ in range(200):
+                        if dropped._stop.is_set():
+                            break
+                        time.sleep(0.05)
+                stats["resizes"] += 1
+                continue
+            name = f"soak.t{rng.integers(0, 12)}"
+            n = int(rng.integers(64, 6000))
+            if name in declared:
+                n = declared[name]  # size is sticky per name
+            x = rng.normal(size=n).astype(np.float32)
+            kind = rng.random()
+            if name not in declared:
+                if kind < 0.25:
+                    # lossless-at-full-k codec so identity still holds
+                    bps.declare_tensor(
+                        name, byteps_compressor_type="topk",
+                        byteps_compressor_k=str(4096 // 4),
+                    )
+                declared[name] = n
+            if kind < 0.25:
+                stats["compressed"] += 1
+                if rng.random() < 0.4:
+                    stats["device"] += 1
+                    out = bps.push_pull(jnp.asarray(x), name=name, average=False)
+                else:
+                    out = bps.push_pull(x, name=name, average=False)
+                np.testing.assert_allclose(np.asarray(out), x, rtol=1e-5,
+                                           atol=1e-6)
+            elif kind < 0.35:
+                stats["rowsparse"] += 1
+                rows, dim = 40, 8
+                rs_name = f"soak.rs{rng.integers(0, 3)}"
+                idx = np.unique(
+                    rng.integers(0, rows, size=int(rng.integers(1, 10)))
+                ).astype(np.int64)
+                vals = rng.normal(size=(idx.size, dim)).astype(np.float32)
+                out = bps.push_pull_rowsparse(
+                    idx, vals, rs_name, total_rows=rows, average=False
+                )
+                # result is already gathered at the pushed indices
+                np.testing.assert_allclose(np.asarray(out), vals, rtol=1e-6)
+            elif kind < 0.55:
+                stats["async"] += 1
+                hs = [
+                    bps.push_pull_async(
+                        x + i, name=name, average=False,
+                        priority=int(rng.integers(-5, 5)),
+                    )
+                    for i in range(3)
+                ]
+                for i, h in enumerate(hs):
+                    np.testing.assert_allclose(
+                        np.asarray(bps.synchronize(h)), x + i, rtol=1e-6
+                    )
+            else:
+                out = bps.push_pull(x, name=name, average=False)
+                np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+            stats["rounds"] += 1
+        bps.shutdown()
+    except BaseException:
+        print(f"SOAK FAILED at step {step} seed={args.seed} stats={stats}",
+              file=sys.stderr, flush=True)
+        raise
+    finally:
+        for srv in servers:
+            srv.stop()
+        sched.stop()
+    print(f"SOAK OK: {stats} (seed={args.seed}, {args.seconds:.0f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
