@@ -130,10 +130,30 @@ def _pmean_float_leaves(tree, axis_name: str):
     )
 
 
-def _ddp_apply(grads, loss, params, opt_state, optimizer, axis_name: str):
+def _ddp_apply(grads, loss, params, opt_state, optimizer, axis_name: str,
+               quant_bits=None):
     """The shared DDP update tail: all-reduce grads + loss over the data
-    axis, update, apply — one copy for every step builder."""
-    grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)
+    axis, update, apply — one copy for every step builder.
+
+    ``quant_bits=8``: gradients ride the int8 block-quantized ring
+    all-reduce (ops/quantized_allreduce.py, EQuARX-style) instead of the
+    dense pmean — ~4× less ICI traffic for ~1% rms gradient noise
+    (replicas stay bit-identical; the loss stays dense).  The whole tree
+    is raveled into ONE ring so small leaves (biases, norm scales) don't
+    each pay the block/chunk padding floor; unravel restores per-leaf
+    dtypes."""
+    if quant_bits == 8:
+        from jax.flatten_util import ravel_pytree
+
+        from byteps_tpu.ops.quantized_allreduce import quantized_psum
+
+        flat, unravel = ravel_pytree(grads)
+        summed = quantized_psum(flat, axis_name)
+        grads = unravel(summed / lax.axis_size(axis_name))
+    else:
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, axis_name), grads
+        )
     loss = lax.pmean(loss, axis_name)
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
@@ -171,6 +191,7 @@ def build_data_parallel_step(
     axis_name: str = DP_AXIS,
     donate: bool = True,
     accumulate_steps: int = 1,
+    grad_quant_bits: Optional[int] = None,
 ) -> Callable:
     """DistributedDataParallel equivalent (parallel/distributed.py:13-287).
 
@@ -186,7 +207,19 @@ def build_data_parallel_step(
     Nth (the allreduce rides INSIDE optax.MultiSteps, so N−1 of every N
     gradient volumes never touch ICI — the whole point of delayed sync).
     opt_state must then be built from the returned step's ``optimizer``
-    attribute (``step.optimizer.init(params)``)."""
+    attribute (``step.optimizer.init(params)``).
+
+    ``grad_quant_bits=8``: gradient sync rides the int8 block-quantized
+    ring all-reduce (EQuARX-style, ops/quantized_allreduce.py) — ~4×
+    less ICI gradient traffic for ~1% rms gradient noise.  Incompatible
+    with ``accumulate_steps > 1`` (the sync there rides inside
+    optax.MultiSteps)."""
+    if grad_quant_bits is not None and grad_quant_bits != 8:
+        raise ValueError("grad_quant_bits: only 8 (int8) is supported")
+    if grad_quant_bits and accumulate_steps > 1:
+        raise ValueError(
+            "grad_quant_bits cannot combine with accumulate_steps>1"
+        )
     if accumulate_steps > 1:
         optimizer = optax.MultiSteps(
             distributed_optimizer(optimizer, (axis_name,), average=True),
@@ -204,7 +237,10 @@ def build_data_parallel_step(
 
         def local_step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            return _ddp_apply(grads, loss, params, opt_state, optimizer, axis_name)
+            return _ddp_apply(
+                grads, loss, params, opt_state, optimizer, axis_name,
+                quant_bits=grad_quant_bits,
+            )
 
     step = _compile_spmd_step(local_step, mesh, axis_name, donate)
     # the (possibly MultiSteps-wrapped) transformation whose .init builds
