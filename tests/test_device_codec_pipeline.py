@@ -189,6 +189,39 @@ class TestDeviceCodecPipeline:
         assert all(p.key not in eng._device_codecs for p in parts)
         bps.shutdown()
 
+    def test_debug_sampler_on_device_path(self, fake_cluster, monkeypatch, capsys):
+        """BYTEPS_DEBUG_SAMPLE_TENSOR with a device-codec job: the
+        pull-side sampler must read the DEVICE partition (job.result is
+        never written on this path) — garbage host-buffer norms would
+        mislead exactly the race diagnosis the knob exists for."""
+        monkeypatch.setenv("BYTEPS_DEBUG_SAMPLE_TENSOR", "dbg.dev")
+        monkeypatch.setenv("BYTEPS_LOG_LEVEL", "INFO")
+        import jax.numpy as jnp
+
+        import byteps_tpu as bps
+
+        bps.init()
+        n = 256
+        bps.declare_tensor(
+            "dbg.dev", byteps_compressor_type="topk",
+            byteps_compressor_k=str(n),
+        )
+        x = jnp.asarray(np.arange(n, dtype=np.float32) - 100.0)
+        out = bps.push_pull(x, name="dbg.dev", average=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+        err = capsys.readouterr().err
+        lines = [l for l in err.splitlines() if "sample dbg.dev" in l]
+        assert any("DECOMPRESS" in l for l in lines), err[-1000:]
+        # the sampled norm must be the REAL tensor norm, not uninitialized
+        # host memory
+        import re
+
+        true_norm = float(np.linalg.norm(np.asarray(x, np.float64)))
+        dec = [l for l in lines if "DECOMPRESS" in l][0]
+        norm = float(re.search(r"norm=([0-9.eE+-]+)", dec).group(1))
+        assert abs(norm - true_norm) / true_norm < 1e-3, (norm, true_norm)
+        bps.shutdown()
+
     def test_randomk_stays_host_only(self):
         from byteps_tpu.core.device_codec import device_codec_for
 
