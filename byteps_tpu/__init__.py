@@ -43,8 +43,10 @@ from byteps_tpu.api import (
     broadcast_parameters,
     broadcast_object,
     get_pushpull_speed,
+    get_robustness_counters,
     set_compression_lr,
 )
+from byteps_tpu.common.types import DegradedError
 from byteps_tpu.optim import DistributedOptimizer, distributed_optimizer
 
 __version__ = "0.1.0"

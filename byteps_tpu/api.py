@@ -158,8 +158,39 @@ def push_pull(
     must be identical on every worker (an auto-generated per-process name
     could never match up).  The reference likewise keys on names
     (torch/__init__.py:139: ``Gradient.<param name>``).
+
+    Degraded-step policy (docs/robustness.md): when the data plane
+    degrades mid-step — a server died past its retry budget — the handle
+    raises :class:`~byteps_tpu.common.types.DegradedError`.  With
+    ``BYTEPS_DEGRADED_STEP_RETRIES`` > 0 this wrapper resubmits the step
+    up to that many times (with backoff, so the elastic rebuild can
+    land); resubmission is exactly-once safe — the abandoned round was
+    never published and the next submit re-runs the key's init barrier.
+    Default 0: the error propagates and the training loop decides.
     """
-    return synchronize(push_pull_async(tensor, name, average=average, priority=priority))
+    retries = get_config().degraded_step_retries
+    if retries <= 0:
+        return synchronize(
+            push_pull_async(tensor, name, average=average, priority=priority)
+        )
+    from byteps_tpu.common.types import DegradedError
+    from byteps_tpu.comm.retry import Backoff
+
+    bo = Backoff(base=0.25, cap=2.0)
+    for attempt in range(retries + 1):
+        try:
+            return synchronize(
+                push_pull_async(tensor, name, average=average, priority=priority)
+            )
+        except (DegradedError, ConnectionError):
+            # ConnectionError covers the submit-time init barrier hitting
+            # a not-yet-evicted dead server — same transient class, and
+            # the user opted into step retries
+            if attempt >= retries:
+                raise
+            import time as _time
+
+            _time.sleep(bo.next_delay())
 
 
 def push_pull_rowsparse_async(
@@ -286,3 +317,14 @@ def get_pushpull_speed() -> float:
     """Windowed push/pull MB/s (common/__init__.py:131-139)."""
     st = require_state()
     return st.telemetry.mbps() if st.telemetry else 0.0
+
+
+def get_robustness_counters() -> dict:
+    """Snapshot of the data-plane degradation counters: retries, deadline
+    expiries, connection revivals, replay dedupes, observed evictions,
+    injected chaos faults (docs/robustness.md).  Process-wide; usable
+    before :func:`init` (counters exist independently of runtime state).
+    """
+    from byteps_tpu.core.telemetry import counters
+
+    return counters().snapshot()

@@ -1,0 +1,256 @@
+"""Chaos van: deterministic fault injection on the PS data plane.
+
+Production BytePS assumes nodes die mid-training (ps-lite heartbeats +
+elastic suspend/resume, SURVEY §5.3); this van lets one machine rehearse
+those failures.  ``BYTEPS_VAN=chaos:<inner>`` wraps any fd-stream van
+(``chaos:tcp``, ``chaos:uds``, ``chaos:shm``) and injects faults on
+every data-plane connection — both directions, because the listener
+wraps accepted sockets and the published address carries a ``chaos+``
+prefix so dialing clients wrap theirs too (the same address-encoded
+dispatch the shm van uses).
+
+Faults are decided per FRAME — transport.py sends one framed message per
+``sendall``/``sendmsg`` call — so a "drop" loses exactly one message
+while the connection stays healthy, which is the case per-RPC deadlines
+and retries exist for.  Classes:
+
+- **drop**:       the frame never leaves; silence until a deadline fires.
+- **delay**:      the frame is held up to ``BYTEPS_CHAOS_DELAY_MS``.
+- **disconnect**: the connection is torn down (peer sees EOF/RST) — the
+                  client's revive-and-retry path must heal it.
+- **truncate**:   a prefix of the frame is sent, then the connection is
+                  torn down — a crash mid-send; the peer must detect the
+                  short frame, not parse garbage.
+- **corrupt**:    the frame's magic byte is flipped before sending — the
+                  peer's framing check rejects it and drops the
+                  connection.  (This models link corruption that survives
+                  to the app layer as frame desync; silent payload
+                  corruption is a checksum problem the 32-byte header has
+                  no field for, and real DCN links CRC their frames.)
+
+Determinism: ``BYTEPS_CHAOS_SEED`` seeds a per-connection
+``random.Random`` derived from ``(seed, connection_index)``, where the
+index is a process-global counter — with a fixed seed and a fixed
+connect order, the fault schedule replays exactly.
+
+Knobs (probabilities in [0,1], applied per frame in the order drop →
+disconnect → truncate → corrupt; delay is rolled independently):
+
+    BYTEPS_CHAOS_SEED         int,   default 0
+    BYTEPS_CHAOS_DROP         float, default 0
+    BYTEPS_CHAOS_DISCONNECT   float, default 0
+    BYTEPS_CHAOS_TRUNCATE     float, default 0
+    BYTEPS_CHAOS_CORRUPT      float, default 0
+    BYTEPS_CHAOS_DELAY        float, default 0
+    BYTEPS_CHAOS_DELAY_MS     float, default 20 (max; uniform 0..max)
+
+Every injected fault bumps a ``chaos_*`` robustness counter
+(core/telemetry.py), so tests can assert the schedule actually fired.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass
+
+from byteps_tpu.comm.van import CHAOS_PREFIX  # single source of the prefix
+
+#: process-global connection index — (seed, index) keys each socket's RNG
+_conn_counter = itertools.count()
+_conn_counter_lock = threading.Lock()
+
+
+def _next_conn_index() -> int:
+    with _conn_counter_lock:
+        return next(_conn_counter)
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v not in (None, "") else default
+
+
+@dataclass(frozen=True)
+class ChaosParams:
+    seed: int = 0
+    drop: float = 0.0
+    disconnect: float = 0.0
+    truncate: float = 0.0
+    corrupt: float = 0.0
+    delay: float = 0.0
+    delay_ms: float = 20.0
+
+    @staticmethod
+    def from_env() -> "ChaosParams":
+        return ChaosParams(
+            seed=int(os.environ.get("BYTEPS_CHAOS_SEED", "0") or 0),
+            drop=_env_float("BYTEPS_CHAOS_DROP", 0.0),
+            disconnect=_env_float("BYTEPS_CHAOS_DISCONNECT", 0.0),
+            truncate=_env_float("BYTEPS_CHAOS_TRUNCATE", 0.0),
+            corrupt=_env_float("BYTEPS_CHAOS_CORRUPT", 0.0),
+            delay=_env_float("BYTEPS_CHAOS_DELAY", 0.0),
+            delay_ms=_env_float("BYTEPS_CHAOS_DELAY_MS", 20.0),
+        )
+
+
+class ChaosSocket:
+    """Socket proxy injecting send-side faults at frame granularity.
+
+    Exposes ``sendmsg`` so transport._send delivers header+payload as ONE
+    call (the scatter-gather path) — a fault then hits a whole frame, not
+    half of one.  Header-only messages arrive via ``sendall``, also one
+    frame.  Receives and teardown pass straight through.
+    """
+
+    def __init__(self, sock, params: ChaosParams, conn_index: int) -> None:
+        self._sock = sock
+        self._p = params
+        # independent stream per connection, reproducible per (seed, index)
+        self._rng = random.Random((params.seed << 20) ^ conn_index)
+        self._send_lock = threading.Lock()  # fault decisions are ordered
+
+    # --- fault engine -----------------------------------------------------
+    def _bump(self, name: str) -> None:
+        from byteps_tpu.core.telemetry import counters
+
+        counters().bump(name)
+
+    def _die(self, reason: str) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        raise ConnectionError(f"chaos: injected {reason}")
+
+    def _send_frame(self, data: bytes) -> None:
+        p = self._p
+        with self._send_lock:
+            roll = self._rng.random()
+            if roll < p.drop:
+                self._bump("chaos_drop")
+                return
+            roll -= p.drop
+            if roll < p.disconnect:
+                self._bump("chaos_disconnect")
+                self._die("disconnect")
+            roll -= p.disconnect
+            if roll < p.truncate:
+                self._bump("chaos_truncate")
+                k = self._rng.randrange(0, max(1, len(data)))
+                try:
+                    self._sock.sendall(data[:k])
+                except OSError:
+                    pass
+                self._die("truncated frame")
+            roll -= p.truncate
+            if roll < p.corrupt:
+                self._bump("chaos_corrupt")
+                mangled = bytearray(data)
+                if mangled:
+                    mangled[0] ^= 0xFF  # flip the magic → framing rejects it
+                self._sock.sendall(bytes(mangled))
+                return
+            if p.delay > 0 and self._rng.random() < p.delay:
+                self._bump("chaos_delay")
+                time.sleep(self._rng.random() * p.delay_ms / 1e3)
+            self._sock.sendall(data)
+
+    # --- socket surface used by transport.py ------------------------------
+    def sendall(self, data) -> None:
+        self._send_frame(bytes(data))
+
+    def sendmsg(self, bufs) -> int:
+        # one frame: transport._send passes [header, payload]; joining keeps
+        # the fault decision atomic per message (the copy is the chaos tax)
+        frame = b"".join(bytes(b) for b in bufs)
+        self._send_frame(frame)
+        return len(frame)
+
+    @property
+    def family(self):
+        return self._sock.family
+
+    def recv(self, n: int) -> bytes:
+        return self._sock.recv(n)
+
+    def recv_into(self, buf, nbytes: int = 0) -> int:
+        return self._sock.recv_into(buf, nbytes)
+
+    def settimeout(self, t) -> None:
+        self._sock.settimeout(t)
+
+    def setsockopt(self, *a) -> None:
+        self._sock.setsockopt(*a)
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def shutdown(self, how: int = socket.SHUT_RDWR) -> None:
+        try:
+            self._sock.shutdown(how)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ChaosListener:
+    """Accept wrapper: accepted connections get the chaos treatment, so
+    server→worker frames (acks, pull responses) are faulted too."""
+
+    def __init__(self, inner, params: ChaosParams) -> None:
+        self._inner = inner
+        self._params = params
+
+    def accept(self):
+        conn, addr = self._inner.accept()
+        return ChaosSocket(conn, self._params, _next_conn_index()), addr
+
+    def shutdown(self, how: int = socket.SHUT_RDWR) -> None:
+        try:
+            self._inner.shutdown(how)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self._inner.close()
+        except OSError:
+            pass
+
+
+def make_chaos_van(inner):
+    """Build the chaos wrapper around an inner Van instance.
+
+    Lives here (not van.py) so the van registry needs no chaos imports
+    unless chaos is actually selected.
+    """
+    from byteps_tpu.comm.van import Van
+
+    class ChaosVan(Van):
+        name = f"chaos:{inner.name}"
+
+        def __init__(self) -> None:
+            self.inner = inner
+            self.params = ChaosParams.from_env()
+
+        def listen(self, host: str):
+            lsock, phost, port = self.inner.listen(host)
+            return ChaosListener(lsock, self.params), CHAOS_PREFIX + phost, port
+
+        def connect(self, host: str, port: int, timeout: float = 30.0):
+            if host.startswith(CHAOS_PREFIX):
+                host = host[len(CHAOS_PREFIX):]
+            sock = self.inner.connect(host, port, timeout=timeout)
+            return ChaosSocket(sock, self.params, _next_conn_index())
+
+    return ChaosVan()

@@ -9,16 +9,32 @@ One TCP connection per server; a receiver thread per connection demuxes
 responses by ``seq`` and fires callbacks — the callback thread then drives
 the next pipeline stage, exactly like ps-lite's callback threads drive
 FinishOrProceed.
+
+Self-healing (docs/robustness.md): every data-plane RPC is retried with
+exponential backoff + jitter when its connection dies (``BYTEPS_RPC_
+RETRIES`` attempts after the first), transparently re-dialing a dead
+server connection first (revival) — so an injected disconnect, a dropped
+frame, or a server restart costs a retry, not a failed training step.
+With ``BYTEPS_RPC_DEADLINE_S`` set, a per-attempt deadline additionally
+catches HUNG servers: expiry tears the suspect connection down (so no
+late response can race a retry into a caller's zero-copy sink) and the
+normal dead-connection retry path heals it.  Pushes carry the worker's
+rank in the header ``flags`` byte so the server dedupes replays —
+retried summation stays exactly-once (see server.py).
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+from byteps_tpu.core.telemetry import counters
 
 from byteps_tpu.common.config import Config
 from byteps_tpu.common.hashing import assign_server
@@ -65,10 +81,12 @@ class _ServerConn:
         # distinct partitions fan out over independent kernel streams (the
         # RDMA/UCX multi-lane van analogue, reference setup.py:312-330).
         # Lane 0 doubles as the control lane (init/register/liveness).
-        from byteps_tpu.comm.van import SHM_PREFIX, UNIX_PREFIX
+        from byteps_tpu.comm.van import SHM_PREFIX, UNIX_PREFIX, strip_chaos
 
         self.stripes = [(self.sock, self.send_lock)]
-        if streams > 1 and not host.startswith((UNIX_PREFIX, SHM_PREFIX)):
+        if streams > 1 and not strip_chaos(host).startswith(
+            (UNIX_PREFIX, SHM_PREFIX)
+        ):
             try:
                 for _ in range(streams - 1):
                     self.stripes.append(
@@ -410,6 +428,18 @@ class PSClient:
         self.is_recovery = False
         #: responses whose payloads landed directly in caller buffers
         self.zero_copy_pulls = 0
+        #: newest membership epoch seen in a scheduler book (eviction /
+        #: adoption / resize broadcasts bump it; docs/robustness.md)
+        self.membership_epoch = 0
+        # --- per-RPC deadline machinery (BYTEPS_RPC_DEADLINE_S) ---
+        # token → (conn, expire_at); a scanner thread tears down the
+        # connection of any RPC that blows its deadline — the drain then
+        # fires every pending callback with None and the retry layer takes
+        # over.  Lazy: the thread starts on the first armed deadline.
+        self._rpc_tokens = itertools.count()
+        self._outstanding: Dict[int, tuple] = {}
+        self._outstanding_lock = threading.Lock()
+        self._deadline_thread: Optional[threading.Thread] = None
 
     # --- rendezvous ------------------------------------------------------
 
@@ -445,6 +475,7 @@ class PSClient:
         self.num_workers = book["num_workers"]
         self.num_servers = book["num_servers"]
         self.is_recovery = book.get("is_recovery", False)
+        self._note_membership(book)
         self._server_addrs = [tuple(s) for s in book["servers"]]
         for host, port in self._server_addrs:
             self._servers.append(self._new_conn(host, port))
@@ -491,6 +522,18 @@ class PSClient:
             raise ConnectionError("scheduler connection lost")
         return box[0]
 
+    def _note_membership(self, book: dict) -> None:
+        """Track the scheduler's membership epoch + cumulative eviction
+        totals from an address book (observability; docs/robustness.md)."""
+        epoch = book.get("epoch")
+        if epoch is not None and epoch > self.membership_epoch:
+            self.membership_epoch = epoch
+        ev = book.get("evictions") or {}
+        for role, name in (("worker", "worker_evicted"),
+                           ("server", "server_evicted")):
+            if ev.get(role):
+                counters().set_floor(name, int(ev[role]))
+
     def barrier(self, group: int = GROUP_WORKERS) -> None:
         self._sched_request(Message(Op.BARRIER, flags=group))
 
@@ -526,6 +569,7 @@ class PSClient:
                     # (server_generation bump)
                     book = json.loads(msg.payload.decode())
                     self.num_workers = book["num_workers"]
+                    self._note_membership(book)
                     new_addrs = [tuple(s) for s in book["servers"]]
                     # token = book arrival order on THIS (single) thread:
                     # rebuild threads acquire the lock in arbitrary order,
@@ -656,13 +700,15 @@ class PSClient:
         the shm van's Python client is already zero-copy), else the
         Python lanes + recv threads."""
         from byteps_tpu.comm.shaping import shaping_enabled
-        from byteps_tpu.comm.van import SHM_PREFIX
+        from byteps_tpu.comm.van import CHAOS_PREFIX, SHM_PREFIX
 
         if shaping_enabled() and self.cfg.native_client:
             from byteps_tpu.comm.shaping import warn_native_bypass_once
 
             warn_native_bypass_once("ignoring BYTEPS_NATIVE_CLIENT=1")
-        elif self.cfg.native_client and not host.startswith(SHM_PREFIX):
+        elif self.cfg.native_client and not host.startswith(
+            (SHM_PREFIX, CHAOS_PREFIX)  # chaos needs the Python fault layer
+        ):
             from byteps_tpu.native import get_lib
 
             lib = get_lib()
@@ -678,11 +724,213 @@ class PSClient:
     def _count_zero_copy(self) -> None:
         self.zero_copy_pulls += 1
 
+    # --- per-RPC deadlines + retry (docs/robustness.md) ------------------
+
+    def _worker_flag(self) -> int:
+        """Worker identity for the header ``flags`` byte: rank+1, so the
+        server can dedupe replayed pushes on (worker, key, version).  0 =
+        no identity (rank unknown, or ≥255 workers — the u8 runs out) and
+        the server skips dedupe for that push."""
+        r = self.rank
+        return r + 1 if r is not None and 0 <= r < 255 else 0
+
+    def _deadline_arm(self, sc) -> Optional[int]:
+        """Register one in-flight RPC attempt with the deadline scanner;
+        returns a token for :meth:`_deadline_clear`, or None when
+        deadlines are disabled."""
+        if self.cfg.rpc_deadline_s <= 0:
+            return None
+        token = next(self._rpc_tokens)
+        expire = time.monotonic() + self.cfg.rpc_deadline_s
+        with self._outstanding_lock:
+            self._outstanding[token] = (sc, expire)
+            if self._deadline_thread is None:
+                self._deadline_thread = threading.Thread(
+                    target=self._deadline_loop, name="bps-rpc-deadline",
+                    daemon=True,
+                )
+                self._deadline_thread.start()
+        return token
+
+    def _deadline_clear(self, token: Optional[int]) -> None:
+        if token is None:
+            return
+        with self._outstanding_lock:
+            self._outstanding.pop(token, None)
+
+    def _deadline_loop(self) -> None:
+        """Scanner: an RPC past its deadline means its server is hung (a
+        dead one would have closed the connection).  Tear the suspect
+        connection down — the recv-loop drain fires every pending callback
+        with None, so ALL of that connection's RPCs funnel into the one
+        retry path, and no late response can race a retried pull into a
+        caller's zero-copy sink (the old lanes are fully dead first)."""
+        tick = max(0.01, min(0.25, self.cfg.rpc_deadline_s / 4))
+        while not self._stop.wait(tick):
+            now = time.monotonic()
+            doomed = []
+            with self._outstanding_lock:
+                expired = [
+                    t for t, (_, at) in self._outstanding.items() if at <= now
+                ]
+                for t in expired:
+                    sc, _ = self._outstanding.pop(t)
+                    doomed.append(sc)
+            if not doomed:
+                continue
+            counters().bump("rpc_deadline_expired", len(doomed))
+            for sc in {id(s): s for s in doomed}.values():
+                try:
+                    sc.close_all()
+                except Exception:  # noqa: BLE001 — scanner must survive
+                    pass
+
+    def _async_rpc(
+        self,
+        make_msg: Callable[[int], Message],
+        key: int,
+        deliver: Callable[[Message], None],
+        on_error: Optional[Callable[[], None]],
+        sink: Optional[memoryview] = None,
+        abort_check: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        """Send one async RPC with deadline + retry + revival.
+
+        ``make_msg(seq)`` builds the wire message per attempt;
+        ``deliver(msg)`` fires once on success; ``on_error`` fires once
+        when ``BYTEPS_RPC_RETRIES`` attempts are exhausted (or
+        immediately with retries disabled — the legacy fail-fast path).
+
+        ``abort_check``: returns True once the caller has abandoned this
+        RPC's whole operation (engine job failed) — pending retries stop
+        resending and route to ``on_error`` instead (the caller's error
+        path is idempotent and still owes per-task cleanup: queue
+        accounting, round-gate re-arm).  Without the fence, a retry
+        timer armed before the abandonment could replay an
+        old-generation push AFTER the re-init barrier cleared the
+        server's dedupe ledger, double-summing that worker.
+        """
+        from byteps_tpu.comm.retry import Backoff
+
+        state = {"attempt": 0}
+        backoff = Backoff(base=self.cfg.rpc_backoff_s, cap=2.0)
+
+        def aborted_cleanup() -> bool:
+            """True (and routes to on_error) when the op is abandoned."""
+            if abort_check is not None and abort_check():
+                if on_error is not None:
+                    on_error()
+                return True
+            return False
+
+        def fail() -> None:
+            counters().bump("rpc_giveup")
+            if on_error is not None:
+                on_error()
+
+        def retry_later() -> None:
+            if aborted_cleanup():
+                return  # abandoned: no resend, cleanup via on_error
+            if self._stop.is_set() or state["attempt"] >= self.cfg.rpc_retries:
+                fail()
+                return
+            state["attempt"] += 1
+            counters().bump("rpc_retry")
+            t = threading.Timer(backoff.next_delay(), send_attempt)
+            t.daemon = True
+            t.start()
+
+        def send_attempt() -> None:
+            if aborted_cleanup():
+                return
+            if self._stop.is_set():
+                fail()
+                return
+            try:
+                sc = self._conn_for(key, revive=state["attempt"] > 0)
+            except (ConnectionError, OSError):
+                retry_later()
+                return
+            token_box: list = [None]
+
+            def on_reply(msg: Optional[Message]) -> None:
+                self._deadline_clear(token_box[0])
+                if msg is None:
+                    retry_later()
+                elif aborted_cleanup():
+                    pass  # late success on an abandoned op: cleanup only
+                else:
+                    deliver(msg)
+
+            # arm BEFORE alloc: alloc_seq on a dead connection fires
+            # on_reply(None) synchronously, which must find the token
+            token_box[0] = self._deadline_arm(sc)
+            seq = sc.alloc_seq(on_reply, sink=sink)
+            if seq < 0:
+                return  # on_reply(None) already fired → retry scheduled
+            try:
+                sc.send_msg(make_msg(seq))
+            except (ConnectionError, OSError):
+                # died between alloc and send: claim the callback — if the
+                # drain beat us to it, on_reply(None) already retried
+                if sc.pop_cb(seq) is not None:
+                    self._deadline_clear(token_box[0])
+                    retry_later()
+
+        send_attempt()
+
+    def _blocking_request_retrying(
+        self, key: int, make_msg, errmsg: str, use_deadline: bool = True
+    ) -> Message:
+        """Retrying wrapper for the blocking control RPCs (init-push,
+        compressor registration).  Safe to replay: the server keys init
+        waiters and compressor registration idempotently (server.py).
+
+        ``use_deadline=False`` for RPCs whose latency depends on PEER
+        workers (the init barrier: the server withholds the ack until
+        every worker arrives) — the ordinary per-RPC deadline would make
+        on-time workers tear down healthy connections whenever one peer
+        straggles.  Such RPCs use the separate ``BYTEPS_INIT_DEADLINE_S``
+        budget instead (default 0 = none; set it ABOVE worst-case worker
+        skew — chaos tests set it small to heal dropped init acks).
+        Connection death still fails the wait immediately (cb(None)
+        drain) either way, so retries remain live; a hung server during
+        a deadline-free init is the scheduler eviction policy's job."""
+        from byteps_tpu.comm.retry import Backoff
+
+        backoff = Backoff(base=self.cfg.rpc_backoff_s, cap=2.0)
+        deadline = (
+            (self.cfg.rpc_deadline_s or None) if use_deadline
+            else (self.cfg.init_deadline_s or None)
+        )
+        last: Optional[BaseException] = None
+        for attempt in range(self.cfg.rpc_retries + 1):
+            if attempt:
+                counters().bump("rpc_retry")
+                if self._stop.wait(backoff.next_delay()):
+                    break
+            try:
+                sc = self._conn_for(key, revive=attempt > 0)
+            except (ConnectionError, OSError) as e:
+                last = e
+                continue
+            try:
+                return self._blocking_request(sc, make_msg, errmsg, deadline)
+            except ConnectionError as e:
+                last = e
+                continue
+        counters().bump("rpc_giveup")
+        raise ConnectionError(errmsg) from last
+
     @staticmethod
-    def _blocking_request(sc, make_msg, errmsg: str) -> Message:
+    def _blocking_request(
+        sc, make_msg, errmsg: str, timeout: Optional[float] = None
+    ) -> Message:
         """Send one server request and block for its ack; raises
         ConnectionError if the connection is dead or dies while waiting
-        (the alloc_seq dead-path fires the callback with None)."""
+        (the alloc_seq dead-path fires the callback with None).  With a
+        ``timeout``, expiry tears the (presumed hung) connection down —
+        same policy as the async deadline scanner."""
         done = threading.Event()
         box: list = []
         seq = sc.alloc_seq(lambda msg: (box.append(msg), done.set()))
@@ -694,7 +942,10 @@ class PSClient:
                 # the same ConnectionError as the dead-connection path
                 sc.pop_cb(seq)
                 raise ConnectionError(errmsg) from None
-        done.wait()
+        if not done.wait(timeout):
+            counters().bump("rpc_deadline_expired")
+            sc.close_all()
+            done.wait(5.0)  # the drain fires promptly once lanes close
         if not box or box[0] is None:
             raise ConnectionError(errmsg)
         return box[0]
@@ -772,24 +1023,68 @@ class PSClient:
             num_workers=self.num_workers,
         )
 
-    def _conn_for(self, key: int) -> _ServerConn:
+    def _conn_for(self, key: int, revive: bool = False) -> _ServerConn:
         """Route a key from ONE atomic snapshot of the server list.
         During a live resize the list reference swaps under us; hashing
         with ``len(snapshot)`` keeps count and list consistent (reading
         self.num_servers separately could pair the new count with the old
-        list → IndexError instead of the designed dead-connection path)."""
+        list → IndexError instead of the designed dead-connection path).
+
+        ``revive=True`` (retry attempts): a dead connection is re-dialed
+        in place first — a transient disconnect (chaos van, server
+        restart, deadline teardown) heals without scheduler involvement.
+        """
         servers = self._servers
-        return servers[
-            assign_server(
-                key,
-                len(servers),
-                fn=self.cfg.key_hash_fn,
-                coef=self.cfg.built_in_hash_coef,
-                mixed_mode=self.cfg.enable_mixed_mode,
-                mixed_bound=self.cfg.mixed_mode_bound,
-                num_workers=self.num_workers,
-            )
-        ]
+        idx = assign_server(
+            key,
+            len(servers),
+            fn=self.cfg.key_hash_fn,
+            coef=self.cfg.built_in_hash_coef,
+            mixed_mode=self.cfg.enable_mixed_mode,
+            mixed_bound=self.cfg.mixed_mode_bound,
+            num_workers=self.num_workers,
+        )
+        sc = servers[idx]
+        if revive and getattr(sc, "dead", False):
+            sc = self._revive_conn(idx, sc)
+        return sc
+
+    def _revive_conn(self, idx: int, dead_sc) -> _ServerConn:
+        """Replace a dead server connection with a fresh dial to the same
+        address (server state is per-key, not per-connection, so a revived
+        link resumes exactly where the dead one left off — retried pushes
+        dedupe server-side).  Raises on dial failure.
+
+        The dial happens OUTSIDE the rebuild lock: a black-holed server
+        (no RST, dial blocks until its timeout) must not stall elastic
+        RESIZE rebuilds or other keys' revives behind it.  Both lock
+        sections re-validate, so a rebuild landing mid-dial wins and the
+        late revival is discarded."""
+        with self._rebuild_lock:
+            if self._stop.is_set():
+                raise ConnectionError("client closed")
+            servers = self._servers  # re-read: a rebuild may have swapped it
+            if idx >= len(servers):
+                raise ConnectionError("server set resized")
+            cur = servers[idx]
+            if cur is not dead_sc and not getattr(cur, "dead", False):
+                return cur  # another retry already revived this slot
+            host, port = self._server_addrs[idx]
+        fresh = self._new_conn(host, port)  # may block; lock NOT held
+        with self._rebuild_lock:
+            servers = self._servers
+            if (self._stop.is_set() or idx >= len(servers)
+                    or self._server_addrs[idx] != (host, port)):
+                fresh.close_all()  # superseded by a rebuild/shutdown
+                raise ConnectionError("server set changed during revive")
+            cur = servers[idx]
+            if cur is not dead_sc and not getattr(cur, "dead", False):
+                fresh.close_all()  # another reviver won the race
+                return cur
+            servers[idx] = fresh
+        counters().bump("conn_revive")
+        cur.close_all()  # idempotent; frees the old lanes' fds
+        return fresh
 
     # --- data plane ------------------------------------------------------
 
@@ -798,19 +1093,24 @@ class PSClient:
         key (InitTensor blocking ZPush, operations.cc:283-414).
 
         Wire payload is language-neutral (u64 nelems + u32 dtype, network
-        order) so the native C++ server parses it directly."""
+        order) so the native C++ server parses it directly.  Carries the
+        worker flag so a replayed init REPLACES this worker's barrier
+        waiter instead of double-counting it (server.py)."""
         import struct
 
-        sc = self._conn_for(key)
-        self._blocking_request(
-            sc,
+        self._blocking_request_retrying(
+            key,
             lambda seq: Message(
                 Op.INIT,
                 key=key,
                 seq=seq,
+                flags=self._worker_flag(),
                 payload=struct.pack("!QI", num_elements, dtype_id),
             ),
             f"server connection lost during init of key {key}",
+            # the init ack legitimately waits for PEER workers — a
+            # per-attempt deadline would punish stragglers' peers
+            use_deadline=False,
         )
 
     def push(
@@ -822,26 +1122,28 @@ class PSClient:
         cb: Callable[[], None],
         request_type: RequestType = RequestType.DEFAULT_PUSH_PULL,
         on_error: Optional[Callable[[], None]] = None,
+        abort_check: Optional[Callable[[], bool]] = None,
     ) -> None:
         """Async push; ``cb`` fires on server ack (ZPush,
-        core_loops.cc:538-582); ``on_error`` fires if the server connection
-        dies before the ack."""
-        sc = self._conn_for(key)
-        seq = sc.alloc_seq(
-            lambda msg: cb() if msg is not None
-            else (on_error() if on_error is not None else None)
-        )
-        if seq < 0:  # connection died; on_error already fired
-            return
-        sc.send_msg(
-            Message(
-                Op.PUSH,
-                key=key,
-                seq=seq,
-                payload=payload,
-                cmd=get_command_type(request_type, dtype_id),
-                version=version,
-            )
+        core_loops.cc:538-582); ``on_error`` fires once retries are
+        exhausted after connection failures (BYTEPS_RPC_RETRIES);
+        ``abort_check`` fences pending retries once the caller abandons
+        the operation.
+
+        Replay-safe: the worker flag + version lets the server suppress a
+        retransmitted push whose original WAS summed (ack lost), so
+        summation stays exactly-once under retry."""
+        cmd = get_command_type(request_type, dtype_id)
+        flags = self._worker_flag()
+        self._async_rpc(
+            lambda seq: Message(
+                Op.PUSH, key=key, seq=seq, payload=payload, cmd=cmd,
+                version=version, flags=flags,
+            ),
+            key,
+            deliver=lambda msg: cb(),
+            on_error=on_error,
+            abort_check=abort_check,
         )
 
     def pull(
@@ -854,6 +1156,7 @@ class PSClient:
         on_error: Optional[Callable[[], None]] = None,
         payload: bytes = b"",
         sink: Optional[memoryview] = None,
+        abort_check: Optional[Callable[[], bool]] = None,
     ) -> None:
         """Async pull; ``cb`` receives the aggregated payload (ZPull,
         core_loops.cc:584-618); ``on_error`` fires if the server connection
@@ -862,24 +1165,22 @@ class PSClient:
 
         ``sink``: caller-owned writable buffer; when the response length
         matches, the payload is received INTO it (zero payload copies) and
-        ``cb`` gets the ``_ZERO_COPIED`` sentinel instead of bytes."""
-        sc = self._conn_for(key)
-        seq = sc.alloc_seq(
-            lambda msg: cb(msg.payload) if msg is not None
-            else (on_error() if on_error is not None else None),
-            sink=sink,
-        )
-        if seq < 0:  # connection died; on_error already fired
-            return
-        sc.send_msg(
-            Message(
-                Op.PULL,
-                key=key,
-                seq=seq,
-                payload=payload,
-                cmd=get_command_type(request_type, dtype_id),
+        ``cb`` gets the ``_ZERO_COPIED`` sentinel instead of bytes.
+
+        Pulls are read-only, hence idempotent — retried freely.  A retried
+        sink pull never races a late writer: retry only happens after the
+        previous attempt's connection is fully dead (all lanes exited)."""
+        cmd = get_command_type(request_type, dtype_id)
+        self._async_rpc(
+            lambda seq: Message(
+                Op.PULL, key=key, seq=seq, payload=payload, cmd=cmd,
                 version=version,
-            )
+            ),
+            key,
+            deliver=lambda msg: cb(msg.payload),
+            on_error=on_error,
+            sink=sink,
+            abort_check=abort_check,
         )
 
     def register_compressor(self, key: int, kwargs: Dict[str, str]) -> None:
@@ -887,11 +1188,11 @@ class PSClient:
         (kCompressedPushPull init push, operations.cc:396-408).
 
         Payload is newline-separated ``key=value`` text — parseable by the
-        Python and native C++ servers alike."""
-        sc = self._conn_for(key)
+        Python and native C++ servers alike.  Replay-idempotent (the
+        server overwrites the key's chain), so the retrying path applies."""
         payload = "\n".join(f"{k}={v}" for k, v in sorted(kwargs.items())).encode()
-        self._blocking_request(
-            sc,
+        self._blocking_request_retrying(
+            key,
             lambda seq: Message(
                 Op.REGISTER_COMPRESSOR, key=key, seq=seq, payload=payload
             ),

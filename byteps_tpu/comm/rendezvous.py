@@ -23,22 +23,34 @@ object transfer stays on the data plane's explicitly documented
 Failure detection (ps-lite heartbeat equivalent, SURVEY §5.3): every
 message from a registered node refreshes its last-seen stamp; nodes ping
 every ``BYTEPS_HEARTBEAT_INTERVAL`` seconds and Op.QUERY returns per-node
-heartbeat ages — the policy for declaring a node dead (age threshold)
-belongs to the monitor consuming the ages.
+heartbeat ages.
+
+Liveness POLICY (docs/robustness.md): with ``BYTEPS_DEAD_NODE_TIMEOUT_S``
+set (> heartbeat interval), a monitor thread EVICTS any registered node
+whose heartbeat age exceeds the threshold — a crashed node stops
+heartbeating, a hung one keeps its connection open but silent; both age
+out.  Eviction shrinks the expected population (so in-flight rounds and
+barriers complete without the dead node's contribution), bumps the
+membership ``epoch``, and broadcasts RESIZE_SEQ address books — the same
+recovery path elastic suspend/resume uses, now triggered automatically.
+Each book carries the epoch and cumulative eviction totals so workers'
+telemetry counters reflect the degradation.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from byteps_tpu.comm.transport import (
     Message,
     Op,
+    close_socket,
     listen,
     recv_message,
     send_message,
@@ -68,9 +80,43 @@ class Scheduler:
     ``import byteps.server`` with DMLC_ROLE=scheduler,
     server/__init__.py:21-27)."""
 
-    def __init__(self, num_workers: int, num_servers: int, host: str = "0.0.0.0", port: int = 0):
+    def __init__(
+        self,
+        num_workers: int,
+        num_servers: int,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        dead_node_timeout: Optional[float] = None,
+    ):
         self.num_workers = num_workers
         self.num_servers = num_servers
+        # liveness policy threshold; None → BYTEPS_DEAD_NODE_TIMEOUT_S
+        # (0 disables eviction: ages stay observable via Op.QUERY only)
+        if dead_node_timeout is None:
+            dead_node_timeout = float(
+                os.environ.get("BYTEPS_DEAD_NODE_TIMEOUT_S", "0") or 0
+            )
+        self.dead_node_timeout = dead_node_timeout
+        if dead_node_timeout > 0:
+            # eviction is heartbeat-driven: with heartbeats disabled (or
+            # slower than the threshold) every healthy node's age grows
+            # past the timeout during any compute-only stretch and the
+            # whole cluster gets evicted — warn loudly
+            hb = float(os.environ.get("BYTEPS_HEARTBEAT_INTERVAL", "5") or 0)
+            if hb <= 0 or dead_node_timeout < 3 * hb:
+                from byteps_tpu.common import logging as bpslog
+
+                bpslog.warning(
+                    "BYTEPS_DEAD_NODE_TIMEOUT_S=%.1f needs heartbeats ≥3x "
+                    "faster (BYTEPS_HEARTBEAT_INTERVAL=%.1f) — healthy "
+                    "nodes risk eviction", dead_node_timeout, hb,
+                )
+        #: membership epoch: bumped on every topology-visible change
+        #: (resize, dead-slot adoption, eviction) and carried in every
+        #: address book
+        self.epoch = 0
+        #: cumulative evictions per role, shipped in books for telemetry
+        self.eviction_totals: Dict[str, int] = {"worker": 0, "server": 0}
         self._sock, self.port = listen(host, port)
         self._lock = threading.Lock()
         self._nodes: Dict[str, List[_Node]] = {"worker": [], "server": []}
@@ -97,6 +143,100 @@ class Scheduler:
         t = threading.Thread(target=self._accept_loop, name="sched-accept", daemon=True)
         t.start()
         self._threads.append(t)
+        if self.dead_node_timeout > 0:
+            m = threading.Thread(
+                target=self._monitor_loop, name="sched-liveness", daemon=True
+            )
+            m.start()
+            self._threads.append(m)
+
+    # --- liveness policy (BYTEPS_DEAD_NODE_TIMEOUT_S) --------------------
+
+    def _monitor_loop(self) -> None:
+        tick = max(0.05, min(1.0, self.dead_node_timeout / 4))
+        while not self._stop.wait(tick):
+            try:
+                self._evict_dead_once()
+            except Exception as e:  # noqa: BLE001 — the monitor must live
+                from byteps_tpu.common import logging as bpslog
+
+                bpslog.warning("liveness monitor error: %r", e)
+
+    def _evict_dead_once(self) -> None:
+        """Evict every registered node whose heartbeat age exceeds the
+        threshold, then re-broadcast the shrunken topology — crashed AND
+        hung nodes alike stop refreshing their stamp, so both age out."""
+        now = time.monotonic()
+        doomed: List[Tuple[str, _Node]] = []
+        with self._lock:
+            if not self._addrbook_sent:
+                return  # bring-up grace: nobody heartbeats before the book
+            for role in ("worker", "server"):
+                for n in self._nodes[role]:
+                    age = now - self._last_seen.get((role, n.rank), now)
+                    if age > self.dead_node_timeout:
+                        doomed.append((role, n))
+            if not doomed:
+                return
+            from byteps_tpu.common import logging as bpslog
+
+            for role, n in doomed:
+                bpslog.warning(
+                    "evicting dead %s rank=%d uid=%s (heartbeat age > %.1fs)",
+                    role, n.rank, n.uid, self.dead_node_timeout,
+                )
+                self._nodes[role].remove(n)
+                self._conn_ids.pop(n.conn, None)
+                self._last_seen.pop((role, n.rank), None)
+                self._recovered_conns.discard(n.conn)
+                if role == "worker":
+                    self.num_workers = max(0, self.num_workers - 1)
+                else:
+                    self.num_servers = max(0, self.num_servers - 1)
+                self.eviction_totals[role] += 1
+            self.epoch += 1
+            # survivors adopt the shrunken topology (workers rebuild their
+            # server set / adopt the worker count; servers complete
+            # partial rounds) — the elastic recovery path, auto-triggered
+            for r in ("worker", "server"):
+                for node in self._nodes[r]:
+                    self._send_addrbook_to(
+                        node.conn, node.send_lock, r, node.rank, RESIZE_SEQ
+                    )
+            # scrub the dead nodes' pending barrier entries FIRST: a stale
+            # waiter would both satisfy a shrunken barrier early (a live
+            # member never arrived) and skew the round counter, stranding
+            # the late member in the next round
+            doomed_conns = {id(n.conn) for _, n in doomed}
+            for key_waiters in self._barriers.values():
+                key_waiters[:] = [
+                    w for w in key_waiters if id(w[0]) not in doomed_conns
+                ]
+            # a barrier the dead node would have joined can now be full
+            self._release_satisfied_barriers_locked()
+        for _, n in doomed:
+            # FIN wakes a hung-but-alive node's control reader so it
+            # learns it was expelled instead of waiting forever
+            close_socket(n.conn)
+
+    def _release_satisfied_barriers_locked(self) -> None:
+        """After a group shrinks, pending barriers may already be full —
+        release them or every survivor hangs.  Caller holds the lock."""
+        for (group, rnd), waiters in list(self._barriers.items()):
+            size = self._group_size(group)
+            if 0 < size <= len(waiters):
+                self._barrier_round[group] = max(
+                    self._barrier_round[group], rnd + 1
+                )
+                del self._barriers[(group, rnd)]
+                for wconn, wlock, wseq in waiters:
+                    try:
+                        send_message(
+                            wconn, Message(Op.BARRIER, seq=wseq, flags=group),
+                            wlock,
+                        )
+                    except (ConnectionError, OSError):
+                        pass
 
     def stop(self) -> None:
         self._stop.set()
@@ -254,12 +394,22 @@ class Scheduler:
                     nodes[nodes.index(node)] = _Node(
                         rank, info["host"], info["port"], conn, send_lock, uid
                     )
+                    # the slot's IDENTITY changed (new uid, and for a
+                    # server a new address) — surviving peers must hear
+                    # about it or they keep dialing the dead member's
+                    # address; piggyback the membership-epoch broadcast
+                    # on the adoption (see _complete_recovery)
+                    resized = True
                 elif len(nodes) < expected:
                     used = {n.rank for n in nodes}
                     rank = next(r for r in range(expected) if r not in used)
                     nodes.append(
                         _Node(rank, info["host"], info["port"], conn, send_lock, uid)
                     )
+                    # the live rank set GREW: peers (and especially the
+                    # servers' zombie fence) must learn the new member's
+                    # rank is legitimate — broadcast like an adoption
+                    resized = True
                 else:
                     err = {
                         "error": f"cluster full: no dead {role} slot to adopt; "
@@ -314,6 +464,11 @@ class Scheduler:
             self._parked_regs.append((conn, send_lock, role, rank, seq))
             self._pending_broadcast = self._pending_broadcast or resized
             return
+        if resized or self._parked_regs or self._pending_broadcast:
+            # topology-visible change (resize, dead-slot adoption, parked
+            # flush): new membership epoch — stamp it into EVERY book sent
+            # below, the recovering node's included
+            self.epoch += 1
         self._send_addrbook_to(conn, send_lock, role, rank, seq, recovery=True)
         parked, self._parked_regs = self._parked_regs, []
         for pconn, plock, prole, prank, pseq in parked:
@@ -342,6 +497,13 @@ class Scheduler:
             "num_servers": max(self.num_servers, len(servers)),
             "servers": [(n.host, n.port) for n in servers],
             "is_recovery": recovery,
+            # membership observability (docs/robustness.md): receivers
+            # track the epoch and mirror eviction totals into telemetry;
+            # servers use the live worker-rank list as the zombie fence
+            # (pushes from evicted ranks are rejected)
+            "epoch": self.epoch,
+            "evictions": dict(self.eviction_totals),
+            "worker_ranks": sorted(n.rank for n in self._nodes["worker"]),
         }
         try:
             send_message(

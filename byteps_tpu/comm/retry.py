@@ -1,0 +1,77 @@
+"""Shared retry machinery for the self-healing data plane.
+
+One backoff policy serves every layer that re-attempts network work:
+
+- van ``connect()`` smoothing over cluster bring-up races (a worker
+  dialing a scheduler/server that has not bound its port yet),
+- per-RPC retry in :mod:`byteps_tpu.comm.ps_client` (deadline expiry,
+  dropped frames, injected disconnects from the chaos van),
+- the PS client's dead-connection revival.
+
+Exponential backoff with full jitter (the AWS-architecture result: under
+contention, jittered backoff drains a thundering herd an order of
+magnitude faster than synchronized retries) — delay for attempt ``k`` is
+uniform in ``(0, min(cap, base * 2**k)]``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+
+class Backoff:
+    """Exponential backoff schedule with full jitter.
+
+    ``rng`` is injectable so chaos tests can pin the schedule; the
+    default uses a private ``random.Random()`` (never the global seed —
+    training code may have seeded it for data order).
+    """
+
+    def __init__(
+        self,
+        base: float = 0.1,
+        cap: float = 2.0,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.base = max(1e-4, base)
+        self.cap = cap
+        self._rng = rng or random.Random()
+        self.attempt = 0
+
+    def next_delay(self) -> float:
+        """Delay to sleep before the NEXT attempt (advances the schedule)."""
+        ceiling = min(self.cap, self.base * (2 ** self.attempt))
+        self.attempt += 1
+        # full jitter, but never 0: a zero sleep turns a dead-connection
+        # retry loop into a busy spin
+        return ceiling * (0.1 + 0.9 * self._rng.random())
+
+    def reset(self) -> None:
+        self.attempt = 0
+
+
+def call_with_retries(
+    fn: Callable,
+    budget_s: float,
+    retry_on: Tuple[Type[BaseException], ...],
+    base: float = 0.05,
+    cap: float = 1.0,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call ``fn()`` until it succeeds or ``budget_s`` of wall time is
+    spent; re-raises the last error once the budget is exhausted.  Only
+    exceptions in ``retry_on`` are retried — anything else propagates
+    immediately (a refused connection is transient; a bad address is not).
+    """
+    deadline = time.monotonic() + max(0.0, budget_s)
+    bo = Backoff(base=base, cap=cap)
+    while True:
+        try:
+            return fn()
+        except retry_on:
+            delay = bo.next_delay()
+            if time.monotonic() + delay >= deadline:
+                raise
+            sleep(delay)

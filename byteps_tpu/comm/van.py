@@ -24,6 +24,16 @@ publishes in the scheduler book encodes the scheme, so clients need no
 config).  Addresses stay ``(host, port)`` shaped for the control plane:
 a UDS address is ``("unix://<path>", 0)``, an shm address is
 ``("shm+unix://<path>", 0)``.
+
+``BYTEPS_VAN=chaos:<inner>`` wraps any van in the fault-injection layer
+(comm/chaos.py): the published address gains a ``chaos+`` prefix so
+dialing clients wrap their side too.  See docs/robustness.md.
+
+``connect()`` retries refused/missing-endpoint dials with backoff for up
+to ``BYTEPS_CONNECT_RETRY_S`` (default 2s, bounded by the connect
+timeout): during cluster bring-up the worker/server/scheduler start
+order no longer matters.  A down endpoint still fails fast enough for
+the elastic rebuild path to notice.
 """
 
 from __future__ import annotations
@@ -38,6 +48,32 @@ from typing import Tuple
 
 UNIX_PREFIX = "unix://"
 SHM_PREFIX = "shm+unix://"
+CHAOS_PREFIX = "chaos+"
+
+#: bring-up races surface as these: the peer's port/socket-file does not
+#: exist yet (ECONNREFUSED / ENOENT) — transient by nature, so connect()
+#: retries them with backoff inside a bounded budget
+_RETRYABLE_DIAL_ERRORS = (ConnectionRefusedError, FileNotFoundError)
+
+
+def _dial_retry_budget(timeout: float) -> float:
+    """Seconds to keep re-dialing a refused endpoint.  Deliberately small
+    by default: bring-up races close in well under 2s, while the elastic
+    rebuild/revive paths need a DOWN server to fail fast."""
+    raw = os.environ.get("BYTEPS_CONNECT_RETRY_S", "2")
+    try:
+        budget = float(raw or 0)
+    except ValueError:
+        budget = 2.0
+    return max(0.0, min(budget, timeout))
+
+
+def _dial_with_retry(dial, timeout: float):
+    from byteps_tpu.comm.retry import call_with_retries
+
+    return call_with_retries(
+        dial, _dial_retry_budget(timeout), _RETRYABLE_DIAL_ERRORS
+    )
 
 
 class Van:
@@ -64,7 +100,10 @@ class TcpVan(Van):
         return srv, host, srv.getsockname()[1]
 
     def connect(self, host: str, port: int, timeout: float = 30.0) -> socket.socket:
-        sock = socket.create_connection((host, port), timeout=timeout)
+        def dial():
+            return socket.create_connection((host, port), timeout=timeout)
+
+        sock = _dial_with_retry(dial, timeout)
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
@@ -83,9 +122,18 @@ class UdsVan(Van):
 
     def connect(self, host: str, port: int, timeout: float = 30.0) -> socket.socket:
         path = host[len(UNIX_PREFIX):]
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(timeout)
-        sock.connect(path)
+
+        def dial():
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(timeout)
+            try:
+                sock.connect(path)
+            except BaseException:
+                sock.close()
+                raise
+            return sock
+
+        sock = _dial_with_retry(dial, timeout)
         sock.settimeout(None)
         return sock
 
@@ -285,9 +333,18 @@ class ShmVan(Van):
 
         _check_shm_arch()
         path = host[len(SHM_PREFIX):]
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(timeout)
-        sock.connect(path)
+
+        def dial():
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(timeout)
+            try:
+                s.connect(path)
+            except BaseException:
+                s.close()
+                raise
+            return s
+
+        sock = _dial_with_retry(dial, timeout)
         # default 512KB (was 16MB): payloads larger than the ring stream
         # through it with cheap park/kick handoffs, so capacity buys
         # nothing — while SMALL rings keep the working set in cache/TLB.
@@ -336,15 +393,42 @@ _VANS = {v.name: v for v in (TcpVan(), UdsVan(), ShmVan())}
 
 
 def get_van(name: str = "") -> Van:
-    """Server-side van selection (``BYTEPS_VAN``, default tcp)."""
+    """Server-side van selection (``BYTEPS_VAN``, default tcp).
+
+    ``chaos:<inner>`` wraps the inner van in the fault-injection layer
+    (comm/chaos.py) — its listener chaos-wraps accepted connections and
+    publishes a ``chaos+``-prefixed address so clients wrap theirs."""
     name = name or os.environ.get("BYTEPS_VAN", "tcp")
+    if name.startswith("chaos:"):
+        inner = name[len("chaos:"):]
+        if not inner or inner.startswith("chaos:"):
+            # an empty inner name would re-read BYTEPS_VAN and recurse
+            raise ValueError(
+                f"BYTEPS_VAN={name!r}: chaos needs a concrete inner van "
+                f"(chaos:tcp | chaos:uds | chaos:shm)"
+            )
+        from byteps_tpu.comm.chaos import make_chaos_van
+
+        return make_chaos_van(get_van(inner))
     if name not in _VANS:
-        raise ValueError(f"unknown van {name!r}; available: {sorted(_VANS)}")
+        raise ValueError(
+            f"unknown van {name!r}; available: {sorted(_VANS)} "
+            "(or chaos:<inner>)"
+        )
     return _VANS[name]
+
+
+def strip_chaos(host: str) -> str:
+    """The inner-scheme address of a possibly chaos-prefixed one."""
+    return host[len(CHAOS_PREFIX):] if host.startswith(CHAOS_PREFIX) else host
 
 
 def van_for_address(host: str) -> Van:
     """Client-side dispatch: the scheme is encoded in the address."""
+    if host.startswith(CHAOS_PREFIX):
+        from byteps_tpu.comm.chaos import make_chaos_van
+
+        return make_chaos_van(van_for_address(strip_chaos(host)))
     if host.startswith(SHM_PREFIX):
         return _VANS["shm"]
     return _VANS["uds"] if host.startswith(UNIX_PREFIX) else _VANS["tcp"]

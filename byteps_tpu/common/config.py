@@ -87,6 +87,31 @@ class Config:
 
     # --- failure detection (ps-lite heartbeats, SURVEY §5.3) ---
     heartbeat_interval: float = 5.0  # BYTEPS_HEARTBEAT_INTERVAL; 0 disables
+    # scheduler-side liveness policy: a registered node whose heartbeat
+    # age exceeds this is evicted from the membership (book re-broadcast,
+    # rounds re-sized) — 0 disables eviction (ages stay observable via
+    # Op.QUERY, the pre-policy behavior)
+    dead_node_timeout_s: float = 0.0  # BYTEPS_DEAD_NODE_TIMEOUT_S
+
+    # --- per-RPC deadlines + idempotent retry (self-healing data plane) ---
+    # attempts AFTER the first before a push/pull/init surfaces its error
+    rpc_retries: int = 2  # BYTEPS_RPC_RETRIES; 0 restores fail-fast
+    # per-attempt deadline: a server that neither answers nor closes the
+    # connection within this window is treated as failed (the connection
+    # is torn down and the RPC retried).  0 disables the timer — only
+    # connection death then triggers retry; hung servers are left to the
+    # scheduler's eviction policy.
+    rpc_deadline_s: float = 0.0  # BYTEPS_RPC_DEADLINE_S
+    # exponential-backoff base between attempts (full jitter, capped 2s)
+    rpc_backoff_s: float = 0.1  # BYTEPS_RPC_BACKOFF_S
+    # separate deadline for the init-push barrier, whose ack legitimately
+    # waits for every PEER worker: must exceed worst-case worker skew, so
+    # it is NOT covered by rpc_deadline_s.  0 = none (default); chaos
+    # tests set it small to heal dropped init acks.
+    init_deadline_s: float = 0.0  # BYTEPS_INIT_DEADLINE_S
+    # synchronous push_pull resubmits a DegradedError'd step this many
+    # times (exactly-once safe; api.py) before surfacing the error
+    degraded_step_retries: int = 0  # BYTEPS_DEGRADED_STEP_RETRIES
 
     # --- transport (ps-lite van lanes) ---
     # parallel TCP connections per server, partitions striped across them
@@ -164,6 +189,22 @@ class Config:
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
             heartbeat_interval=float(
                 os.environ.get("BYTEPS_HEARTBEAT_INTERVAL", "5") or "5"
+            ),
+            dead_node_timeout_s=float(
+                os.environ.get("BYTEPS_DEAD_NODE_TIMEOUT_S", "0") or "0"
+            ),
+            rpc_retries=max(0, _env_int("BYTEPS_RPC_RETRIES", 2)),
+            rpc_deadline_s=float(
+                os.environ.get("BYTEPS_RPC_DEADLINE_S", "0") or "0"
+            ),
+            rpc_backoff_s=float(
+                os.environ.get("BYTEPS_RPC_BACKOFF_S", "0.1") or "0.1"
+            ),
+            init_deadline_s=float(
+                os.environ.get("BYTEPS_INIT_DEADLINE_S", "0") or "0"
+            ),
+            degraded_step_retries=max(
+                0, _env_int("BYTEPS_DEGRADED_STEP_RETRIES", 0)
             ),
             tcp_streams=max(1, _env_int("BYTEPS_TCP_STREAMS", 1)),
             native_client=_env_bool("BYTEPS_NATIVE_CLIENT"),

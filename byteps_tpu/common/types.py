@@ -148,6 +148,25 @@ class StatusType(enum.IntEnum):
     ABORTED = 3
     INVALID_ARGUMENT = 4
     IN_PROGRESS = 5
+    # the data plane degraded under the operation (server lost, retries
+    # exhausted, membership shrank) — retrying the STEP is safe and may
+    # succeed once the cluster heals (docs/robustness.md)
+    DEGRADED = 6
+
+
+class DegradedError(RuntimeError):
+    """A push_pull failed because the PS data plane degraded mid-flight —
+    a server died or hung past its retry budget, or the membership
+    changed under the operation.
+
+    Subclasses ``RuntimeError`` so pre-existing handlers keep working.
+    Resubmitting the same step is SAFE: the abandoned round was never
+    published (no worker consumed it), the engine re-runs the key's
+    init barrier against the healed topology on the next submit, and
+    the server dedupes any replayed pushes — summation stays
+    exactly-once.  ``BYTEPS_DEGRADED_STEP_RETRIES`` makes the
+    synchronous API retry automatically (api.py).
+    """
 
 
 @dataclasses.dataclass
@@ -168,6 +187,10 @@ class Status:
     @staticmethod
     def Aborted(msg: str) -> "Status":
         return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def Degraded(msg: str) -> "Status":
+        return Status(StatusType.DEGRADED, msg)
 
     @staticmethod
     def PreconditionError(msg: str) -> "Status":

@@ -49,7 +49,7 @@ class _Job:
     __slots__ = (
         "name", "ctx", "flat", "result", "dtype_id", "average", "handle",
         "pending", "lock", "shape", "np_dtype", "is_jax", "version", "t0",
-        "rowsparse", "device_parts",
+        "rowsparse", "device_parts", "failed",
     )
 
     def __init__(self, name, ctx, flat, result, dtype_id, average, handle,
@@ -76,6 +76,11 @@ class _Job:
         # assembled on DEVICE in _finalize (the result never round-trips
         # through the host uncompressed)
         self.device_parts = device_parts
+        # set when ANY task of this job fails: the abort fence the PS
+        # client checks before (re)sending — a pending retry timer from
+        # an abandoned round must not replay into the re-initialized
+        # next generation (its cleared dedupe ledger would re-sum it)
+        self.failed = False
 
 
 class _StripedStage:
@@ -163,6 +168,12 @@ class PipelineEngine:
         self._device_codecs: Dict[int, object] = {}
         self._compression_lr: float = 1.0
         self._lr_sent_to_servers: float = 1.0
+        # tensor names whose last job failed degraded: their next submit
+        # re-runs the init-push barrier, which resets the key's round
+        # numbering on the (possibly healed) owners — without this the
+        # abandoned round leaves client and server version counters
+        # skewed and every later pull of that key pends forever
+        self._reinit_names: set = set()
 
     # --- lifecycle -------------------------------------------------------
 
@@ -212,7 +223,10 @@ class PipelineEngine:
             try:
                 fn(task)
             except Exception as e:  # surface errors on the handle
-                self._fail_task(task, q.queue_type, repr(e))
+                self._fail_task(
+                    task, q.queue_type, repr(e),
+                    degraded=isinstance(e, (ConnectionError, OSError)),
+                )
 
     # --- submission ------------------------------------------------------
 
@@ -320,7 +334,8 @@ class PipelineEngine:
                     )
             gen = getattr(self.client, "server_generation", 0)
             if (not ctx.initialized or ctx.server_generation != gen
-                    or ctx.engine_epoch != self._epoch):
+                    or ctx.engine_epoch != self._epoch
+                    or ctx.name in self._reinit_names):
                 # engine_epoch mismatch: the registry survived a
                 # shutdown()/init() cycle but this engine's servers are
                 # new (fresh stores) — re-run the init barrier exactly
@@ -340,6 +355,7 @@ class PipelineEngine:
                 ctx.initialized = True
                 ctx.server_generation = gen
                 ctx.engine_epoch = self._epoch
+                self._reinit_names.discard(ctx.name)
             ctx.version += 1
             for part in ctx.partitions:
                 if part.key not in self._seeded:
@@ -546,11 +562,19 @@ class PipelineEngine:
 
         get_state().handles.mark_done(job.handle, None, status)
 
-    def _fail_task(self, task: TensorTableEntry, stage: QueueType, reason: str) -> None:
+    def _fail_task(self, task: TensorTableEntry, stage: QueueType,
+                   reason: str, degraded: bool = False) -> None:
         """Fail a task exactly once: return credits, advance the key's
         round allowance (a failed round can never advance it by completing),
         and surface the error on the handle — callers must never hang in
         synchronize() on a dead cluster.
+
+        ``degraded`` (connection-class failures): the handle raises
+        DegradedError — retryable — and the tensor is marked for a forced
+        re-init barrier on its next submit.  The abandoned round skewed
+        the key's version sequence between client and (possibly new)
+        servers; the barrier resets both sides so a resubmitted step's
+        pulls can actually complete instead of pending forever.
 
         Two paths can race here for one task — a stage-thread exception and
         the dead-connection error callback — so the job lock + task.failed
@@ -561,10 +585,18 @@ class PipelineEngine:
             if task.failed:
                 return
             task.failed = True
+            job.failed = True  # abort fence: stops sibling tasks' retries
         self.queues[stage].report_finish(task)
         self._push_ready.add_ready_count(task.key)
         self.queues[QueueType.PUSH].notify()
-        self._fail_job(job, Status.Aborted(f"{stage.name}: {reason}"))
+        if degraded:
+            from byteps_tpu.core.telemetry import counters
+
+            counters().bump("degraded_jobs")
+            self._reinit_names.add(job.name)
+            self._fail_job(job, Status.Degraded(f"{stage.name}: {reason}"))
+        else:
+            self._fail_job(job, Status.Aborted(f"{stage.name}: {reason}"))
 
     def _finalize(self, job: _Job) -> None:
         """All partitions done: average (the plugin-side div by size,
@@ -662,8 +694,9 @@ class PipelineEngine:
             cb=lambda: self._proceed(task),
             request_type=rtype,
             on_error=lambda: self._fail_task(
-                task, QueueType.PUSH, "server connection lost"
+                task, QueueType.PUSH, "server connection lost", degraded=True
             ),
+            abort_check=lambda: job.failed,
         )
 
     def _pull_once(self, task: TensorTableEntry) -> None:
@@ -685,8 +718,10 @@ class PipelineEngine:
                 request_type=RequestType.ROW_SPARSE_PUSH_PULL,
                 payload=job.rowsparse["pull_req"],
                 on_error=lambda: self._fail_task(
-                    task, QueueType.PULL, "server connection lost"
+                    task, QueueType.PULL, "server connection lost",
+                    degraded=True,
                 ),
+                abort_check=lambda: job.failed,
             )
             return
 
@@ -728,8 +763,9 @@ class PipelineEngine:
             if compressed else RequestType.DEFAULT_PUSH_PULL,
             sink=sink,
             on_error=lambda: self._fail_task(
-                task, QueueType.PULL, "server connection lost"
+                task, QueueType.PULL, "server connection lost", degraded=True
             ),
+            abort_check=lambda: job.failed,
         )
 
     def _decompress_once(self, task: TensorTableEntry) -> None:

@@ -10,7 +10,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
-from byteps_tpu.common.types import Status
+from byteps_tpu.common.types import DegradedError, Status, StatusType
 
 
 class HandleManager:
@@ -30,11 +30,16 @@ class HandleManager:
 
     def mark_done(self, handle: int, result: Any, status: Optional[Status] = None) -> None:
         with self._lock:
+            ev = self._events.get(handle)
+            if ev is None:
+                # late duplicate completion of an already-cleared handle
+                # (e.g. a retried RPC resolving after its job failed and
+                # the caller synchronized) — storing it would leak the
+                # entry forever, since nobody will wait on it again
+                return
             self._results[handle] = result
             self._status[handle] = status or Status.OK()
-            ev = self._events.get(handle)
-        if ev is not None:
-            ev.set()
+        ev.set()
 
     def poll(self, handle: int) -> bool:
         with self._lock:
@@ -54,6 +59,11 @@ class HandleManager:
             status = self._status.pop(handle)
             del self._events[handle]
         if not status.ok():
+            if status.type == StatusType.DEGRADED:
+                # retryable: the data plane degraded under the op; the
+                # caller (or BYTEPS_DEGRADED_STEP_RETRIES in api.py) may
+                # resubmit the step once the cluster heals
+                raise DegradedError(f"push_pull failed: {status.reason}")
             raise RuntimeError(f"push_pull failed: {status.reason}")
         return result
 

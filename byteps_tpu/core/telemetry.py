@@ -1,9 +1,16 @@
-"""Push/pull speed telemetry.
+"""Push/pull speed telemetry + robustness counters.
 
 Re-design of ``BytePSGlobal::PushPullSpeed`` (global.cc:697-752): a windowed
 MB/s counter over recent push_pull byte volume, exposed to Python as
 ``bps.get_pushpull_speed()`` (common/__init__.py:131-139).  Gate:
 ``BYTEPS_TELEMETRY_ON``.
+
+The robustness counters (:func:`counters`) make data-plane degradation
+observable: every retry, deadline expiry, connection revival, server-side
+duplicate-push suppression, chaos-van injected fault, and membership
+eviction bumps a named counter.  They are process-global and always on —
+a counter bump is one dict update under a lock, and the self-healing
+paths they instrument are rare by construction.
 """
 
 from __future__ import annotations
@@ -11,7 +18,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Deque, Tuple
+from typing import Deque, Dict, Tuple
 
 WINDOW_SEC = 10.0  # reference uses a 10-second window (global.cc:703)
 
@@ -46,3 +53,56 @@ class PushPullSpeed:
                 return 0.0
             span = max(now - self._events[0][0], 1e-6)
             return self._total_bytes / span / 1e6
+
+
+class RobustnessCounters:
+    """Named monotonic counters for data-plane degradation events.
+
+    Canonical names (consumers may add others):
+
+    - ``rpc_retry``            — a push/pull/init attempt was re-sent
+    - ``rpc_deadline_expired`` — a per-RPC deadline fired (hung server)
+    - ``rpc_giveup``           — retries exhausted; error surfaced
+    - ``conn_revive``          — a dead server connection was rebuilt
+    - ``push_dedup``           — server suppressed a replayed push
+    - ``degraded_jobs``        — engine jobs failed with DegradedError
+    - ``worker_evicted`` / ``server_evicted`` — evictions observed from
+      the scheduler's membership broadcasts (cumulative)
+    - ``chaos_drop`` / ``chaos_delay`` / ``chaos_disconnect`` /
+      ``chaos_truncate`` / ``chaos_corrupt`` — injected faults
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def set_floor(self, name: str, value: int) -> None:
+        """Raise a counter to ``value`` if below it — used for cumulative
+        totals observed from broadcasts, which may be re-delivered."""
+        with self._lock:
+            if self._counts.get(name, 0) < value:
+                self._counts[name] = value
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+_counters = RobustnessCounters()
+
+
+def counters() -> RobustnessCounters:
+    """The process-global robustness counter set."""
+    return _counters

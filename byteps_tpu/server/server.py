@@ -30,6 +30,7 @@ import itertools
 import json
 import socket
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -72,6 +73,7 @@ class _KeyState:
         "pushed_total",
         "pending_pulls",
         "init_waiters",
+        "push_seen",
         "dtype",
         "compressor_kwargs",
         "compressor",
@@ -92,7 +94,15 @@ class _KeyState:
         self.pending_pulls: List[
             Tuple[int, socket.socket, threading.Lock, int, bool, Optional[bytes]]
         ] = []
-        self.init_waiters: List[Tuple[socket.socket, threading.Lock, int]] = []
+        # (worker_flag, conn, send_lock, seq); worker_flag 0 = anonymous
+        self.init_waiters: List[Tuple[int, socket.socket, threading.Lock, int]] = []
+        # replay dedupe (docs/robustness.md): worker_flag → newest summed
+        # push version.  Per (key, worker) versions are strictly
+        # increasing (the engine's round gate), so a replayed push — the
+        # worker's retry after a lost ack or dropped frame — arrives with
+        # version <= the recorded one and is acked WITHOUT re-summing:
+        # retried summation stays exactly-once.
+        self.push_seen: Dict[int, int] = {}
         self.dtype: Optional[np.dtype] = None
         self.compressor_kwargs: Dict[str, str] = {}
         self.compressor = None  # server-side chain (no momentum)
@@ -186,6 +196,12 @@ class PSServer:
         ]
         self.rank: Optional[int] = None
         self.num_workers = cfg.num_worker
+        # zombie fence (docs/robustness.md): worker flags (rank+1) the
+        # scheduler's latest book lists as LIVE; None = no book seen yet /
+        # book without ranks → fence off.  Pushes from evicted ranks are
+        # rejected so a stalled-but-alive worker cannot pollute rounds
+        # sized for the shrunken membership.
+        self._live_worker_flags: Optional[set] = None
         self._sched_conn: Optional[socket.socket] = None
         self._reducer = _make_reducer()
         import os
@@ -218,13 +234,14 @@ class PSServer:
             self._sock.close()  # listener: no peer to FIN
         except OSError:
             pass
-        from byteps_tpu.comm.van import UNIX_PREFIX
+        from byteps_tpu.comm.van import UNIX_PREFIX, strip_chaos
 
-        if self.host.startswith(UNIX_PREFIX):
+        host = strip_chaos(self.host)  # chaos:uds publishes chaos+unix://
+        if host.startswith(UNIX_PREFIX):
             import os
 
             try:
-                os.unlink(self.host[len(UNIX_PREFIX):])
+                os.unlink(host[len(UNIX_PREFIX):])
             except OSError:
                 pass
         close_socket(self._sched_conn)
@@ -254,6 +271,7 @@ class PSServer:
         book = json.loads(resp.payload.decode())
         self.rank = book["rank"]
         self.num_workers = book["num_workers"]
+        self._adopt_worker_ranks(book)
         # global barrier before serving (server.cc:506)
         send_message(conn, Message(Op.BARRIER, flags=GROUP_ALL))
         recv_message(conn)
@@ -261,45 +279,56 @@ class PSServer:
         # heartbeat (ps-lite heartbeats, SURVEY §5.3) when enabled, and in
         # all cases the reader for unsolicited control messages — RESIZE_SEQ
         # address books and the scale-down SHUTDOWN must be honored even
-        # with heartbeats disabled (BYTEPS_HEARTBEAT_INTERVAL=0).
+        # with heartbeats disabled (BYTEPS_HEARTBEAT_INTERVAL=0), and
+        # promptly (a book parked until the next heartbeat tick would keep
+        # the zombie fence / worker count stale for a whole interval).
         hb = self.cfg.heartbeat_interval
         from byteps_tpu.comm.rendezvous import RESIZE_SEQ
 
-        def handle_control(msg) -> bool:
-            """True = keep draining; False = this was the ping response."""
+        def handle_control(msg) -> None:
             if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
                 book = json.loads(msg.payload.decode())
                 self.update_num_workers(book["num_workers"])
-                return True
+                self._adopt_worker_ranks(book)
+                return
             if msg.op == Op.SHUTDOWN:
                 # elastic scale-down dropped this server from the book;
                 # stop serving (stop() joins threads — run it off-thread)
                 threading.Thread(target=self.stop, daemon=True).start()
                 raise ConnectionError("scheduler requested shutdown")
-            return False
+            # PING responses and anything else: drained, no action
 
-        def beat() -> None:
-            try:
-                while not self._stop.wait(hb):
-                    send_message(conn, Message(Op.PING))
-                    # drain until the PING response: unsolicited control
-                    # messages arrive interleaved on this conn
-                    while handle_control(recv_message(conn)):
-                        pass
-            except (ConnectionError, OSError):
-                return
+        def control_loop() -> None:
+            """Heartbeat + prompt control-message delivery on one thread:
+            select() waits for control traffic between beats, so RESIZE
+            books apply within ~0.3s instead of a heartbeat interval."""
+            import select as _select
 
-        def listen_only() -> None:
+            next_beat = time.monotonic() + hb if hb > 0 else None
             try:
                 while not self._stop.is_set():
-                    handle_control(recv_message(conn))
-            except (ConnectionError, OSError):
+                    now = time.monotonic()
+                    if next_beat is not None and now >= next_beat:
+                        send_message(conn, Message(Op.PING))
+                        next_beat = now + hb
+                    readable, _, _ = _select.select([conn], [], [], 0.3)
+                    if readable:
+                        handle_control(recv_message(conn))
+            except (ConnectionError, OSError, ValueError):
                 return
 
         threading.Thread(
-            target=beat if hb > 0 else listen_only,
-            name="ps-heartbeat", daemon=True,
+            target=control_loop, name="ps-heartbeat", daemon=True,
         ).start()
+
+    def _adopt_worker_ranks(self, book: dict) -> None:
+        """Refresh the zombie fence from a scheduler book.  Books without
+        a rank list (older schedulers) disable the fence."""
+        ranks = book.get("worker_ranks")
+        self._live_worker_flags = (
+            {r + 1 for r in ranks if 0 <= r < 255} if ranks is not None
+            else None
+        )
 
     # --- connection plane ------------------------------------------------
 
@@ -441,35 +470,70 @@ class PSServer:
 
         n, dtype_id = struct.unpack("!QI", msg.payload)
         ks = self._key_state(msg.key)
+        wid = msg.flags
         with ks.lock:
             if ks.store is None:
                 dtype = to_numpy_dtype(DataType(dtype_id))
                 ks.dtype = dtype
                 ks.store = np.zeros(n, dtype=dtype)
                 ks.accum = np.zeros(n, dtype=dtype)
-            ks.init_waiters.append((conn, send_lock, msg.seq))
-            if len(ks.init_waiters) >= self.num_workers:
-                waiters, ks.init_waiters = ks.init_waiters, []
-                # A completed init barrier (re-)establishes round numbering:
-                # after an elastic resize/resume EVERY worker re-inits and
-                # restarts versions at 1 (ReDeclareTensor semantics,
-                # global.cc:431-436), so stale sync-round state from the
-                # previous generation must not gate the new sequence.  Store
-                # CONTENTS survive (async parameter store across resume).
-                ks.store_version = 0
-                ks.recv_count = 0
-                ks.pending_pulls = []
-                # round caches are stamped with version numbers that the
-                # new generation will REUSE — a stale cache would serve
-                # the previous generation's bytes as the new round
-                ks.pull_payload = None
-                ks.pull_version = -1
-                ks.raw_payload = None
-                ks.raw_version = -1
+            # keyed by worker identity: a REPLAYED init (retry after a lost
+            # ack / torn connection) replaces this worker's waiter entry —
+            # appending it again would double-count one worker and release
+            # the barrier short.  Anonymous inits (wid 0) keep appending.
+            entry = (wid, conn, send_lock, msg.seq)
+            if wid:
+                for i, w in enumerate(ks.init_waiters):
+                    if w[0] == wid:
+                        ks.init_waiters[i] = entry
+                        break
+                else:
+                    ks.init_waiters.append(entry)
             else:
+                ks.init_waiters.append(entry)
+            waiters = self._complete_init_barrier_locked(ks)
+            if waiters is None:
                 return
-        for wconn, wlock, wseq in waiters:
-            send_message(wconn, Message(Op.INIT, key=msg.key, seq=wseq), wlock)
+        self._release_init_waiters(msg.key, waiters)
+
+    def _complete_init_barrier_locked(self, ks: "_KeyState"):
+        """If the key's init barrier is full, consume it and reset the
+        round state; returns the waiters to release, or None if the
+        barrier is still short.  Caller holds ks.lock."""
+        if not (0 < self.num_workers <= len(ks.init_waiters)):
+            return None
+        waiters, ks.init_waiters = ks.init_waiters, []
+        # A completed init barrier (re-)establishes round numbering:
+        # after an elastic resize/resume EVERY worker re-inits and
+        # restarts versions at 1 (ReDeclareTensor semantics,
+        # global.cc:431-436), so stale sync-round state from the
+        # previous generation must not gate the new sequence.  Store
+        # CONTENTS survive (async parameter store across resume).
+        ks.store_version = 0
+        ks.recv_count = 0
+        ks.pending_pulls = []
+        # the new generation restarts versions at 1, so the replay
+        # ledger from the previous generation must not mark its
+        # first-round pushes as duplicates
+        ks.push_seen = {}
+        # round caches are stamped with version numbers that the
+        # new generation will REUSE — a stale cache would serve
+        # the previous generation's bytes as the new round
+        ks.pull_payload = None
+        ks.pull_version = -1
+        ks.raw_payload = None
+        ks.raw_version = -1
+        return waiters
+
+    @staticmethod
+    def _release_init_waiters(key: int, waiters) -> None:
+        for _wid, wconn, wlock, wseq in waiters:
+            try:
+                send_message(wconn, Message(Op.INIT, key=key, seq=wseq), wlock)
+            except (ConnectionError, OSError):
+                # one dead waiter (it may be mid-retry on a fresh
+                # connection) must not strand the releases behind it
+                continue
 
     @staticmethod
     def _parse_rowsparse(payload: bytes, dtype, with_values: bool):
@@ -489,6 +553,62 @@ class PSServer:
             payload, dtype=dtype, count=nrows * row_len, offset=8 + 4 * nrows
         ).reshape(nrows, row_len)
         return nrows, row_len, idx, vals
+
+    def _is_replayed_push_locked(self, ks: "_KeyState", msg: Message) -> bool:
+        """Exactly-once summation under client retry (caller holds
+        ks.lock).  The ledger holds (worker → newest SUMMED version); per
+        (key, worker) versions are strictly increasing (engine round
+        gate), so an arriving version <= the record is a retransmit whose
+        original WAS summed — ack it, don't re-sum.  Anonymous pushes
+        (flags 0: legacy callers, ranks ≥ 255) are never deduped.
+
+        Read-only: the caller records via :meth:`_record_push_locked`
+        only AFTER the summation succeeded — recording first would mark a
+        push whose sum then RAISED as already-summed, and its retry would
+        be falsely acked (lost contribution).
+
+        Also the zombie fence: a push from a worker the scheduler has
+        EVICTED (rank absent from the latest book's live set) raises —
+        the engine loop drops the connection, so a stalled-but-alive
+        worker cannot pollute rounds sized for the shrunken membership;
+        it learns of its expulsion through the dropped connection."""
+        wid = msg.flags
+        if not wid or msg.version <= 0:
+            return False
+        live = self._live_worker_flags
+        if live is not None and wid not in live:
+            raise RuntimeError(
+                f"push from evicted worker (flag {wid}, key {msg.key})"
+            )
+        if msg.version <= ks.push_seen.get(wid, 0):
+            from byteps_tpu.core.telemetry import counters
+
+            counters().bump("push_dedup")
+            return True
+        return False
+
+    @staticmethod
+    def _record_push_locked(ks: "_KeyState", msg: Message) -> None:
+        """Mark (worker, version) as summed — call under ks.lock, after
+        the summation completed without raising."""
+        if msg.flags and msg.version > 0:
+            ks.push_seen[msg.flags] = msg.version
+
+    @staticmethod
+    def _flush_pulls(key: int, flush: List) -> None:
+        """Answer flushed pending pulls, tolerating dead pullers — one
+        torn connection (its worker is already re-pulling on a fresh one)
+        must not strand the responses queued behind it."""
+        for pconn, plock, pseq, payload, ver in flush:
+            try:
+                send_message(
+                    pconn,
+                    Message(Op.PULL, key=key, payload=payload, seq=pseq,
+                            version=ver),
+                    plock,
+                )
+            except (ConnectionError, OSError):
+                continue
 
     def _handle_push(self, msg: Message, conn, send_lock) -> None:
         ks = self._key_state(msg.key)
@@ -517,7 +637,9 @@ class PSServer:
                 # out instead of waiting forever for an ack (matches the
                 # native server's return-false-drop)
                 raise RuntimeError(f"push for uninitialized key {msg.key}")
-            if self.cfg.enable_async:
+            if self._is_replayed_push_locked(ks, msg):
+                pass  # ack-only (below): the original was already summed
+            elif self.cfg.enable_async:
                 # async mode: parameter store, sum deltas in place
                 # (server.cc:315-319)
                 if compressed:
@@ -526,6 +648,7 @@ class PSServer:
                     self._reducer(ks.store, arr)
                 ks.store_version += 1
                 ks.pushed_total += 1
+                self._record_push_locked(ks, msg)
             else:
                 if compressed:
                     # decompress-then-sum (server.cc:92-118)
@@ -539,15 +662,11 @@ class PSServer:
                     self._reducer(ks.accum, arr)  # SUM_RECV
                 ks.recv_count += 1
                 ks.pushed_total += 1
+                self._record_push_locked(ks, msg)
                 if ks.recv_count >= self.num_workers:
                     flush.extend(self._publish_round_locked(ks, compressed))
         send_message(conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version), send_lock)
-        for pconn, plock, pseq, payload, ver in flush:
-            send_message(
-                pconn,
-                Message(Op.PULL, key=msg.key, payload=payload, seq=pseq, version=ver),
-                plock,
-            )
+        self._flush_pulls(msg.key, flush)
 
     def _handle_push_rowsparse(self, msg: Message, conn, send_lock, ks) -> None:
         """Row-sparse push (RequestType::kRowSparsePushPull,
@@ -572,11 +691,14 @@ class PSServer:
                 raise RuntimeError(
                     f"rowsparse index {int(idx.max())} >= {total_rows} rows"
                 )
-            if self.cfg.enable_async:
+            if self._is_replayed_push_locked(ks, msg):
+                pass  # ack-only: the original scatter-sum already landed
+            elif self.cfg.enable_async:
                 # async parameter store: scatter deltas in place
                 np.add.at(ks.store.reshape(total_rows, row_len), idx, vals)
                 ks.store_version += 1
                 ks.pushed_total += 1
+                self._record_push_locked(ks, msg)
             else:
                 if ks.recv_count == 0:
                     # sparse COPY_FIRST: rows this worker does NOT touch
@@ -586,18 +708,14 @@ class PSServer:
                 np.add.at(ks.accum.reshape(total_rows, row_len), idx, vals)
                 ks.recv_count += 1
                 ks.pushed_total += 1
+                self._record_push_locked(ks, msg)
                 if ks.recv_count >= self.num_workers:
                     flush.extend(self._publish_round_locked(ks, False))
         send_message(
             conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version),
             send_lock,
         )
-        for pconn, plock, pseq, payload, ver in flush:
-            send_message(
-                pconn,
-                Message(Op.PULL, key=msg.key, payload=payload, seq=pseq, version=ver),
-                plock,
-            )
+        self._flush_pulls(msg.key, flush)
 
     def _rowsparse_gather(self, ks: "_KeyState", req_payload: bytes) -> bytes:
         """Serve an RS pull: gather the requested rows from the store."""
@@ -647,8 +765,16 @@ class PSServer:
     def update_num_workers(self, n: int) -> None:
         """Adopt a resized worker population (elastic scale-up/down).  A
         round that already has >= n pushes completes immediately — on
-        scale-down the departed workers' contributions will never arrive."""
+        scale-down the departed workers' contributions will never arrive.
+        Likewise an init barrier that is now full releases immediately:
+        survivors blocked in the init RPC must not wait forever for an
+        evicted worker's INIT."""
         self.num_workers = n
+        for key, ks in list(self._keys.items()):
+            with ks.lock:
+                waiters = self._complete_init_barrier_locked(ks)
+            if waiters:
+                self._release_init_waiters(key, waiters)
         if self.cfg.enable_async:
             return
         for key, ks in list(self._keys.items()):
@@ -656,15 +782,7 @@ class PSServer:
             with ks.lock:
                 if ks.store is not None and 0 < n <= ks.recv_count:
                     flush = self._publish_round_locked(ks, ks.compressor is not None)
-            for pconn, plock, pseq, payload, ver in flush:
-                try:
-                    send_message(
-                        pconn,
-                        Message(Op.PULL, key=key, payload=payload, seq=pseq, version=ver),
-                        plock,
-                    )
-                except (ConnectionError, OSError):
-                    pass
+            self._flush_pulls(key, flush)
 
     def _handle_pull(self, msg: Message, conn, send_lock) -> None:
         ks = self._key_state(msg.key)
@@ -778,6 +896,11 @@ class NativePSServer:
         self.num_workers = n
         self._lib.bps_native_server_set_num_workers(self._id, n)
 
+    # shared control-loop surface with PSServer (the register helper is
+    # borrowed unbound); the C++ engine has no zombie fence yet, so the
+    # adopted set is informational only
+    _adopt_worker_ranks = PSServer._adopt_worker_ranks
+
     def start(self, register: bool = True) -> None:
         if register:
             # identical control-plane bring-up to the Python server
@@ -814,7 +937,10 @@ def run_server() -> None:
 
     cfg = Config.from_env()
     if cfg.role == "scheduler":
-        sched = Scheduler(cfg.num_worker, cfg.num_server, port=cfg.ps_root_port)
+        sched = Scheduler(
+            cfg.num_worker, cfg.num_server, port=cfg.ps_root_port,
+            dead_node_timeout=cfg.dead_node_timeout_s,
+        )
         sched.start()
         threading.Event().wait()  # serve forever
     elif cfg.role == "server":

@@ -1,0 +1,253 @@
+"""Chaos van (comm/chaos.py) + the self-healing data plane.
+
+Unit layer: fault decisions are deterministic per (seed, connection
+index) and each fault class produces its documented wire effect.
+
+Cluster layer (the tier-1 deterministic chaos schedule): a live
+1-worker/2-server cluster under ``BYTEPS_VAN=chaos:tcp`` with a fixed
+``BYTEPS_CHAOS_SEED`` and 5% frame drops completes training with
+bitwise-correct sums — dropped requests/acks are healed by per-RPC
+deadlines + retries, and replayed pushes are deduped server-side
+(exactly-once summation, asserted by the sums themselves).
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.comm.chaos import ChaosParams, ChaosSocket
+from byteps_tpu.comm.transport import Message, Op, recv_message, send_message
+from byteps_tpu.core.telemetry import counters
+
+
+def _pair():
+    return socket.socketpair()
+
+
+class TestChaosSocketUnit:
+    def test_deterministic_fault_schedule(self):
+        """Same (seed, connection index) ⇒ identical drop pattern."""
+
+        def run(seed):
+            a, b = _pair()
+            chaos = ChaosSocket(
+                a, ChaosParams(seed=seed, drop=0.4), conn_index=7
+            )
+            for i in range(40):
+                chaos.sendall(bytes([i]) * 10)
+            a.close()
+            b.settimeout(5)
+            got = bytearray()
+            try:
+                while True:
+                    chunk = b.recv(4096)
+                    if not chunk:
+                        break
+                    got.extend(chunk)
+            except OSError:
+                pass
+            b.close()
+            return bytes(got)
+
+        one, two = run(123), run(123)
+        assert one == two
+        assert len(one) < 400  # some frames actually dropped
+        assert run(999) != one  # a different seed reshuffles the schedule
+
+    def test_no_faults_is_passthrough(self):
+        a, b = _pair()
+        chaos = ChaosSocket(a, ChaosParams(seed=1), conn_index=0)
+        send_message(chaos, Message(Op.PING, seq=5, payload=b"xyz"))
+        b.settimeout(5)
+        msg = recv_message(b)
+        assert msg.seq == 5 and msg.payload == b"xyz"
+        a.close()
+        b.close()
+
+    def test_corrupt_flips_magic_and_peer_rejects(self):
+        a, b = _pair()
+        chaos = ChaosSocket(a, ChaosParams(seed=1, corrupt=1.0), conn_index=0)
+        send_message(chaos, Message(Op.PUSH, key=3, seq=1, payload=b"p" * 64))
+        b.settimeout(5)
+        with pytest.raises(ConnectionError, match="bad magic"):
+            recv_message(b)
+        a.close()
+        b.close()
+
+    def test_truncate_tears_down_connection(self):
+        a, b = _pair()
+        chaos = ChaosSocket(a, ChaosParams(seed=4, truncate=1.0), conn_index=0)
+        with pytest.raises(ConnectionError, match="chaos"):
+            send_message(chaos, Message(Op.PUSH, key=1, seq=1, payload=b"q" * 256))
+        # receiver sees a short frame then EOF — detected, not garbage
+        b.settimeout(5)
+        with pytest.raises(ConnectionError):
+            recv_message(b)
+        a.close()
+        b.close()
+
+    def test_disconnect_raises_and_peer_sees_eof(self):
+        a, b = _pair()
+        chaos = ChaosSocket(a, ChaosParams(seed=2, disconnect=1.0), conn_index=0)
+        with pytest.raises(ConnectionError, match="chaos"):
+            chaos.sendall(b"never arrives")
+        b.settimeout(5)
+        assert b.recv(64) == b""
+        a.close()
+        b.close()
+
+
+class TestBringupOrdering:
+    def test_connect_retries_refused_dial_until_listener_appears(self, monkeypatch):
+        """Cluster bring-up race (docs/robustness.md): a worker dialing
+        the scheduler BEFORE it listens must retry ECONNREFUSED within
+        BYTEPS_CONNECT_RETRY_S instead of raising — start order must not
+        matter."""
+        from byteps_tpu.comm.van import get_van
+
+        monkeypatch.setenv("BYTEPS_CONNECT_RETRY_S", "5")
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()  # port reserved but CLOSED: dials get ECONNREFUSED
+
+        results = {}
+
+        def dial():
+            try:
+                results["sock"] = get_van("tcp").connect("127.0.0.1", port)
+            except BaseException as e:  # noqa: BLE001
+                results["err"] = e
+
+        t = threading.Thread(target=dial, daemon=True)
+        t.start()
+        time.sleep(0.4)  # several refused attempts happen in this window
+        assert t.is_alive(), "dial gave up instead of retrying"
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(4)
+        t.join(timeout=10)
+        try:
+            assert "sock" in results, f"dial failed: {results.get('err')!r}"
+            results["sock"].close()
+        finally:
+            srv.close()
+
+    def test_connect_fails_fast_once_budget_spent(self, monkeypatch):
+        """A genuinely down endpoint still fails within the (small)
+        budget — the elastic rebuild/revival paths rely on it."""
+        from byteps_tpu.comm.van import get_van
+
+        monkeypatch.setenv("BYTEPS_CONNECT_RETRY_S", "0.3")
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionRefusedError):
+            get_van("tcp").connect("127.0.0.1", port)
+        assert time.monotonic() - t0 < 3.0
+
+
+class TestChaosCluster:
+    def test_tier1_deterministic_chaos_schedule(self, monkeypatch):
+        """The acceptance schedule: chaos:tcp, fixed seed, 5% drops —
+        30 training rounds across two tensors on a 1-worker/2-server
+        cluster finish with exact sums and at least one observed retry
+        (i.e. the schedule really injected faults and the client really
+        healed them)."""
+        from byteps_tpu.common.config import Config
+        from byteps_tpu.comm.rendezvous import Scheduler
+        from byteps_tpu.server.server import PSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
+        monkeypatch.setenv("BYTEPS_CHAOS_SEED", "1234")
+        monkeypatch.setenv("BYTEPS_CHAOS_DROP", "0.05")
+        monkeypatch.setenv("BYTEPS_RPC_DEADLINE_S", "0.3")
+        monkeypatch.setenv("BYTEPS_INIT_DEADLINE_S", "0.5")
+        monkeypatch.setenv("BYTEPS_RPC_RETRIES", "6")
+        monkeypatch.setenv("BYTEPS_RPC_BACKOFF_S", "0.05")
+        monkeypatch.setenv("BYTEPS_CONNECT_RETRY_S", "0.2")
+        monkeypatch.setenv("BYTEPS_DEGRADED_STEP_RETRIES", "3")
+        counters().reset()
+
+        sched = Scheduler(num_workers=1, num_servers=2, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.2")
+        servers = [PSServer(Config.from_env()) for _ in range(2)]
+        for srv in servers:
+            threading.Thread(target=srv.start, daemon=True).start()
+
+        import byteps_tpu as bps
+
+        failures = {}
+
+        def train():
+            try:
+                bps.init()
+                rng = np.random.default_rng(0)
+                for step in range(30):
+                    for name in ("chaos.a", "chaos.b"):
+                        x = rng.standard_normal(257).astype(np.float32)
+                        out = bps.push_pull(x, name=name, average=False)
+                        # bitwise-exact: one worker ⇒ the sum IS the input;
+                        # a double-summed replayed push would return 2x
+                        np.testing.assert_array_equal(np.asarray(out), x)
+            except BaseException as e:  # noqa: BLE001
+                failures["err"] = e
+
+        t = threading.Thread(target=train, daemon=True)
+        t.start()
+        t.join(timeout=120)
+        try:
+            assert not t.is_alive(), "training hung under the chaos schedule"
+            assert "err" not in failures, f"training failed: {failures['err']!r}"
+            snap = bps.get_robustness_counters()
+            assert snap.get("chaos_drop", 0) > 0, f"no drops injected: {snap}"
+            assert snap.get("rpc_retry", 0) > 0, f"no retries observed: {snap}"
+        finally:
+            bps.shutdown()
+            for srv in servers:
+                srv.stop()
+            sched.stop()
+
+    def test_chaos_address_keeps_native_client_off(self, monkeypatch):
+        """A chaos+ address must route through the Python data plane (the
+        C++ lanes would silently skip the fault layer)."""
+        from byteps_tpu.comm.ps_client import PSClient, _ServerConn
+        from byteps_tpu.common.config import Config
+
+        monkeypatch.setenv("BYTEPS_NATIVE_CLIENT", "1")
+        monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
+        from byteps_tpu.comm.van import get_van
+
+        van = get_van()
+        listener, host, port = van.listen("127.0.0.1")
+        try:
+            accepted = []
+
+            def serve():
+                conn, _ = listener.accept()
+                accepted.append(conn)
+
+            threading.Thread(target=serve, daemon=True).start()
+            pc = PSClient.__new__(PSClient)
+            pc.cfg = Config.from_env()
+            pc.zero_copy_pulls = 0
+            pc._stop = threading.Event()
+            sc = pc._new_conn(host, port)
+            try:
+                assert isinstance(sc, _ServerConn)
+            finally:
+                sc.close_all()
+        finally:
+            listener.close()

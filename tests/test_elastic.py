@@ -143,6 +143,64 @@ class TestMultiWorkerRejoinIdentity:
                     os.environ[k] = v
         sched.stop()
 
+    def test_dead_slot_adoption_broadcasts_epoch_to_survivors(self):
+        """Satellite fix: adopting a dead member's slot changes the
+        slot's IDENTITY, so surviving peers must receive a membership
+        broadcast (epoch bump) instead of staying oblivious — previously
+        the adoption path notified nobody."""
+        import os
+        import time
+
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.server.server import PSServer
+
+        sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
+        sched.start()
+        env = {
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "1",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+        }
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            cfg = Config.from_env()
+            srv = PSServer(cfg)
+            threading.Thread(target=srv.start, daemon=True).start()
+            w0 = PSClient(cfg, node_uid="adopt-w0")
+            w1 = PSClient(cfg, node_uid="adopt-w1")
+            t0 = threading.Thread(target=w0.connect, daemon=True)
+            t0.start()
+            w1.connect()
+            t0.join(10)
+            epoch_before = w0.membership_epoch
+            w1.close()  # dies
+            time.sleep(0.3)
+            w_new = PSClient(cfg)  # fresh uid → adopts w1's dead slot
+            w_new.connect()
+            assert w_new.is_recovery
+            # the SURVIVOR hears about the identity change
+            for _ in range(100):
+                if w0.membership_epoch > epoch_before:
+                    break
+                time.sleep(0.05)
+            assert w0.membership_epoch > epoch_before, (
+                "surviving peer never notified of dead-slot adoption"
+            )
+            assert sched.epoch == w0.membership_epoch
+            w0.close()
+            w_new.close()
+            srv.stop()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        sched.stop()
+
     def test_unknown_uid_restart_adopts_dead_slot(self):
         """A restarted process that lost its uuid (BYTEPS_NODE_UID unset)
         must adopt a dead member's slot — and must never be left hanging
@@ -521,6 +579,221 @@ class TestEngineServerGenerationReinit:
         eng.submit("g.resize", x, average=False, priority=0, version=0, handle=3)
         assert client.inits == first * 2, "generation bump must re-init"
         get_registry().clear()
+
+
+class TestInvoluntaryServerFailure:
+    def test_server_crash_mid_traffic_evicts_and_heals(self, monkeypatch):
+        """Involuntary failure under the chaos van (docs/robustness.md):
+        a PSServer is killed mid-training on a 1-worker/2-server cluster
+        with frame drops injected.  The scheduler's liveness policy must
+        evict it within BYTEPS_DEAD_NODE_TIMEOUT_S (visible in telemetry),
+        the worker must fail over to the surviving server (RESIZE book →
+        rebuild → re-init), and training must resume with exact sums —
+        i.e. no replayed push was double-summed and no step hung."""
+        from byteps_tpu.core.telemetry import counters
+
+        monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
+        monkeypatch.setenv("BYTEPS_CHAOS_SEED", "77")
+        monkeypatch.setenv("BYTEPS_CHAOS_DROP", "0.03")
+        monkeypatch.setenv("BYTEPS_RPC_DEADLINE_S", "0.3")
+        monkeypatch.setenv("BYTEPS_INIT_DEADLINE_S", "0.5")
+        monkeypatch.setenv("BYTEPS_RPC_RETRIES", "3")
+        monkeypatch.setenv("BYTEPS_RPC_BACKOFF_S", "0.05")
+        monkeypatch.setenv("BYTEPS_CONNECT_RETRY_S", "0.2")
+        monkeypatch.setenv("BYTEPS_DEGRADED_STEP_RETRIES", "8")
+        monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.1")
+        monkeypatch.setenv("BYTEPS_DEAD_NODE_TIMEOUT_S", "0.8")
+        counters().reset()
+
+        sched = Scheduler(num_workers=1, num_servers=2, host="127.0.0.1")
+        sched.start()
+        assert sched.dead_node_timeout == 0.8  # env-derived policy
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        servers = [PSServer(Config.from_env()) for _ in range(2)]
+        for srv in servers:
+            threading.Thread(target=srv.start, daemon=True).start()
+
+        import byteps_tpu as bps
+        from byteps_tpu.core.state import get_state
+
+        failures = {}
+        crashed = threading.Event()
+
+        def train():
+            try:
+                bps.init()
+                # keys sized to spread over both servers
+                names = ["inv.a", "inv.b", "inv.c"]
+                for step in range(24):
+                    for name in names:
+                        x = np.full(129, float(step + 1), np.float32)
+                        out = bps.push_pull(x, name=name, average=False)
+                        # exact: a double-summed replay would give 2x
+                        np.testing.assert_array_equal(np.asarray(out), x)
+                    if step == 5:
+                        # hard-kill server 1: listener + conns drop, the
+                        # heartbeat stops — involuntary, mid-traffic
+                        servers[1].stop()
+                        crashed.set()
+            except BaseException as e:  # noqa: BLE001
+                failures["err"] = e
+
+        t = threading.Thread(target=train, daemon=True)
+        t.start()
+        t.join(timeout=120)
+        try:
+            assert not t.is_alive(), "training hung after the server crash"
+            assert "err" not in failures, f"training failed: {failures['err']!r}"
+            assert crashed.is_set()
+            # eviction happened and is observable end to end
+            assert sched.eviction_totals["server"] == 1
+            assert sched.num_servers == 1
+            snap = bps.get_robustness_counters()
+            assert snap.get("server_evicted", 0) == 1, f"telemetry: {snap}"
+            # the worker's client adopted the shrunken membership
+            assert get_state().ps_client.membership_epoch >= 1
+            assert get_state().ps_client.num_servers == 1
+        finally:
+            bps.shutdown()
+            for srv in servers:
+                srv.stop()
+            sched.stop()
+
+
+class TestEvictionBarrierScrub:
+    def test_dead_waiter_scrubbed_so_survivors_pair_up(self):
+        """A node that died INSIDE a barrier must have its waiter entry
+        scrubbed at eviction — otherwise the stale entry releases the
+        shrunken barrier early for one survivor and strands the other in
+        the next round (review finding)."""
+        import os
+        import time
+
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.comm.rendezvous import GROUP_WORKERS
+        from byteps_tpu.server.server import PSServer
+
+        env = {
+            "DMLC_NUM_WORKER": "3",
+            "DMLC_NUM_SERVER": "1",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.1",
+            "BYTEPS_DEAD_NODE_TIMEOUT_S": "0.6",
+        }
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        sched = Scheduler(num_workers=3, num_servers=1, host="127.0.0.1")
+        sched.start()
+        os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+        os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+        old.setdefault("DMLC_PS_ROOT_URI", None)
+        old.setdefault("DMLC_PS_ROOT_PORT", None)
+        try:
+            cfg = Config.from_env()
+            srv = PSServer(cfg)
+            threading.Thread(target=srv.start, daemon=True).start()
+            ws = [PSClient(cfg, node_uid=f"bs-w{i}") for i in range(3)]
+            ts = [
+                threading.Thread(target=w.connect, daemon=True) for w in ws[:2]
+            ]
+            for t in ts:
+                t.start()
+            ws[2].connect()
+            for t in ts:
+                t.join(10)
+
+            # w2 enters a workers barrier, then dies mid-wait (its
+            # barrier call raises ConnectionError on close — expected)
+            def doomed_barrier():
+                try:
+                    ws[2].barrier(GROUP_WORKERS)
+                except ConnectionError:
+                    pass
+
+            threading.Thread(target=doomed_barrier, daemon=True).start()
+            time.sleep(0.3)  # its waiter is registered at the scheduler
+            ws[2].close()
+            for _ in range(100):
+                if sched.eviction_totals["worker"] == 1:
+                    break
+                time.sleep(0.05)
+            assert sched.eviction_totals["worker"] == 1
+
+            # the two survivors must pair up in ONE barrier round — with
+            # the dead waiter left behind, one of them would be stranded
+            done = [threading.Event(), threading.Event()]
+
+            def bar(i):
+                ws[i].barrier(GROUP_WORKERS)
+                done[i].set()
+
+            for i in range(2):
+                threading.Thread(target=bar, args=(i,), daemon=True).start()
+            assert done[0].wait(10) and done[1].wait(10), (
+                "survivor stranded: stale dead waiter skewed the barrier"
+            )
+            for w in ws[:2]:
+                w.close()
+            srv.stop()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        sched.stop()
+
+
+class TestZombieWorkerFence:
+    def test_push_from_evicted_rank_rejected_and_replay_after_failed_sum_resummed(self):
+        """Two server-side guards around the replay ledger
+        (docs/robustness.md): (1) a push from a rank absent from the
+        latest book's live set raises (engine drops the connection) —
+        the stalled-but-alive worker cannot pollute shrunken rounds;
+        (2) the ledger records AFTER summation, so a push whose sum
+        RAISED is not falsely deduped on retry."""
+        import numpy as np
+
+        from byteps_tpu.comm.transport import Message, Op
+        from byteps_tpu.server.server import PSServer, _KeyState
+
+        srv = PSServer.__new__(PSServer)
+        srv._live_worker_flags = {1}  # only rank 0 is live
+        ks = _KeyState()
+        ks.store = np.zeros(4, np.float32)
+
+        zombie = Message(Op.PUSH, key=1, version=3, flags=2)  # rank 1: evicted
+        with ks.lock:
+            with pytest.raises(RuntimeError, match="evicted"):
+                srv._is_replayed_push_locked(ks, zombie)
+
+        live = Message(Op.PUSH, key=1, version=3, flags=1)
+        with ks.lock:
+            # first sight: not a replay — and NOT yet recorded (the sum
+            # could still fail); the same message stays fresh until the
+            # caller records it post-sum
+            assert not srv._is_replayed_push_locked(ks, live)
+            assert not srv._is_replayed_push_locked(ks, live)
+            srv._record_push_locked(ks, live)  # sum succeeded
+            assert srv._is_replayed_push_locked(ks, live)  # replay now
+
+        # fence off (no book / legacy scheduler): anonymous + any rank ok
+        srv._live_worker_flags = None
+        with ks.lock:
+            assert not srv._is_replayed_push_locked(ks, zombie)
+
+    def test_adopt_worker_ranks_from_book(self):
+        from byteps_tpu.server.server import PSServer
+
+        srv = PSServer.__new__(PSServer)
+        srv._adopt_worker_ranks({"worker_ranks": [0, 2]})
+        assert srv._live_worker_flags == {1, 3}
+        srv._adopt_worker_ranks({})  # legacy book: fence off
+        assert srv._live_worker_flags is None
 
 
 class TestRebuildRetrySupersede:

@@ -898,8 +898,14 @@ class TestStripedTcpVan:
 
     def test_stripes_die_together(self, monkeypatch):
         """Killing the server mid-flight must fail pending handles (not
-        hang) even with multiple lanes — one dead lane poisons all."""
+        hang) even with multiple lanes — one dead lane poisons all.
+
+        With the self-healing layer (docs/robustness.md) a push on the
+        poisoned connection then REVIVES it (the server is still alive)
+        and succeeds; with retries disabled it fails fast as before —
+        both contracts are pinned here."""
         monkeypatch.setenv("BYTEPS_TCP_STREAMS", "3")
+        monkeypatch.setenv("BYTEPS_RPC_RETRIES", "0")  # legacy fail-fast
         sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
         sched.start()
         monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
@@ -934,6 +940,19 @@ class TestStripedTcpVan:
                 cb=lambda *a: None, on_error=failed.set,
             )
             assert failed.wait(5), "push on dead conn must fail, not hang"
+
+            # self-healing contract: with retries enabled the same push
+            # revives the connection (server still alive) and SUCCEEDS
+            client.cfg.rpc_retries = 2
+            healed = threading.Event()
+            died = threading.Event()
+            client.push(
+                7, np.zeros(256, np.float32).tobytes(), 0, 2,
+                cb=healed.set, on_error=died.set,
+            )
+            assert healed.wait(10), "retry+revive must heal a dead conn"
+            assert not died.is_set()
+            assert not client._servers[0].dead  # fresh lanes in place
             client.close()
         finally:
             srv.stop()

