@@ -56,7 +56,8 @@ _ZERO_COPIED = object()
 
 
 class _ServerConn:
-    def __init__(self, host: str, port: int, streams: int = 1) -> None:
+    def __init__(self, host: str, port: int, streams: int = 1,
+                 dial_timeout: float = 30.0) -> None:
         from byteps_tpu.comm.shaping import (
             maybe_shape,
             shaping_enabled,
@@ -73,7 +74,7 @@ class _ServerConn:
             streams = 1
         # data-plane link: shaped when BYTEPS_VAN_DELAY_MS /
         # BYTEPS_VAN_RATE_MBPS emulate a DCN link (shaping.py)
-        self.sock = maybe_shape(connect(host, port))
+        self.sock = maybe_shape(connect(host, port, timeout=dial_timeout))
         self.send_lock = threading.Lock()
         # striped lanes (BYTEPS_TCP_STREAMS, tcp only): extra parallel
         # connections to the same server, each framed message riding ONE
@@ -90,7 +91,8 @@ class _ServerConn:
             try:
                 for _ in range(streams - 1):
                     self.stripes.append(
-                        (maybe_shape(connect(host, port)), threading.Lock())
+                        (maybe_shape(connect(host, port, timeout=dial_timeout)),
+                         threading.Lock())
                     )
             except (ConnectionError, OSError):
                 for sock, _ in self.stripes[1:]:
@@ -436,10 +438,35 @@ class PSClient:
         # connection of any RPC that blows its deadline — the drain then
         # fires every pending callback with None and the retry layer takes
         # over.  Lazy: the thread starts on the first armed deadline.
+        #
+        # The same thread doubles as the retry TIMER WHEEL: backoff-delayed
+        # resend callbacks park in a heap and FIRE from the scanner loop,
+        # replacing one short-lived threading.Timer thread per retry (at
+        # chaos-test retry rates that churn was hundreds of thread spawns
+        # per second).  Due callbacks EXECUTE on a small persistent
+        # executor pool (bps-rpc-retry-*, grown on backlog to a fixed
+        # cap), never the scanner itself: a resend can block — revival
+        # dial, or send_msg into the full socket buffer of a hung server —
+        # and the ONLY thing that unblocks a wedged send is the scanner
+        # expiring that connection's deadline and tearing it down, so the
+        # scanner must never be the thread doing the sending.  Bounded
+        # thread count, zero per-retry churn.
         self._rpc_tokens = itertools.count()
         self._outstanding: Dict[int, tuple] = {}
         self._outstanding_lock = threading.Lock()
+        self._scan_cv = threading.Condition(self._outstanding_lock)
+        self._timers: list = []  # heap of (fire_at, tiebreak, fn)
         self._deadline_thread: Optional[threading.Thread] = None
+        import queue as _queue
+
+        self._retry_q: "_queue.Queue" = _queue.Queue()
+        # executor POOL, grown lazily to a small cap: resends serialize
+        # per thread, and one resend can block in a revival dial to a
+        # black-holed server — a healthy server's 0.1s-backoff retry must
+        # not queue behind it for the dial timeout.  Threads persist
+        # (zero per-retry churn); the cap bounds the footprint.
+        self._retry_threads: List[threading.Thread] = []
+        self._retry_pool_cap = 4
 
     # --- rendezvous ------------------------------------------------------
 
@@ -498,6 +525,10 @@ class PSClient:
 
     def close(self) -> None:
         self._stop.set()
+        with self._outstanding_lock:
+            # wake the deadline/timer scanner so it exits (and drains any
+            # parked retry timers through their stop-check fail path)
+            self._scan_cv.notify_all()
         for sc in self._servers:
             sc.close_all()
         close_socket(self._sched)
@@ -694,11 +725,13 @@ class PSClient:
         for sc in old:
             sc.close_all()  # recv loops exit → mark_dead fails pendings
 
-    def _new_conn(self, host: str, port: int):
+    def _new_conn(self, host: str, port: int, dial_timeout: float = 30.0):
         """Build a server connection: the C++ data plane when
         BYTEPS_NATIVE_CLIENT=1 and the lib speaks it (tcp/uds only —
         the shm van's Python client is already zero-copy), else the
-        Python lanes + recv threads."""
+        Python lanes + recv threads.  ``dial_timeout`` bounds the connect
+        (revival dials pass a deadline-scaled bound; the native client
+        keeps its own fixed 30s)."""
         from byteps_tpu.comm.shaping import shaping_enabled
         from byteps_tpu.comm.van import CHAOS_PREFIX, SHM_PREFIX
 
@@ -717,7 +750,8 @@ class PSClient:
                     host, port, streams=self.cfg.tcp_streams,
                     on_zero_copy=self._count_zero_copy,
                 )
-        sc = _ServerConn(host, port, streams=self.cfg.tcp_streams)
+        sc = _ServerConn(host, port, streams=self.cfg.tcp_streams,
+                         dial_timeout=dial_timeout)
         self._start_recv_loops(sc)
         return sc
 
@@ -734,6 +768,18 @@ class PSClient:
         r = self.rank
         return r + 1 if r is not None and 0 <= r < 255 else 0
 
+    def _ensure_scanner_locked(self) -> None:
+        """Start (or wake) the shared deadline/timer scanner thread.
+        Caller holds ``_outstanding_lock``."""
+        if self._deadline_thread is None:
+            self._deadline_thread = threading.Thread(
+                target=self._deadline_loop, name="bps-rpc-deadline",
+                daemon=True,
+            )
+            self._deadline_thread.start()
+        else:
+            self._scan_cv.notify()
+
     def _deadline_arm(self, sc) -> Optional[int]:
         """Register one in-flight RPC attempt with the deadline scanner;
         returns a token for :meth:`_deadline_clear`, or None when
@@ -744,12 +790,7 @@ class PSClient:
         expire = time.monotonic() + self.cfg.rpc_deadline_s
         with self._outstanding_lock:
             self._outstanding[token] = (sc, expire)
-            if self._deadline_thread is None:
-                self._deadline_thread = threading.Thread(
-                    target=self._deadline_loop, name="bps-rpc-deadline",
-                    daemon=True,
-                )
-                self._deadline_thread.start()
+            self._ensure_scanner_locked()
         return token
 
     def _deadline_clear(self, token: Optional[int]) -> None:
@@ -758,31 +799,133 @@ class PSClient:
         with self._outstanding_lock:
             self._outstanding.pop(token, None)
 
+    def _timer_after(self, delay: float, fn) -> None:
+        """Timer wheel: fire ``fn`` after ``delay`` seconds (timed by the
+        ``bps-rpc-deadline`` scanner, executed on the bounded
+        ``bps-rpc-retry-*`` pool).  Replaces per-retry ``threading.Timer``
+        spawning with a handful of persistent threads.  After close(),
+        ``fn`` runs inline so its stop-check resolves the caller (fail →
+        on_error) instead of parking forever."""
+        import heapq
+
+        with self._outstanding_lock:
+            if not self._stop.is_set():
+                heapq.heappush(
+                    self._timers,
+                    (time.monotonic() + delay, next(self._rpc_tokens), fn),
+                )
+                self._ensure_scanner_locked()
+                return
+        fn()
+
+    def _dispatch_retry(self, fn) -> None:
+        """Queue a due retry callback onto the persistent executor pool.
+        An executor may block in a resend (revival dial, wedged send);
+        the scanner stays free to expire deadlines — including the one
+        whose teardown unblocks a wedged send — and a visible backlog
+        grows the pool (to the cap) so one blocked dial doesn't
+        head-of-line-block other servers' retries."""
+        self._retry_q.put(fn)
+        threads = self._retry_threads
+        if not threads or (
+            self._retry_q.qsize() > 0 and len(threads) < self._retry_pool_cap
+        ):
+            t = threading.Thread(
+                target=self._retry_loop,
+                name=f"bps-rpc-retry-{len(threads)}", daemon=True,
+            )
+            threads.append(t)
+            t.start()
+
+    def _retry_loop(self) -> None:
+        import queue as _queue
+
+        while True:
+            try:
+                fn = self._retry_q.get(timeout=0.5)
+            except _queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                # after close(): still run — fn's stop-check fails it out
+                # through on_error instead of stranding its waiter
+                fn()
+            except Exception:  # noqa: BLE001 — executor must survive
+                pass
+
     def _deadline_loop(self) -> None:
-        """Scanner: an RPC past its deadline means its server is hung (a
+        """Deadline scanner + retry timer wheel (one timing thread).
+
+        Deadlines: an RPC past its deadline means its server is hung (a
         dead one would have closed the connection).  Tear the suspect
         connection down — the recv-loop drain fires every pending callback
         with None, so ALL of that connection's RPCs funnel into the one
         retry path, and no late response can race a retried pull into a
-        caller's zero-copy sink (the old lanes are fully dead first)."""
-        tick = max(0.01, min(0.25, self.cfg.rpc_deadline_s / 4))
-        while not self._stop.wait(tick):
-            now = time.monotonic()
-            doomed = []
+        caller's zero-copy sink (the old lanes are fully dead first).
+
+        Timers: backoff-delayed resends parked by :meth:`_timer_after`
+        become DUE here and are handed to the executor thread (see
+        :meth:`_dispatch_retry` for why they must not run on this one).
+        The condition wait sleeps exactly until the next timer or the
+        next deadline scan tick, whichever is sooner, and is notified on
+        every new arm/park so an earlier event never waits behind a
+        longer sleep."""
+        import heapq
+
+        tick = (
+            max(0.01, min(0.25, self.cfg.rpc_deadline_s / 4))
+            if self.cfg.rpc_deadline_s > 0 else 0.25
+        )
+        try:
+            while True:
+                due, doomed = [], []
+                with self._outstanding_lock:
+                    if self._stop.is_set():
+                        return
+                    now = time.monotonic()
+                    while self._timers and self._timers[0][0] <= now:
+                        due.append(heapq.heappop(self._timers)[2])
+                    for t in [
+                        t for t, (_, at) in self._outstanding.items()
+                        if at <= now
+                    ]:
+                        sc, _ = self._outstanding.pop(t)
+                        doomed.append(sc)
+                    if not due and not doomed:
+                        timeout = (
+                            self._timers[0][0] - now if self._timers else None
+                        )
+                        if self._outstanding:
+                            timeout = (
+                                tick if timeout is None else min(timeout, tick)
+                            )
+                        self._scan_cv.wait(timeout)
+                        continue
+                # teardowns on THIS thread (close_all never blocks), due
+                # retries handed to the executor thread (a resend can
+                # block — and the teardown side must stay live to unblock
+                # it; see __init__)
+                if doomed:
+                    counters().bump("rpc_deadline_expired", len(doomed))
+                    for sc in {id(s): s for s in doomed}.values():
+                        try:
+                            sc.close_all()
+                        except Exception:  # noqa: BLE001
+                            pass
+                for fn in due:
+                    self._dispatch_retry(fn)
+        finally:
+            # shutdown drain: every parked retry must still resolve (its
+            # stop-check fails it through on_error) — parking it forever
+            # would strand a synchronize() waiter
             with self._outstanding_lock:
-                expired = [
-                    t for t, (_, at) in self._outstanding.items() if at <= now
-                ]
-                for t in expired:
-                    sc, _ = self._outstanding.pop(t)
-                    doomed.append(sc)
-            if not doomed:
-                continue
-            counters().bump("rpc_deadline_expired", len(doomed))
-            for sc in {id(s): s for s in doomed}.values():
+                leftovers = [fn for _, _, fn in self._timers]
+                self._timers.clear()
+            for fn in leftovers:
                 try:
-                    sc.close_all()
-                except Exception:  # noqa: BLE001 — scanner must survive
+                    fn()
+                except Exception:  # noqa: BLE001
                     pass
 
     def _async_rpc(
@@ -793,6 +936,7 @@ class PSClient:
         on_error: Optional[Callable[[], None]],
         sink: Optional[memoryview] = None,
         abort_check: Optional[Callable[[], bool]] = None,
+        precheck: Optional[Callable[[], bool]] = None,
     ) -> None:
         """Send one async RPC with deadline + retry + revival.
 
@@ -809,6 +953,14 @@ class PSClient:
         timer armed before the abandonment could replay an
         old-generation push AFTER the re-init barrier cleared the
         server's dedupe ledger, double-summing that worker.
+
+        ``precheck``: evaluated before EVERY attempt (first and retries);
+        returning False fails the RPC straight to ``on_error`` without
+        sending.  Used by fused frames to bail out the moment the server
+        set resizes — a pre-resize pack's members may no longer share a
+        destination, and the caller's error path knows how to regroup
+        (engine unfuse fallback), while blind resends would just burn the
+        retry budget shipping mis-homed keys.
         """
         from byteps_tpu.comm.retry import Backoff
 
@@ -836,14 +988,15 @@ class PSClient:
                 return
             state["attempt"] += 1
             counters().bump("rpc_retry")
-            t = threading.Timer(backoff.next_delay(), send_attempt)
-            t.daemon = True
-            t.start()
+            # timer wheel, not threading.Timer: no per-retry thread churn
+            self._timer_after(backoff.next_delay(), send_attempt)
 
         def send_attempt() -> None:
             if aborted_cleanup():
                 return
-            if self._stop.is_set():
+            if self._stop.is_set() or (
+                precheck is not None and not precheck()
+            ):
                 fail()
                 return
             try:
@@ -870,6 +1023,9 @@ class PSClient:
                 return  # on_reply(None) already fired → retry scheduled
             try:
                 sc.send_msg(make_msg(seq))
+                # every frame that actually hit the wire (incl. retries) —
+                # the denominator tools/fusion_bench.py compares
+                counters().bump("wire_rpc")
             except (ConnectionError, OSError):
                 # died between alloc and send: claim the callback — if the
                 # drain beat us to it, on_reply(None) already retried
@@ -1070,7 +1226,15 @@ class PSClient:
             if cur is not dead_sc and not getattr(cur, "dead", False):
                 return cur  # another retry already revived this slot
             host, port = self._server_addrs[idx]
-        fresh = self._new_conn(host, port)  # may block; lock NOT held
+        # revival dials get a deadline-scaled bound: with per-RPC
+        # deadlines armed the operator opted into bounded-latency failure
+        # handling, and a black-holed server (SYN dropped, no RST) must
+        # not pin a retry-executor thread for the full 30s van timeout
+        dial_timeout = (
+            min(30.0, max(2.0, 4 * self.cfg.rpc_deadline_s))
+            if self.cfg.rpc_deadline_s > 0 else 30.0
+        )
+        fresh = self._new_conn(host, port, dial_timeout)  # lock NOT held
         with self._rebuild_lock:
             servers = self._servers
             if (self._stop.is_set() or idx >= len(servers)
@@ -1144,6 +1308,70 @@ class PSClient:
             deliver=lambda msg: cb(),
             on_error=on_error,
             abort_check=abort_check,
+        )
+
+    def push_fused(
+        self,
+        members: List[tuple],
+        cb: Callable[[list], None],
+        on_error: Optional[Callable[[], None]] = None,
+        abort_check: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        """One multi-key fused push+pull RPC (Op.FUSED; docs/perf.md).
+
+        ``members`` is ``[(key, cmd, version, payload), ...]`` — small
+        same-server partitions packed by the engine's FUSE stage.  The
+        whole frame shares ONE seq, ONE deadline token, and ONE retry
+        state (vs. 2 × len(members) for unfused push+pull pairs), and is
+        routed by its first member's key.  ``cb`` receives the decoded
+        reply ``[(key, version, merged_bytes), ...]``.
+
+        Replay-safe like :meth:`push`: the frame carries the worker flag,
+        and the server runs every sub-push through the per-(worker, key)
+        exactly-once ledger — a retransmitted frame re-sums nothing that
+        already landed, atomically per member key."""
+        import struct as _struct
+
+        from byteps_tpu.comm.transport import (
+            decode_fused_reply,
+            encode_fused_push,
+        )
+
+        frame = encode_fused_push(members)
+        route_key = members[0][0]
+        flags = self._worker_flag()
+        # generation fence: the pack was grouped under the CURRENT server
+        # set; if a resize lands before any attempt (first or retry), the
+        # members may no longer share a server — fail fast to on_error
+        # (the engine regroups via its unfuse fallback) instead of
+        # re-shipping mis-homed keys until retries exhaust
+        gen0 = self.server_generation
+
+        def deliver(msg: Message) -> None:
+            # decode INSIDE the delivery path: a corrupted reply (chaos
+            # corrupt fault surviving framing, buggy server) must route to
+            # the caller's error handler — raising here would unwind into
+            # the recv lane AFTER the callback was popped and the deadline
+            # cleared, stranding every member with no retry
+            try:
+                reply = decode_fused_reply(msg.payload)
+            except (ValueError, _struct.error):
+                counters().bump("fused_reply_malformed")
+                if on_error is not None:
+                    on_error()
+                return
+            cb(reply)
+
+        self._async_rpc(
+            lambda seq: Message(
+                Op.FUSED, key=route_key, seq=seq, payload=frame,
+                cmd=len(members), flags=flags,
+            ),
+            route_key,
+            deliver=deliver,
+            on_error=on_error,
+            abort_check=abort_check,
+            precheck=lambda: self.server_generation == gen0,
         )
 
     def pull(

@@ -43,6 +43,11 @@ class Op(enum.IntEnum):
     PUSH = 11         # gradient payload; response = ack
     PULL = 12         # request payload; response = aggregated bytes
     REGISTER_COMPRESSOR = 13  # serialized compressor kwargs (operations.cc:396-408)
+    FUSED = 14        # multi-key fused push+pull: request packs N small
+                      # sub-pushes for one server; the response is the N
+                      # merged round payloads (small-tensor coalescing,
+                      # docs/perf.md).  One seq / deadline / retry state
+                      # covers the whole frame.
     # control
     PING = 20
     SHUTDOWN = 21
@@ -169,6 +174,75 @@ def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:
     from byteps_tpu.comm.van import van_for_address
 
     return van_for_address(host).connect(host, port, timeout=timeout)
+
+
+# --- multi-key fusion frames (Op.FUSED) ----------------------------------
+#
+# Request body (network byte order):
+#     u32 count
+#     count × [u64 key, u32 cmd, u32 version, u64 length, length bytes]
+# Response body:
+#     u32 count
+#     count × [u64 key, u32 version, u64 length, length bytes]
+#
+# The outer 32-byte header carries the ROUTE key (first member), the frame
+# seq, and the worker-identity flags byte; each member keeps its own key,
+# Cantor-encoded cmd, and round version so the server sums every sub-push
+# through the per-(worker, key) exactly-once ledger — a retried frame
+# dedupes atomically per member key.
+
+_FUSED_MEMBER_FMT = "!QIIQ"
+_FUSED_MEMBER_SIZE = struct.calcsize(_FUSED_MEMBER_FMT)
+_FUSED_REPLY_FMT = "!QIQ"
+_FUSED_REPLY_SIZE = struct.calcsize(_FUSED_REPLY_FMT)
+
+
+def encode_fused_push(members) -> bytes:
+    """Pack ``[(key, cmd, version, payload), ...]`` into one frame body."""
+    parts = [struct.pack("!I", len(members))]
+    for key, cmd, version, payload in members:
+        parts.append(struct.pack(_FUSED_MEMBER_FMT, key, cmd, version, len(payload)))
+        parts.append(bytes(payload) if not isinstance(payload, bytes) else payload)
+    return b"".join(parts)
+
+
+def decode_fused_push(body: bytes) -> list:
+    """Inverse of :func:`encode_fused_push` → [(key, cmd, version, bytes)]."""
+    (count,) = struct.unpack_from("!I", body, 0)
+    off = 4
+    members = []
+    for _ in range(count):
+        key, cmd, version, length = struct.unpack_from(_FUSED_MEMBER_FMT, body, off)
+        off += _FUSED_MEMBER_SIZE
+        if off + length > len(body):
+            raise ValueError("fused frame truncated")
+        members.append((key, cmd, version, body[off : off + length]))
+        off += length
+    return members
+
+
+def encode_fused_reply(members) -> bytes:
+    """Pack ``[(key, version, payload), ...]`` into one reply body."""
+    parts = [struct.pack("!I", len(members))]
+    for key, version, payload in members:
+        parts.append(struct.pack(_FUSED_REPLY_FMT, key, version, len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def decode_fused_reply(body: bytes) -> list:
+    """Inverse of :func:`encode_fused_reply` → [(key, version, bytes)]."""
+    (count,) = struct.unpack_from("!I", body, 0)
+    off = 4
+    members = []
+    for _ in range(count):
+        key, version, length = struct.unpack_from(_FUSED_REPLY_FMT, body, off)
+        off += _FUSED_REPLY_SIZE
+        if off + length > len(body):
+            raise ValueError("fused reply truncated")
+        members.append((key, version, body[off : off + length]))
+        off += length
+    return members
 
 
 def decode_liveness(payload: bytes) -> dict:

@@ -74,6 +74,23 @@ class Config:
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES (global.cc:43,137)
     threadpool_size: int = 4  # BYTEPS_THREADPOOL_SIZE (global.cc:216)
 
+    # --- small-tensor fusion (docs/perf.md) ---
+    # partitions at or below this many BYTES take the FUSE stage: same-
+    # server neighbors are packed into one multi-key Op.FUSED RPC instead
+    # of per-key push+pull pairs — the hot path stops paying per-message
+    # overhead for bias/layernorm-sized gradients.  0 disables fusion
+    # (every partition keeps its own RPC).  Requires the Python server
+    # engine (the C++ engine does not speak Op.FUSED yet), hence off by
+    # default.
+    fusion_threshold: int = 0  # BYTEPS_FUSION_THRESHOLD
+    # fusion buffer capacity per destination server; a full buffer
+    # flushes immediately
+    fusion_bytes: int = 262144  # BYTEPS_FUSION_BYTES
+    # max milliseconds a buffered partition may wait for more neighbors
+    # before the pack is flushed anyway (latency backstop; the buffer
+    # also flushes eagerly whenever the FUSE queue drains)
+    fusion_cycle_ms: float = 2.0  # BYTEPS_FUSION_CYCLE_MS
+
     # --- key→server sharding (global.cc:158-180, 566-677) ---
     key_hash_fn: str = "djb2"  # naive | built_in | djb2 | sdbm | mixed
     enable_mixed_mode: bool = False
@@ -180,6 +197,11 @@ class Config:
             scheduling=os.environ.get("BYTEPS_SCHEDULING", "priority"),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             threadpool_size=_env_int("BYTEPS_THREADPOOL_SIZE", 4),
+            fusion_threshold=max(0, _env_int("BYTEPS_FUSION_THRESHOLD", 0)),
+            fusion_bytes=max(1, _env_int("BYTEPS_FUSION_BYTES", 262144)),
+            fusion_cycle_ms=max(0.0, float(
+                os.environ.get("BYTEPS_FUSION_CYCLE_MS", "2") or "2"
+            )),
             key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
             enable_mixed_mode=_env_bool("BYTEPS_ENABLE_MIXED_MODE"),
             mixed_mode_bound=_env_int("BYTEPS_MIXED_MODE_BOUND", 101),

@@ -112,6 +112,12 @@ class QueueType(enum.IntEnum):
     COORDINATE_PUSH = 9
     COORDINATE_BROADCAST = 10
     BROADCAST = 11
+    # TPU-native addition (no reference analogue): small-tensor fusion.
+    # Partitions below BYTEPS_FUSION_THRESHOLD bytes take FUSE instead of
+    # PUSH — the stage packs same-server partitions into one multi-key
+    # Op.FUSED frame, and the fused reply fans back out into each
+    # member's PULL stage (docs/perf.md).
+    FUSE = 12
 
 
 QUEUE_NUM = len(QueueType)
@@ -247,6 +253,14 @@ class TensorTableEntry:
     # once-guard: a task may be failed from two racing paths (stage-thread
     # exception AND dead-connection callback); only the first wins
     failed: bool = False
+    # fusion (QueueType.FUSE): the member's slice of a fused reply, set
+    # when the multi-key response fans out — its PULL stage then delivers
+    # locally instead of issuing a wire pull
+    fused_reply: Optional[bytes] = None
+    # scheduler flag: skip the ready-table gate (fusion GROUP tasks — the
+    # members already passed their per-key round gates at the FUSE queue,
+    # re-gating the pack under its route key would deadlock it)
+    gate_exempt: bool = False
 
     def current_stage(self) -> Optional[QueueType]:
         return self.queue_list[0] if self.queue_list else None

@@ -25,7 +25,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -37,6 +37,7 @@ from byteps_tpu.common.types import (
     RequestType,
     Status,
     TensorTableEntry,
+    get_command_type,
     to_datatype,
 )
 from byteps_tpu.core.ready_table import ReadyTable
@@ -83,6 +84,147 @@ class _Job:
         self.failed = False
 
 
+class _FusionGroup:
+    """One flushed fusion pack: member tasks + their staged payloads,
+    shipped as a single multi-key Op.FUSED RPC.  Member keys are unique
+    within a pack (the per-key round gate admits at most one in-flight
+    round per key, and a round has one task per key)."""
+
+    __slots__ = ("members", "done", "lock")
+
+    def __init__(self, members: List[tuple]) -> None:
+        self.members = members  # [(task, payload buffer)]
+        self.done = False  # once-guard: deliver/on_error both race here
+        self.lock = threading.Lock()
+
+
+class _FusionBuffer:
+    """Accumulating pack for one destination server."""
+
+    __slots__ = ("members", "nbytes", "max_priority", "oldest")
+
+    def __init__(self) -> None:
+        self.members: List[tuple] = []
+        self.nbytes = 0
+        self.max_priority = -(1 << 62)
+        self.oldest = time.monotonic()
+
+
+class _Fuser:
+    """Per-destination-server fusion buffers — the FUSE stage's state.
+
+    Small partitions (≤ BYTEPS_FUSION_THRESHOLD bytes) are packed here by
+    destination server instead of each paying its own framed RPC, deadline
+    arm, and retry state.  Flush triggers (each bumps a
+    ``fusion_flush_<reason>`` counter):
+
+    - ``full``:  the pack reached BYTEPS_FUSION_BYTES — ship it.
+    - ``idle``:  the FUSE queue drained, so no more smalls are coming from
+      this burst; holding the pack any longer would only add latency.
+      This keeps sequential single-tensor rounds near-zero-overhead.
+    - ``cycle``: a member has waited BYTEPS_FUSION_CYCLE_MS — the latency
+      backstop for workloads whose FUSE queue never quite drains.
+    """
+
+    def __init__(self, engine: "PipelineEngine") -> None:
+        self._engine = engine
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._bufs: Dict[int, _FusionBuffer] = {}
+        self._cycle_thread: Optional[threading.Thread] = None
+
+    def add(self, task: TensorTableEntry, payload) -> None:
+        sid = self._engine.client.server_for(task.key)
+        full = None
+        with self._lock:
+            buf = self._bufs.get(sid)
+            if buf is None:
+                buf = self._bufs[sid] = _FusionBuffer()
+                # wake the cycle thread: it sleeps indefinitely while
+                # every buffer is empty, and must now arm this pack's
+                # BYTEPS_FUSION_CYCLE_MS deadline
+                self._cv.notify()
+            buf.members.append((task, payload))
+            buf.nbytes += len(payload)
+            buf.max_priority = max(buf.max_priority, task.priority)
+            if buf.nbytes >= self._engine.cfg.fusion_bytes:
+                full = self._bufs.pop(sid)
+        if full is not None:
+            self._emit(full, "full")
+        self._ensure_cycle_thread()
+
+    def drain_idle(self) -> None:
+        """The FUSE queue is empty: flush every pack now."""
+        with self._lock:
+            bufs, self._bufs = self._bufs, {}
+        for buf in bufs.values():
+            self._emit(buf, "idle")
+
+    def _ensure_cycle_thread(self) -> None:
+        if self._cycle_thread is not None:
+            return
+        with self._lock:
+            if self._cycle_thread is not None:
+                return
+            t = threading.Thread(
+                target=self._cycle_loop, name="bps-fusion-cycle", daemon=True
+            )
+            self._cycle_thread = t
+        t.start()
+
+    def _cycle_loop(self) -> None:
+        """BYTEPS_FUSION_CYCLE_MS backstop, event-driven: sleeps until the
+        OLDEST live pack's deadline (woken by add() when a pack is born),
+        not on a fixed half-cycle poll — an idle fuser costs ~2 wakeups/s,
+        not a permanent kHz tick."""
+        cycle_s = max(0.0005, self._engine.cfg.fusion_cycle_ms / 1e3)
+        stop = self._engine._stop
+        while not stop.is_set():
+            aged = []
+            with self._cv:
+                if not self._bufs:
+                    # idle: nothing to age — park until add() notifies
+                    # (bounded so engine shutdown is noticed promptly)
+                    self._cv.wait(0.5)
+                    continue
+                now = time.monotonic()
+                soonest = min(b.oldest for b in self._bufs.values()) + cycle_s
+                if soonest > now:
+                    self._cv.wait(soonest - now)
+                    continue
+                for sid in [
+                    s for s, b in self._bufs.items()
+                    if now - b.oldest >= cycle_s
+                ]:
+                    aged.append(self._bufs.pop(sid))
+            for buf in aged:
+                self._emit(buf, "cycle")
+
+    def _emit(self, buf: _FusionBuffer, reason: str) -> None:
+        """Hand the pack to the PUSH queue as ONE group task.  The group
+        inherits the MAX priority of its members (fusion must never defeat
+        priority scheduling: a pack holding one urgent front-layer gradient
+        outranks every bulkier push below that urgency) and the summed
+        length (credit accounting); ``gate_exempt`` skips the per-key round
+        gate the members already passed at the FUSE queue."""
+        from byteps_tpu.core.telemetry import counters
+
+        counters().bump(f"fusion_flush_{reason}")
+        members = buf.members
+        group = TensorTableEntry(
+            tensor_name="<fused>",
+            key=members[0][0].key,
+            priority=buf.max_priority,
+            version=0,
+            length=sum(t.length for t, _ in members),
+            total_partnum=len(members),
+            queue_list=[QueueType.PUSH],
+            context=_FusionGroup(members),
+            gate_exempt=True,
+        )
+        self._engine.queues[QueueType.PUSH].add_task(group)
+
+
 class _StripedStage:
     """N parallel queues for a stage, striped by key.
 
@@ -110,6 +252,13 @@ class PipelineEngine:
     STAGES_COMPRESSED = [
         QueueType.COPYD2H, QueueType.COMPRESS, QueueType.PUSH,
         QueueType.PULL, QueueType.DECOMPRESS, QueueType.COPYH2D,
+    ]
+    #: small partitions (≤ BYTEPS_FUSION_THRESHOLD bytes) swap PUSH for
+    #: FUSE: the multi-key fused RPC carries both halves of the round
+    #: trip, and the PULL stage delivers the fanned-out reply slice
+    #: locally (docs/perf.md)
+    STAGES_FUSED = [
+        QueueType.COPYD2H, QueueType.FUSE, QueueType.PULL, QueueType.COPYH2D,
     ]
 
     #: monotonically increasing engine-instance id: the tensor registry
@@ -151,10 +300,25 @@ class PipelineEngine:
                 version_gated=True,
                 discipline=disc,
             ),
+            # FUSE shares the PUSH round gate: a fused member obeys the
+            # same per-key round order as an unfused push — the gate just
+            # moves to where the small partition leaves the pipeline
+            QueueType.FUSE: ScheduledQueue(
+                QueueType.FUSE,
+                ready_table=self._push_ready,
+                version_gated=True,
+                discipline=disc,
+            ),
             QueueType.PULL: ScheduledQueue(QueueType.PULL, discipline=disc),
             QueueType.DECOMPRESS: _StripedStage(QueueType.DECOMPRESS, pool),
             QueueType.COPYH2D: ScheduledQueue(QueueType.COPYH2D, discipline=disc),
         }
+        self._fuser = _Fuser(self)
+        # small tasks submitted but not yet handed to the fusion buffer:
+        # the idle-flush decision needs this because queue.pending() can't
+        # see a task COPYD2H has popped but not finished staging
+        self._staged_smalls = 0
+        self._fuse_lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         self._init_lock = threading.Lock()
         # per-key stateful codec chains (per-partition compressor
@@ -182,12 +346,17 @@ class PipelineEngine:
         global.cc:299-317).  The COMPRESS/DECOMPRESS striped pools spawn
         lazily when the first codec registers — uncompressed workers don't
         pay for 2×threadpool_size idle pollers."""
-        for qt, fn in (
+        stages = [
             (QueueType.COPYD2H, self._copy_d2h_once),
             (QueueType.PUSH, self._push_once),
             (QueueType.PULL, self._pull_once),
             (QueueType.COPYH2D, self._copy_h2d_once),
-        ):
+        ]
+        if self.cfg.fusion_threshold > 0:
+            # fusion off (the default) spawns no FUSE poller — the stage
+            # only exists when small partitions can actually route to it
+            stages.insert(1, (QueueType.FUSE, self._fuse_once))
+        for qt, fn in stages:
             self._spawn_stage(qt, fn)
 
     def _spawn_stage(self, qt: QueueType, fn) -> None:
@@ -287,7 +456,18 @@ class PipelineEngine:
         )
         compressed = ctx.partitions and ctx.partitions[0].key in self._compressors
         stages = self.STAGES_COMPRESSED if compressed else self.STAGES
+        # small-tensor fusion: uncompressed partitions at or below the
+        # threshold take FUSE instead of PUSH (compressed partitions keep
+        # their own RPC — their wire size is codec-dependent, and the
+        # default MIN_COMPRESS_BYTES floor keeps genuinely small tensors
+        # out of the codec path anyway)
+        fuse_limit = 0 if compressed else self.cfg.fusion_threshold
+        itemsize = np_dtype.itemsize
         for part in ctx.partitions:
+            small = fuse_limit and part.length * itemsize <= fuse_limit
+            if small:
+                with self._fuse_lock:
+                    self._staged_smalls += 1
             task = TensorTableEntry(
                 tensor_name=name,
                 key=part.key,
@@ -296,7 +476,7 @@ class PipelineEngine:
                 offset=part.offset,
                 length=part.length,
                 total_partnum=len(ctx.partitions),
-                queue_list=list(stages),
+                queue_list=list(self.STAGES_FUSED if small else stages),
                 context=job,
             )
             self.queues[QueueType.COPYD2H].add_task(task)
@@ -551,6 +731,7 @@ class PipelineEngine:
         # completed round (version <= store_version, server.cc:376-409)
         self._push_ready.add_ready_count(task.key)
         self.queues[QueueType.PUSH].notify()
+        self.queues[QueueType.FUSE].notify()
         with job.lock:
             job.pending -= 1
             done = job.pending == 0
@@ -580,7 +761,22 @@ class PipelineEngine:
         the dead-connection error callback — so the job lock + task.failed
         guard makes the second a no-op (credits and the version allowance
         must not be double-counted)."""
-        job: _Job = task.context
+        job = task.context
+        if isinstance(job, _FusionGroup):
+            # a GROUP task failing (stage-thread exception escaping
+            # _push_group) has no job/handle of its own — return its
+            # credit once and route the failure to its members, which own
+            # all the real accounting.  Without this branch the generic
+            # path would touch _Job-only fields and kill the PUSH stage
+            # thread, stalling the whole pipeline.
+            with job.lock:
+                if job.done:
+                    return
+                job.done = True
+            self.queues[QueueType.PUSH].report_finish(task)
+            for mtask, _ in job.members:
+                self._fail_task(mtask, QueueType.FUSE, reason, degraded=degraded)
+            return
         with job.lock:
             if task.failed:
                 return
@@ -589,6 +785,7 @@ class PipelineEngine:
         self.queues[stage].report_finish(task)
         self._push_ready.add_ready_count(task.key)
         self.queues[QueueType.PUSH].notify()
+        self.queues[QueueType.FUSE].notify()
         if degraded:
             from byteps_tpu.core.telemetry import counters
 
@@ -641,16 +838,28 @@ class PipelineEngine:
         staging, core_loops.cc:498-536): the Pallas/jnp packer runs on the
         DEVICE slice first, and what crosses the device→host boundary here
         is the compressed payload — 32× less for onebit."""
-        job: _Job = task.context
-        if job.device_parts is not None:
-            dc = self._device_codecs[task.key]
+        # a small (FUSE-routed) partition leaves the staging window only
+        # once it is visible downstream: _proceed enqueues it into the
+        # FUSE queue BEFORE the counter drops, so the fuser's idle check
+        # (staged == 0 AND fuse queue empty) can never miss it.  The
+        # finally also covers the failure path, or a staging error would
+        # pin the counter and disable idle flushing forever.
+        small = len(task.queue_list) > 1 and task.queue_list[1] == QueueType.FUSE
+        try:
+            job: _Job = task.context
+            if job.device_parts is not None:
+                dc = self._device_codecs[task.key]
+                sl = job.flat[task.offset : task.offset + task.length]
+                task.compressed = dc.compress(sl)  # D2H of the packed payload
+                self._proceed(task)
+                return
             sl = job.flat[task.offset : task.offset + task.length]
-            task.compressed = dc.compress(sl)  # D2H of the packed payload
+            task.cpubuff = sl if isinstance(sl, np.ndarray) else np.asarray(sl)
             self._proceed(task)
-            return
-        sl = job.flat[task.offset : task.offset + task.length]
-        task.cpubuff = sl if isinstance(sl, np.ndarray) else np.asarray(sl)
-        self._proceed(task)
+        finally:
+            if small:
+                with self._fuse_lock:
+                    self._staged_smalls -= 1
 
     def _compress_once(self, task: TensorTableEntry) -> None:
         """COMPRESS stage (core_loops.cc:498-536): run the codec chain on
@@ -667,9 +876,143 @@ class PipelineEngine:
         task.compressed = codec.compress(task.cpubuff)
         self._proceed(task)
 
+    def _fuse_once(self, task: TensorTableEntry) -> None:
+        """FUSE stage: stage a small partition into its destination
+        server's fusion buffer instead of issuing a per-key push RPC.
+        Tasks leave the FUSE queue in priority order (and round-gated per
+        key, same as PUSH), so packs fill highest-priority-first; the
+        flushed group then re-enters the PUSH queue carrying the max
+        member priority."""
+        buf = task.cpubuff
+        payload = (
+            buf.data.cast("B") if buf.flags.c_contiguous else buf.tobytes()
+        )
+        self._fuser.add(task, payload)
+        with self._fuse_lock:
+            staging = self._staged_smalls
+        if staging == 0 and self.queues[QueueType.FUSE].pending() == 0:
+            # pipeline drained: every submitted small has reached the
+            # buffer and none wait in the FUSE queue — this burst is over,
+            # ship what we have rather than paying the cycle-timer latency
+            # on every quiet round.  (Checking the FUSE queue alone is not
+            # enough: COPYD2H feeds us one task at a time and a popped-
+            # but-unstaged task is invisible to pending() — that's what
+            # the _staged_smalls counter tracks.)
+            self._fuser.drain_idle()
+
+    def _push_group(self, group_task: TensorTableEntry, group: _FusionGroup) -> None:
+        """Ship one fusion pack as a single multi-key Op.FUSED RPC and fan
+        the multi-key reply back out to the member tasks' PULL stages."""
+        from byteps_tpu.core.telemetry import counters
+
+        members = group.members
+
+        def finish_group() -> bool:
+            """Group bookkeeping exactly once (credit return); True for
+            the winner of the deliver/on_error race."""
+            with group.lock:
+                if group.done:
+                    return False
+                group.done = True
+            self.queues[QueueType.PUSH].report_finish(group_task)
+            return True
+
+        # the pack was grouped under the server mapping at FUSE time; an
+        # elastic resize may have re-homed members since.  A frame whose
+        # members no longer share a destination would ship keys to a
+        # server that never initialized them — unfuse instead (per-key
+        # pushes re-route per retry, surviving the resize like the
+        # unfused path always has)
+        sids = {self.client.server_for(mtask.key) for mtask, _ in members}
+        if len(sids) > 1:
+            if finish_group():
+                self._unfuse_members(group, "server set resized under pack")
+            return
+
+        wire = [
+            (
+                mtask.key,
+                get_command_type(
+                    RequestType.DEFAULT_PUSH_PULL, mtask.context.dtype_id
+                ),
+                mtask.version,
+                payload,
+            )
+            for mtask, payload in members
+        ]
+        if self.telemetry is not None:
+            self.telemetry.record(sum(len(p) for _, _, _, p in wire))
+        counters().bump("fused_frames")
+        counters().bump("fused_keys", len(members))
+
+        def deliver(replies: list) -> None:
+            if not finish_group():
+                return
+            by_key = {key: payload for key, _ver, payload in replies}
+            for mtask, _ in members:
+                payload = by_key.get(mtask.key)
+                if payload is None or mtask.context.failed:
+                    self._fail_task(
+                        mtask, QueueType.FUSE,
+                        "fused reply missing member key"
+                        if payload is None else "job aborted",
+                        degraded=True,
+                    )
+                    continue
+                mtask.fused_reply = payload
+                self._proceed(mtask)  # FUSE done → PULL delivers locally
+
+        def on_error() -> None:
+            # fused retries exhausted (or the reply was malformed): fall
+            # back to per-key unfused push+pull rather than failing the
+            # members outright — per-key RPCs re-route on every retry, so
+            # whatever broke the FRAME (resize mid-retry, a server that
+            # can't serve fused traffic) doesn't have to cost the step.
+            # A genuinely dead cluster still fails through the unfused
+            # path's own retry budget, same as it always did.
+            if not finish_group():
+                return
+            self._unfuse_members(group, "fused frame failed")
+
+        self.client.push_fused(
+            wire,
+            cb=deliver,
+            on_error=on_error,
+            # the frame is abandoned only when EVERY member's job is —
+            # one live member keeps the whole pack (and its siblings'
+            # cleanup-by-delivery) in flight
+            abort_check=lambda: all(m.context.failed for m, _ in members),
+        )
+
+    def _unfuse_members(self, group: _FusionGroup, reason: str) -> None:
+        """Fall back to per-key unfused push+pull for every live member of
+        a pack that can't (or repeatedly didn't) ship as one frame.  The
+        member re-enters the PUSH queue in place of its FUSE stage — its
+        round allowance still holds (version gates are never consumed), so
+        this is exactly the pipeline the partition would have taken with
+        fusion off.  One-way: a fallback push that fails again surfaces
+        through the normal per-task error path, no re-fusing loop."""
+        from byteps_tpu.core.telemetry import counters
+
+        counters().bump("fused_fallback")
+        for mtask, _ in group.members:
+            if mtask.context.failed or (
+                not mtask.queue_list or mtask.queue_list[0] != QueueType.FUSE
+            ):
+                self._fail_task(
+                    mtask, QueueType.FUSE, f"unfuse fallback: {reason}",
+                    degraded=True,
+                )
+                continue
+            mtask.queue_list[0] = QueueType.PUSH
+            self.queues[QueueType.PUSH].add_task(mtask)
+
     def _push_once(self, task: TensorTableEntry) -> None:
         """Priority-ordered ZPush (RunPushLoopOnce, core_loops.cc:538-582)."""
-        job: _Job = task.context
+        job = task.context
+        if isinstance(job, _FusionGroup):
+            self._push_group(task, job)
+            return
         if job.rowsparse is not None:
             payload = job.rowsparse["push_payload"]
             rtype = RequestType.ROW_SPARSE_PUSH_PULL
@@ -703,6 +1046,19 @@ class PipelineEngine:
         """ZPull into the result buffer (RunPullLoopOnce,
         core_loops.cc:584-618)."""
         job: _Job = task.context
+        if task.fused_reply is not None:
+            # fused member: the multi-key reply already carried this key's
+            # merged round — deliver straight into the partition's slice of
+            # the result buffer (the zero-copy sink destination), no wire
+            # pull
+            payload = task.fused_reply
+            task.fused_reply = None
+            if self.telemetry is not None:
+                self.telemetry.record(len(payload))
+            arr = np.frombuffer(payload, dtype=job.np_dtype)
+            job.result[task.offset : task.offset + task.length] = arr[: task.length]
+            self._proceed(task)
+            return
         compressed = task.key in self._compressors
 
         if job.rowsparse is not None:

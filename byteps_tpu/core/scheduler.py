@@ -80,6 +80,15 @@ class ScheduledQueue:
     def _eligible(self, task: TensorTableEntry) -> bool:
         if self.credit_enabled and task.length * self._itemsize > self._credits:
             return False
+        if task.gate_exempt:
+            # fusion GROUP task: its members each passed their own per-key
+            # round gate before being packed, and the pack's route key is
+            # just the first member's — gating the group under that one key
+            # would stall (or deadlock) the other members' rounds.  The
+            # group still competes on priority (it inherits the max of its
+            # members) and still spends credit, so fusion never defeats
+            # priority scheduling or the in-flight byte budget.
+            return True
         if self._ready_table is not None:
             if self._version_gated:
                 if task.version > self._ready_table.get_count(task.key):
@@ -113,7 +122,8 @@ class ScheduledQueue:
                 self._tasks.pop(i)
                 if self.credit_enabled:
                     self._credits -= t.length * self._itemsize
-                if self._ready_table is not None and not self._version_gated:
+                if (self._ready_table is not None and not self._version_gated
+                        and not t.gate_exempt):
                     # classic rendezvous consumes the accumulated signals
                     # (scheduled_queue.cc:125-163); the version gate keeps
                     # its allowance — completions advance it instead

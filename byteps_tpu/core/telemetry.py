@@ -70,6 +70,23 @@ class RobustnessCounters:
       the scheduler's membership broadcasts (cumulative)
     - ``chaos_drop`` / ``chaos_delay`` / ``chaos_disconnect`` /
       ``chaos_truncate`` / ``chaos_corrupt`` — injected faults
+
+    Small-tensor fusion (docs/perf.md):
+
+    - ``wire_rpc``             — data-plane frames actually sent (every
+      async push/pull/fused attempt, retries included) — the denominator
+      ``tools/fusion_bench.py`` compares fused vs. unfused
+    - ``fused_frames``         — multi-key Op.FUSED frames shipped
+    - ``fused_keys``           — member partitions carried by those frames
+      (``fused_keys / fused_frames`` = achieved pack density)
+    - ``fusion_flush_full`` / ``fusion_flush_idle`` /
+      ``fusion_flush_cycle`` — why each pack left the buffer (capacity
+      reached / pipeline drained / BYTEPS_FUSION_CYCLE_MS backstop) —
+      the first knob to read when tuning threshold vs. cycle
+    - ``fused_fallback``       — packs downgraded to per-key unfused
+      RPCs (server resize under the pack, or fused retries exhausted)
+    - ``fused_reply_malformed`` — fused replies that failed to decode
+      (routed to the frame's error path instead of the recv lane)
     """
 
     def __init__(self) -> None:

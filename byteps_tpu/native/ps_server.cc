@@ -739,9 +739,16 @@ class NativeServer {
 
   void stop() {
     stop_.store(true);
+    // Join the acceptor BEFORE closing the listen fd.  The accept loop
+    // polls with a bounded timeout precisely so this join converges:
+    // shutdown()/close() on a LISTENING AF_UNIX socket does not wake a
+    // blocked accept() on Linux (TCP listeners return EINVAL, unix ones
+    // stay parked forever) — the old shutdown-then-join order hung every
+    // uds/shm native-server teardown.  Closing after the join also
+    // removes the fd-reuse race (poll on a recycled fd number).
+    if (accept_thread_.joinable()) accept_thread_.join();
     if (listen_fd_ >= 0) { shutdown(listen_fd_, SHUT_RDWR); close(listen_fd_); }
     if (!uds_path_.empty()) ::unlink(uds_path_.c_str());
-    if (accept_thread_.joinable()) accept_thread_.join();
     for (auto& t : engine_threads_)
       if (t.joinable()) t.join();
     engine_threads_.clear();
@@ -778,17 +785,35 @@ class NativeServer {
   }
 
   void accept_loop() {
+    // non-blocking + poll tick: accept() must never park unboundedly,
+    // or stop()'s join hangs on vans whose listener shutdown cannot
+    // wake it (AF_UNIX; see stop()).  200ms bounds teardown latency.
+    int fl = fcntl(listen_fd_, F_GETFL, 0);
+    fcntl(listen_fd_, F_SETFL, fl | O_NONBLOCK);
     while (!stop_.load()) {
+      pollfd p{listen_fd_, POLLIN, 0};
+      int pr = ::poll(&p, 1, 200);
+      if (stop_.load()) return;
+      if (pr < 0) {
+        if (errno == EINTR) continue;
+        return;
+      }
+      if (pr == 0) continue;
       int fd = accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) {
         // transient failures (client RST before accept, signals, fd
         // pressure) must not kill the acceptor
-        if (errno == EINTR || errno == ECONNABORTED || errno == EMFILE ||
-            errno == ENFILE || errno == ENOBUFS || errno == ENOMEM) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR ||
+            errno == ECONNABORTED || errno == EMFILE || errno == ENFILE ||
+            errno == ENOBUFS || errno == ENOMEM) {
           continue;
         }
         return;  // listen socket closed (stop) or unrecoverable
       }
+      // accepted fds do not inherit O_NONBLOCK on Linux, but make the
+      // serve loops' blocking assumption explicit
+      int cfl = fcntl(fd, F_GETFL, 0);
+      fcntl(fd, F_SETFL, cfl & ~O_NONBLOCK);
       ConnPtr conn;
       if (uds_path_.empty()) {
         int one = 1;

@@ -72,6 +72,7 @@ class _KeyState:
         "store_version",
         "pushed_total",
         "pending_pulls",
+        "fused_waiters",
         "init_waiters",
         "push_seen",
         "dtype",
@@ -94,6 +95,10 @@ class _KeyState:
         self.pending_pulls: List[
             Tuple[int, socket.socket, threading.Lock, int, bool, Optional[bytes]]
         ] = []
+        # fused-frame pull halves parked on this key:
+        # (version, _FusedReply, slot, wants_compressed) — filled at round
+        # publish; a completed reply rides the same flush list as pulls
+        self.fused_waiters: List[Tuple[int, "_FusedReply", int, bool]] = []
         # (worker_flag, conn, send_lock, seq); worker_flag 0 = anonymous
         self.init_waiters: List[Tuple[int, socket.socket, threading.Lock, int]] = []
         # replay dedupe (docs/robustness.md): worker_flag → newest summed
@@ -139,6 +144,57 @@ class _KeyState:
             self.raw_payload = self.store.tobytes()
             self.raw_version = self.store_version
         return self.raw_payload
+
+
+class _FusedReply:
+    """Accumulator for one Op.FUSED frame's multi-key response.
+
+    Sub-keys' rounds complete independently (another worker's push to key
+    A can publish while key B still waits), possibly on different engine
+    threads — each completed member fills its slot, and the LAST fill
+    (exactly one, lock-guarded) makes the whole frame sendable.  The
+    response leaves as ONE frame so the worker's single seq/deadline/retry
+    state resolves atomically for every member."""
+
+    __slots__ = (
+        "conn", "send_lock", "seq", "route_key", "keys", "slots",
+        "versions", "remaining", "lock",
+    )
+
+    def __init__(self, conn, send_lock, seq: int, route_key: int,
+                 keys: List[int]) -> None:
+        self.conn = conn
+        self.send_lock = send_lock
+        self.seq = seq
+        self.route_key = route_key
+        self.keys = keys
+        self.slots: List[Optional[bytes]] = [None] * len(keys)
+        self.versions = [0] * len(keys)
+        self.remaining = len(keys)
+        self.lock = threading.Lock()
+
+    def fill(self, slot: int, payload: bytes, version: int) -> bool:
+        """Record one member's merged round; True exactly once — when this
+        fill completed the frame (the caller then queues the send)."""
+        with self.lock:
+            if self.slots[slot] is not None:
+                return False  # duplicate publish race: first fill wins
+            self.slots[slot] = payload
+            self.versions[slot] = version
+            self.remaining -= 1
+            return self.remaining == 0
+
+    def send(self) -> None:
+        from byteps_tpu.comm.transport import encode_fused_reply
+
+        body = encode_fused_reply(
+            list(zip(self.keys, self.versions, self.slots))
+        )
+        send_message(
+            self.conn,
+            Message(Op.FUSED, key=self.route_key, seq=self.seq, payload=body),
+            self.send_lock,
+        )
 
 
 class _EngineQueue:
@@ -366,7 +422,7 @@ class PSServer:
         try:
             while not self._stop.is_set():
                 msg = recv_message(conn)
-                if msg.op in (Op.PUSH, Op.PULL, Op.INIT):
+                if msg.op in (Op.PUSH, Op.PULL, Op.INIT, Op.FUSED):
                     self._enqueue(msg, conn, send_lock)
                 elif msg.op == Op.REGISTER_COMPRESSOR and msg.flags & 1:
                     # lr update for every EF chain (flag bit 0; payload =
@@ -446,6 +502,8 @@ class PSServer:
                     self._handle_push(msg, conn, send_lock)
                 elif msg.op == Op.PULL:
                     self._handle_pull(msg, conn, send_lock)
+                elif msg.op == Op.FUSED:
+                    self._handle_fused(msg, conn, send_lock)
             except (ConnectionError, OSError):
                 continue
             except Exception as e:  # noqa: BLE001
@@ -512,6 +570,10 @@ class PSServer:
         ks.store_version = 0
         ks.recv_count = 0
         ks.pending_pulls = []
+        # parked fused pull-halves are from the abandoned generation too —
+        # their frames' round numbering no longer matches (same policy as
+        # pending_pulls: dropped, the worker's retry/deadline path owns it)
+        ks.fused_waiters = []
         # the new generation restarts versions at 1, so the replay
         # ledger from the previous generation must not mark its
         # first-round pushes as duplicates
@@ -596,11 +658,17 @@ class PSServer:
 
     @staticmethod
     def _flush_pulls(key: int, flush: List) -> None:
-        """Answer flushed pending pulls, tolerating dead pullers — one
-        torn connection (its worker is already re-pulling on a fresh one)
-        must not strand the responses queued behind it."""
-        for pconn, plock, pseq, payload, ver in flush:
+        """Answer flushed pending pulls — 5-tuples for plain pulls,
+        :class:`_FusedReply` objects for completed fused frames —
+        tolerating dead pullers: one torn connection (its worker is
+        already re-pulling on a fresh one) must not strand the responses
+        queued behind it."""
+        for entry in flush:
             try:
+                if isinstance(entry, _FusedReply):
+                    entry.send()
+                    continue
+                pconn, plock, pseq, payload, ver = entry
                 send_message(
                     pconn,
                     Message(Op.PULL, key=key, payload=payload, seq=pseq,
@@ -609,6 +677,38 @@ class PSServer:
                 )
             except (ConnectionError, OSError):
                 continue
+
+    def _sum_push_locked(self, ks: "_KeyState", msg: Message,
+                         compressed: bool, arr) -> None:
+        """One (sub-)push's summation under ``ks.lock`` — shared by the
+        plain PUSH and fused paths so both stay behaviorally identical:
+        async mode sums into the live store; sync mode COPY_FIRSTs /
+        SUM_RECVs into the accumulator.  Records the replay-ledger entry
+        only AFTER the summation succeeded (a sum that raises must leave
+        the retry eligible)."""
+        if self.cfg.enable_async:
+            # async mode: parameter store, sum deltas in place
+            # (server.cc:315-319)
+            if compressed:
+                ks.compressor.sum_into(msg.payload, ks.store)
+            else:
+                self._reducer(ks.store, arr)
+            ks.store_version += 1
+        elif compressed:
+            # decompress-then-sum (server.cc:92-118)
+            if ks.recv_count == 0:
+                ks.accum[:] = ks.compressor.decompress(msg.payload, ks.accum.size)
+            else:
+                ks.compressor.sum_into(msg.payload, ks.accum)
+            ks.recv_count += 1
+        elif ks.recv_count == 0:
+            ks.accum[: len(arr)] = arr  # COPY_FIRST (server.cc:296)
+            ks.recv_count += 1
+        else:
+            self._reducer(ks.accum, arr)  # SUM_RECV
+            ks.recv_count += 1
+        ks.pushed_total += 1
+        self._record_push_locked(ks, msg)
 
     def _handle_push(self, msg: Message, conn, send_lock) -> None:
         ks = self._key_state(msg.key)
@@ -627,6 +727,7 @@ class PSServer:
         compressed = (
             rtype == RequestType.COMPRESSED_PUSH_PULL and ks.compressor is not None
         )
+        arr = None
         if not compressed:
             arr = np.frombuffer(msg.payload, dtype=to_numpy_dtype(DataType(dtype_id)))
         flush: List = []
@@ -639,34 +740,85 @@ class PSServer:
                 raise RuntimeError(f"push for uninitialized key {msg.key}")
             if self._is_replayed_push_locked(ks, msg):
                 pass  # ack-only (below): the original was already summed
-            elif self.cfg.enable_async:
-                # async mode: parameter store, sum deltas in place
-                # (server.cc:315-319)
-                if compressed:
-                    ks.compressor.sum_into(msg.payload, ks.store)
-                else:
-                    self._reducer(ks.store, arr)
-                ks.store_version += 1
-                ks.pushed_total += 1
-                self._record_push_locked(ks, msg)
             else:
-                if compressed:
-                    # decompress-then-sum (server.cc:92-118)
-                    if ks.recv_count == 0:
-                        ks.accum[:] = ks.compressor.decompress(msg.payload, ks.accum.size)
-                    else:
-                        ks.compressor.sum_into(msg.payload, ks.accum)
-                elif ks.recv_count == 0:
-                    ks.accum[: len(arr)] = arr  # COPY_FIRST (server.cc:296)
-                else:
-                    self._reducer(ks.accum, arr)  # SUM_RECV
-                ks.recv_count += 1
-                ks.pushed_total += 1
-                self._record_push_locked(ks, msg)
-                if ks.recv_count >= self.num_workers:
+                self._sum_push_locked(ks, msg, compressed, arr)
+                if (not self.cfg.enable_async
+                        and ks.recv_count >= self.num_workers):
                     flush.extend(self._publish_round_locked(ks, compressed))
         send_message(conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version), send_lock)
         self._flush_pulls(msg.key, flush)
+
+    def _handle_fused(self, msg: Message, conn, send_lock) -> None:
+        """Op.FUSED: unpack one multi-key fused frame, run every sub-push
+        through the per-(worker, key) exactly-once ledger, and answer with
+        ONE multi-key reply once every member's round is published.
+
+        Frame-level retry safety falls out per key: the frame carries one
+        worker flag and each member its own round version, so a
+        retransmitted frame (lost reply, deadline teardown) re-sums
+        nothing whose original landed — dedupe is atomic per member key,
+        partial processing included (members summed before a mid-frame
+        error are ledger-recorded; the retry skips exactly those).
+
+        The pull halves that cannot answer yet (peer workers still owe
+        their round) park as ``fused_waiters`` on each key; round publish
+        fills them, and the LAST filled slot queues the one reply frame."""
+        from byteps_tpu.comm.transport import decode_fused_push
+
+        members = decode_fused_push(msg.payload)
+        if not members:
+            raise RuntimeError("empty fused frame")
+        if self._debug:
+            from byteps_tpu.common import logging as bpslog
+
+            bpslog.info(
+                "server fused frame keys=%d bytes=%d v0=%d",
+                len(members), len(msg.payload), members[0][2],
+            )
+        reply = _FusedReply(
+            conn, send_lock, msg.seq, msg.key, [m[0] for m in members]
+        )
+        for slot, (key, cmd, version, payload) in enumerate(members):
+            ks = self._key_state(key)
+            rtype, dtype_id = decode_command_type(cmd)
+            if rtype == RequestType.ROW_SPARSE_PUSH_PULL:
+                raise RuntimeError("row-sparse members cannot fuse")
+            sub = Message(
+                Op.PUSH, key=key, payload=payload, cmd=cmd,
+                version=version, flags=msg.flags,
+            )
+            compressed = (
+                rtype == RequestType.COMPRESSED_PUSH_PULL
+                and ks.compressor is not None
+            )
+            arr = None
+            if not compressed:
+                arr = np.frombuffer(
+                    payload, dtype=to_numpy_dtype(DataType(dtype_id))
+                )
+            flush: List = []
+            with ks.lock:
+                if ks.store is None:
+                    raise RuntimeError(f"push for uninitialized key {key}")
+                if not self._is_replayed_push_locked(ks, sub):
+                    self._sum_push_locked(ks, sub, compressed, arr)
+                    if (not self.cfg.enable_async
+                            and ks.recv_count >= self.num_workers):
+                        flush.extend(
+                            self._publish_round_locked(ks, compressed)
+                        )
+                # this member's pull half: answered now if its round is
+                # published (async mode always is), else parked on the key
+                if self.cfg.enable_async or version <= ks.store_version:
+                    if reply.fill(
+                        slot,
+                        ks.wire_payload(compressed, self.cfg.enable_async),
+                        ks.store_version,
+                    ):
+                        flush.append(reply)
+                else:
+                    ks.fused_waiters.append((version, reply, slot, compressed))
+            self._flush_pulls(key, flush)
 
     def _handle_push_rowsparse(self, msg: Message, conn, send_lock, ks) -> None:
         """Row-sparse push (RequestType::kRowSparsePushPull,
@@ -760,6 +912,16 @@ class PSServer:
             else:
                 still_pending.append((version, pconn, plock, pseq, pcomp, rs_req))
         ks.pending_pulls = still_pending
+        # fused pull-halves parked on this key: fill their reply slots;
+        # a fill that COMPLETES its frame queues the whole reply for send
+        still_fused = []
+        for version, reply, slot, pcomp in ks.fused_waiters:
+            if version <= ks.store_version:
+                if reply.fill(slot, ks.wire_payload(pcomp), ks.store_version):
+                    flush.append(reply)
+            else:
+                still_fused.append((version, reply, slot, pcomp))
+        ks.fused_waiters = still_fused
         return flush
 
     def update_num_workers(self, n: int) -> None:

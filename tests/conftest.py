@@ -29,6 +29,62 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+# --- fail-fast guard for native-lane tests -------------------------------
+#
+# History: a native-server lifecycle bug once parked teardown forever
+# (AF_UNIX accept() ignores listener shutdown), and the 870s tier-1 budget
+# burned idle from the first native test onward — every test sorting after
+# it was simply never counted.  The bug is fixed, but a REGRESSION must
+# fail fast, not eat the rest of the suite.  Two layers, because the hang
+# classes differ:
+#
+# - SIGALRM (the soft layer): raises TimeoutError in the main thread for
+#   Python-level waits (Event.wait, socket recv) — the test fails, the
+#   run continues.  pytest-timeout without the dependency.
+# - faulthandler.dump_traceback_later with exit=True (the hard layer, 2×
+#   the soft budget): a hang INSIDE a ctypes call — e.g. a C-level
+#   pthread_join in bps_native_server_stop, which is exactly what the
+#   original bug was — never re-enters the eval loop, so the SIGALRM
+#   handler can never run.  faulthandler's C watchdog thread needs no
+#   interpreter: it dumps every thread's stack and _exit()s, killing the
+#   run loudly with diagnostics instead of idling out the tier-1 budget.
+
+_NATIVE_GUARD_S = int(os.environ.get("BYTEPS_NATIVE_TEST_TIMEOUT_S", "60"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    import faulthandler
+    import signal
+    import threading
+
+    guard = (
+        _NATIVE_GUARD_S > 0
+        and "native" in item.nodeid
+        and threading.current_thread() is threading.main_thread()
+        and hasattr(signal, "SIGALRM")
+    )
+    if not guard:
+        yield
+        return
+
+    def _alarm(_signum, _frame):
+        raise TimeoutError(
+            f"native test guard: {item.nodeid} exceeded "
+            f"{_NATIVE_GUARD_S}s (BYTEPS_NATIVE_TEST_TIMEOUT_S)"
+        )
+
+    prev = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(_NATIVE_GUARD_S)
+    faulthandler.dump_traceback_later(2 * _NATIVE_GUARD_S, exit=True)
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
 @pytest.fixture(autouse=True)
 def _clean_runtime():
     """Reset global runtime state between tests."""

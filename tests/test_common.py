@@ -53,10 +53,14 @@ class TestTypes:
         assert dtype_size(DataType.BFLOAT16) == 2
 
     def test_queue_enum_has_12_stages(self):
-        # parity with common.h:88-102
-        assert len(QueueType) == 12
+        # parity with common.h:88-102: the reference's 12 stages keep
+        # their exact ids; TPU-native additions (FUSE, small-tensor
+        # fusion) append AFTER the reference range so wire/trace ids
+        # never shift
+        assert len(QueueType) == 13
         assert QueueType.COORDINATE_REDUCE == 0
         assert QueueType.BROADCAST == 11
+        assert QueueType.FUSE == 12
 
     def test_cantor_roundtrip(self):
         for rt in RequestType:

@@ -1,0 +1,212 @@
+"""Small-tensor fusion microbenchmark — fused vs. unfused RPC count and
+step latency on a many-small-keys workload.
+
+The workload the FUSE stage exists for: N small tensors (default 512 ×
+4 KB — the bias/layernorm population of a transformer) pushed+pulled per
+step through a live in-process PS cluster.  Unfused, every key pays its
+own framed push RPC and pull RPC (2N wire messages per step, each with
+its own deadline arm and retry state); fused, same-server neighbors ride
+multi-key Op.FUSED frames.
+
+    python tools/fusion_bench.py [--keys 512] [--bytes 4096] [--steps 10]
+                                 [--threshold 16384] [--delay-ms 0.1]
+                                 [--rate-mbps 0] [--chaos]
+                                 [--out FUSION_BENCH.json]
+
+Runs the SAME deterministic workload twice — BYTEPS_FUSION_THRESHOLD=0
+(off) then =<threshold> — asserts the pull results are bitwise identical
+across modes, and writes a JSON artifact with per-mode wire_rpc counts
+and step-latency stats plus the fused/unfused ratios.  ``--chaos`` adds
+a third+fourth run under the deterministic chaos schedule (fixed seed,
+5% frame drops) and asserts bitwise equality there too.
+
+Acceptance (ISSUE 2): rpc_reduction ≥ 2× and speedup ≥ 1.3× on the
+default workload.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def _reset_runtime() -> None:
+    """Tear down the process-global worker runtime between modes."""
+    from byteps_tpu.common import config as _config
+    from byteps_tpu.common import registry as _registry
+    from byteps_tpu.core import state as _state
+
+    _state.shutdown_state()
+    _registry.reset_registry()
+    _config.clear_config()
+
+
+def run_mode(threshold: int, keys: int, nbytes: int, steps: int,
+             delay_ms: float, rate_mbps: float, chaos: bool) -> dict:
+    """One full cluster bring-up → timed steps → teardown; returns stats
+    plus the final step's results for cross-mode bitwise comparison."""
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.core.telemetry import counters
+    from byteps_tpu.server.server import PSServer
+
+    os.environ["BYTEPS_FUSION_THRESHOLD"] = str(threshold)
+    os.environ["BYTEPS_FUSION_CYCLE_MS"] = "2"
+    os.environ["BYTEPS_VAN_DELAY_MS"] = str(delay_ms)
+    os.environ["BYTEPS_VAN_RATE_MBPS"] = str(rate_mbps)
+    if chaos:
+        os.environ.update({
+            "BYTEPS_VAN": "chaos:tcp",
+            "BYTEPS_CHAOS_SEED": "1234",
+            "BYTEPS_CHAOS_DROP": "0.02",
+            "BYTEPS_RPC_DEADLINE_S": "0.5",
+            "BYTEPS_INIT_DEADLINE_S": "1.0",
+            "BYTEPS_RPC_RETRIES": "8",
+            "BYTEPS_RPC_BACKOFF_S": "0.05",
+            "BYTEPS_CONNECT_RETRY_S": "0.3",
+            "BYTEPS_DEGRADED_STEP_RETRIES": "3",
+        })
+    else:
+        os.environ["BYTEPS_VAN"] = "tcp"
+
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    os.environ.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(sched.port),
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    srv = PSServer(Config.from_env())
+    threading.Thread(target=srv.start, daemon=True).start()
+
+    import byteps_tpu as bps
+
+    n = max(1, nbytes // 4)
+    rng = np.random.default_rng(42)
+    base = [rng.standard_normal(n).astype(np.float32) for _ in range(keys)]
+    names = [f"fb.{i}" for i in range(keys)]
+    final = {}
+    try:
+        bps.init()
+        # warmup step: init barriers + first-round allocation (unfuseable,
+        # excluded from timing)
+        hs = [bps.push_pull_async(x, name=nm, average=False)
+              for nm, x in zip(names, base)]
+        for h in hs:
+            bps.synchronize(h)
+        counters().reset()
+        lat = []
+        for step in range(steps):
+            scale = np.float32(step + 2)
+            t0 = time.perf_counter()
+            hs = [bps.push_pull_async(x * scale, name=nm, average=False)
+                  for nm, x in zip(names, base)]
+            outs = [np.asarray(bps.synchronize(h)) for h in hs]
+            lat.append(time.perf_counter() - t0)
+            for x, out in zip(base, outs):
+                np.testing.assert_array_equal(out, x * scale)
+            if step == steps - 1:
+                final = {nm: out for nm, out in zip(names, outs)}
+        snap = counters().snapshot()
+    finally:
+        bps.shutdown()
+        _reset_runtime()
+        srv.stop()
+        sched.stop()
+    lat.sort()
+    return {
+        "threshold": threshold,
+        "chaos": chaos,
+        "steps": steps,
+        "wire_rpcs": snap.get("wire_rpc", 0),
+        "wire_rpcs_per_step": snap.get("wire_rpc", 0) / steps,
+        "fused_frames": snap.get("fused_frames", 0),
+        "fused_keys": snap.get("fused_keys", 0),
+        "rpc_retry": snap.get("rpc_retry", 0),
+        "flush_full": snap.get("fusion_flush_full", 0),
+        "flush_idle": snap.get("fusion_flush_idle", 0),
+        "flush_cycle": snap.get("fusion_flush_cycle", 0),
+        "step_ms_mean": 1e3 * sum(lat) / len(lat),
+        "step_ms_p50": 1e3 * lat[len(lat) // 2],
+        "step_ms_max": 1e3 * lat[-1],
+        "steps_per_s": len(lat) / sum(lat),
+        "_final": final,
+    }
+
+
+def compare(off: dict, on: dict) -> dict:
+    """Bitwise-compare final-step pulls and compute the headline ratios."""
+    for nm, ref in off["_final"].items():
+        np.testing.assert_array_equal(
+            on["_final"][nm], ref,
+            err_msg=f"fused vs unfused results diverged for {nm}",
+        )
+    return {
+        "rpc_reduction": off["wire_rpcs"] / max(1, on["wire_rpcs"]),
+        "speedup": off["step_ms_mean"] / on["step_ms_mean"],
+        "bitwise_identical": True,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--keys", type=int, default=512)
+    ap.add_argument("--bytes", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--threshold", type=int, default=16384)
+    ap.add_argument("--delay-ms", type=float, default=0.1,
+                    help="shaped-link one-way delay per message")
+    ap.add_argument("--rate-mbps", type=float, default=0.0,
+                    help="shaped-link bandwidth (0 = unlimited)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="also compare under the deterministic chaos schedule")
+    ap.add_argument("--out", default="FUSION_BENCH.json")
+    args = ap.parse_args()
+
+    modes = {}
+    modes["unfused"] = run_mode(0, args.keys, args.bytes, args.steps,
+                                args.delay_ms, args.rate_mbps, False)
+    modes["fused"] = run_mode(args.threshold, args.keys, args.bytes,
+                              args.steps, args.delay_ms, args.rate_mbps,
+                              False)
+    report = {
+        "workload": {
+            "keys": args.keys, "bytes_per_key": args.bytes,
+            "steps": args.steps, "threshold": args.threshold,
+            "delay_ms": args.delay_ms, "rate_mbps": args.rate_mbps,
+        },
+        "clean": compare(modes["unfused"], modes["fused"]),
+    }
+    if args.chaos:
+        modes["unfused_chaos"] = run_mode(0, args.keys, args.bytes,
+                                          args.steps, args.delay_ms,
+                                          args.rate_mbps, True)
+        modes["fused_chaos"] = run_mode(args.threshold, args.keys,
+                                        args.bytes, args.steps,
+                                        args.delay_ms, args.rate_mbps, True)
+        report["chaos"] = compare(modes["unfused_chaos"],
+                                  modes["fused_chaos"])
+    for name, m in modes.items():
+        m.pop("_final")
+        report[name] = m
+    report["acceptance"] = {
+        "rpc_reduction_ge_2x": report["clean"]["rpc_reduction"] >= 2.0,
+        "speedup_ge_1_3x": report["clean"]["speedup"] >= 1.3,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
