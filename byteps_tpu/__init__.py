@@ -44,6 +44,8 @@ from byteps_tpu.api import (
     broadcast_object,
     get_pushpull_speed,
     get_robustness_counters,
+    get_metrics,
+    get_metrics_text,
     set_compression_lr,
 )
 from byteps_tpu.common.types import DegradedError
@@ -94,6 +96,9 @@ __all__ = [
     "broadcast_parameters",
     "broadcast_object",
     "get_pushpull_speed",
+    "get_robustness_counters",
+    "get_metrics",
+    "get_metrics_text",
     "set_compression_lr",
     "DistributedOptimizer",
     "distributed_optimizer",

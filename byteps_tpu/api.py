@@ -324,7 +324,30 @@ def get_robustness_counters() -> dict:
     expiries, connection revivals, replay dedupes, observed evictions,
     injected chaos faults (docs/robustness.md).  Process-wide; usable
     before :func:`init` (counters exist independently of runtime state).
-    """
+
+    FLAT totals only, for back-compat — the per-peer dimension (which
+    server a retry/deadline/revive hit) is in :func:`get_metrics` under
+    ``counters_labeled`` (docs/observability.md)."""
     from byteps_tpu.core.telemetry import counters
 
     return counters().snapshot()
+
+
+def get_metrics() -> dict:
+    """Structured snapshot of the full metrics registry: flat + labeled
+    counters, gauges, and histogram p50/p90/p99 summaries (RPC round
+    trips, per-stage dwell, server sum/publish latency, fused pack
+    density — the catalog lives in docs/observability.md).  Process-wide;
+    usable before :func:`init`."""
+    from byteps_tpu.core.telemetry import metrics
+
+    return metrics().snapshot()
+
+
+def get_metrics_text() -> str:
+    """The Prometheus text exposition this process would serve on
+    ``BYTEPS_METRICS_PORT`` — for logging a scrape without running the
+    HTTP endpoint (docs/observability.md)."""
+    from byteps_tpu.core.telemetry import metrics
+
+    return metrics().render_prometheus()

@@ -115,10 +115,32 @@ class ChaosSocket:
         self._send_lock = threading.Lock()  # fault decisions are ordered
 
     # --- fault engine -----------------------------------------------------
-    def _bump(self, name: str) -> None:
+    def _bump(self, name: str, frame: bytes = b"") -> None:
         from byteps_tpu.core.telemetry import counters
 
         counters().bump(name)
+        self._tag_span(name, frame)
+
+    @staticmethod
+    def _tag_span(name: str, frame: bytes) -> None:
+        """Stamp the injected fault on the OWNING span (the trace context
+        of the frame being faulted), so a rehearsed fault is
+        distinguishable from an organic one on the merged timeline: the
+        instant event shares the victim RPC's trace/span ids and carries
+        ``injected: true`` (docs/observability.md)."""
+        from byteps_tpu.core.tracing import get_process_tracer
+
+        tracer = get_process_tracer()
+        if tracer is None or not tracer.enabled:
+            return
+        args = {"fault": name, "injected": True}
+        if len(frame) >= 48 and frame[2] & 0x80:  # status TRACE_FLAG
+            import struct as _struct
+
+            trace_id, span_id = _struct.unpack_from("!QQ", frame, 32)
+            args["trace"] = format(trace_id, "x")
+            args["span"] = format(span_id, "x")
+        tracer.record_instant("chaos", name, args)
 
     def _die(self, reason: str) -> None:
         try:
@@ -132,15 +154,15 @@ class ChaosSocket:
         with self._send_lock:
             roll = self._rng.random()
             if roll < p.drop:
-                self._bump("chaos_drop")
+                self._bump("chaos_drop", data)
                 return
             roll -= p.drop
             if roll < p.disconnect:
-                self._bump("chaos_disconnect")
+                self._bump("chaos_disconnect", data)
                 self._die("disconnect")
             roll -= p.disconnect
             if roll < p.truncate:
-                self._bump("chaos_truncate")
+                self._bump("chaos_truncate", data)
                 k = self._rng.randrange(0, max(1, len(data)))
                 try:
                     self._sock.sendall(data[:k])
@@ -149,14 +171,14 @@ class ChaosSocket:
                 self._die("truncated frame")
             roll -= p.truncate
             if roll < p.corrupt:
-                self._bump("chaos_corrupt")
+                self._bump("chaos_corrupt", data)
                 mangled = bytearray(data)
                 if mangled:
                     mangled[0] ^= 0xFF  # flip the magic → framing rejects it
                 self._sock.sendall(bytes(mangled))
                 return
             if p.delay > 0 and self._rng.random() < p.delay:
-                self._bump("chaos_delay")
+                self._bump("chaos_delay", data)
                 time.sleep(self._rng.random() * p.delay_ms / 1e3)
             self._sock.sendall(data)
 

@@ -34,7 +34,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from byteps_tpu.core.telemetry import counters
+from byteps_tpu.core.telemetry import counters, metrics
 
 from byteps_tpu.common.config import Config
 from byteps_tpu.common.hashing import assign_server
@@ -579,9 +579,20 @@ class PSClient:
         while not self._stop.is_set():
             if self._stop.wait(interval):
                 return
+            # piggyback this process's metric DELTAS on the beat: the
+            # scheduler folds them into its cluster-wide aggregate
+            # registry (served on its own BYTEPS_METRICS_PORT), so one
+            # scrape of the scheduler sees the whole job without the
+            # scraper having to discover every worker's endpoint
+            delta = metrics().delta_snapshot()
             try:
-                self._sched_request(Message(Op.PING))
+                payload = json.dumps(delta).encode() if delta else b""
+                self._sched_request(Message(Op.PING, payload=payload))
             except (ConnectionError, OSError):
+                # the delta was consumed from the shipped baselines but
+                # never delivered — give it back for the next beat (or a
+                # successor control plane) instead of losing increments
+                metrics().requeue_delta(delta)
                 return
 
     def _sched_recv_loop(self) -> None:
@@ -780,16 +791,17 @@ class PSClient:
         else:
             self._scan_cv.notify()
 
-    def _deadline_arm(self, sc) -> Optional[int]:
+    def _deadline_arm(self, sc, sid: Optional[str] = None) -> Optional[int]:
         """Register one in-flight RPC attempt with the deadline scanner;
         returns a token for :meth:`_deadline_clear`, or None when
-        deadlines are disabled."""
+        deadlines are disabled.  ``sid`` (server-rank string) labels the
+        expiry counter so one hung server stands out of the total."""
         if self.cfg.rpc_deadline_s <= 0:
             return None
         token = next(self._rpc_tokens)
         expire = time.monotonic() + self.cfg.rpc_deadline_s
         with self._outstanding_lock:
-            self._outstanding[token] = (sc, expire)
+            self._outstanding[token] = (sc, expire, sid)
             self._ensure_scanner_locked()
         return token
 
@@ -887,11 +899,11 @@ class PSClient:
                     while self._timers and self._timers[0][0] <= now:
                         due.append(heapq.heappop(self._timers)[2])
                     for t in [
-                        t for t, (_, at) in self._outstanding.items()
+                        t for t, (_, at, _sid) in self._outstanding.items()
                         if at <= now
                     ]:
-                        sc, _ = self._outstanding.pop(t)
-                        doomed.append(sc)
+                        sc, _, sid = self._outstanding.pop(t)
+                        doomed.append((sc, sid))
                     if not due and not doomed:
                         timeout = (
                             self._timers[0][0] - now if self._timers else None
@@ -907,8 +919,12 @@ class PSClient:
                 # block — and the teardown side must stay live to unblock
                 # it; see __init__)
                 if doomed:
-                    counters().bump("rpc_deadline_expired", len(doomed))
-                    for sc in {id(s): s for s in doomed}.values():
+                    for sc, sid in doomed:
+                        counters().bump(
+                            "rpc_deadline_expired",
+                            labels={"server": sid} if sid is not None else None,
+                        )
+                    for sc in {id(s): s for s, _ in doomed}.values():
                         try:
                             sc.close_all()
                         except Exception:  # noqa: BLE001
@@ -966,6 +982,13 @@ class PSClient:
 
         state = {"attempt": 0}
         backoff = Backoff(base=self.cfg.rpc_backoff_s, cap=2.0)
+        # server-rank label for the robustness counters: a single sick
+        # server must be visible in the per-peer dimension, not just as
+        # an anonymous bump of the flat total (docs/observability.md)
+        try:
+            sid = str(self.server_for(key))
+        except (ValueError, ZeroDivisionError, IndexError):
+            sid = "?"
 
         def aborted_cleanup() -> bool:
             """True (and routes to on_error) when the op is abandoned."""
@@ -976,7 +999,7 @@ class PSClient:
             return False
 
         def fail() -> None:
-            counters().bump("rpc_giveup")
+            counters().bump("rpc_giveup", labels={"server": sid})
             if on_error is not None:
                 on_error()
 
@@ -987,7 +1010,7 @@ class PSClient:
                 fail()
                 return
             state["attempt"] += 1
-            counters().bump("rpc_retry")
+            counters().bump("rpc_retry", labels={"server": sid})
             # timer wheel, not threading.Timer: no per-retry thread churn
             self._timer_after(backoff.next_delay(), send_attempt)
 
@@ -1005,6 +1028,7 @@ class PSClient:
                 retry_later()
                 return
             token_box: list = [None]
+            t_sent = time.monotonic()
 
             def on_reply(msg: Optional[Message]) -> None:
                 self._deadline_clear(token_box[0])
@@ -1013,11 +1037,17 @@ class PSClient:
                 elif aborted_cleanup():
                     pass  # late success on an abandoned op: cleanup only
                 else:
+                    # per-ATTEMPT round trip (retries each time their own
+                    # attempt; the retry cost itself shows up in
+                    # retry_backoff_seconds + the rpc_retry counter)
+                    metrics().observe(
+                        "rpc_round_trip_seconds", time.monotonic() - t_sent
+                    )
                     deliver(msg)
 
             # arm BEFORE alloc: alloc_seq on a dead connection fires
             # on_reply(None) synchronously, which must find the token
-            token_box[0] = self._deadline_arm(sc)
+            token_box[0] = self._deadline_arm(sc, sid)
             seq = sc.alloc_seq(on_reply, sink=sink)
             if seq < 0:
                 return  # on_reply(None) already fired → retry scheduled
@@ -1059,10 +1089,14 @@ class PSClient:
             (self.cfg.rpc_deadline_s or None) if use_deadline
             else (self.cfg.init_deadline_s or None)
         )
+        try:
+            sid = str(self.server_for(key))
+        except (ValueError, ZeroDivisionError, IndexError):
+            sid = "?"
         last: Optional[BaseException] = None
         for attempt in range(self.cfg.rpc_retries + 1):
             if attempt:
-                counters().bump("rpc_retry")
+                counters().bump("rpc_retry", labels={"server": sid})
                 if self._stop.wait(backoff.next_delay()):
                     break
             try:
@@ -1246,20 +1280,23 @@ class PSClient:
                 fresh.close_all()  # another reviver won the race
                 return cur
             servers[idx] = fresh
-        counters().bump("conn_revive")
+        counters().bump("conn_revive", labels={"server": str(idx)})
         cur.close_all()  # idempotent; frees the old lanes' fds
         return fresh
 
     # --- data plane ------------------------------------------------------
 
-    def init_tensor(self, key: int, num_elements: int, dtype_id: int) -> None:
+    def init_tensor(self, key: int, num_elements: int, dtype_id: int,
+                    trace: Optional[tuple] = None) -> None:
         """Blocking init-push; doubles as the cross-worker barrier for this
         key (InitTensor blocking ZPush, operations.cc:283-414).
 
         Wire payload is language-neutral (u64 nelems + u32 dtype, network
         order) so the native C++ server parses it directly.  Carries the
         worker flag so a replayed init REPLACES this worker's barrier
-        waiter instead of double-counting it (server.py)."""
+        waiter instead of double-counting it (server.py).  ``trace``
+        rides the optional trace-context header field; a retried init
+        keeps its span."""
         import struct
 
         self._blocking_request_retrying(
@@ -1270,6 +1307,7 @@ class PSClient:
                 seq=seq,
                 flags=self._worker_flag(),
                 payload=struct.pack("!QI", num_elements, dtype_id),
+                trace=trace,
             ),
             f"server connection lost during init of key {key}",
             # the init ack legitimately waits for PEER workers — a
@@ -1287,6 +1325,7 @@ class PSClient:
         request_type: RequestType = RequestType.DEFAULT_PUSH_PULL,
         on_error: Optional[Callable[[], None]] = None,
         abort_check: Optional[Callable[[], bool]] = None,
+        trace: Optional[tuple] = None,
     ) -> None:
         """Async push; ``cb`` fires on server ack (ZPush,
         core_loops.cc:538-582); ``on_error`` fires once retries are
@@ -1296,13 +1335,16 @@ class PSClient:
 
         Replay-safe: the worker flag + version lets the server suppress a
         retransmitted push whose original WAS summed (ack lost), so
-        summation stays exactly-once under retry."""
+        summation stays exactly-once under retry.  ``trace`` is the
+        (trace_id, span_id) context propagated on the wire — built ONCE
+        into the closure, so every retry attempt re-sends the SAME span
+        (the server's dedupe annotation then lands on the right one)."""
         cmd = get_command_type(request_type, dtype_id)
         flags = self._worker_flag()
         self._async_rpc(
             lambda seq: Message(
                 Op.PUSH, key=key, seq=seq, payload=payload, cmd=cmd,
-                version=version, flags=flags,
+                version=version, flags=flags, trace=trace,
             ),
             key,
             deliver=lambda msg: cb(),
@@ -1316,6 +1358,8 @@ class PSClient:
         cb: Callable[[list], None],
         on_error: Optional[Callable[[], None]] = None,
         abort_check: Optional[Callable[[], bool]] = None,
+        trace: Optional[tuple] = None,
+        member_spans: Optional[List[int]] = None,
     ) -> None:
         """One multi-key fused push+pull RPC (Op.FUSED; docs/perf.md).
 
@@ -1329,7 +1373,12 @@ class PSClient:
         Replay-safe like :meth:`push`: the frame carries the worker flag,
         and the server runs every sub-push through the per-(worker, key)
         exactly-once ledger — a retransmitted frame re-sums nothing that
-        already landed, atomically per member key."""
+        already landed, atomically per member key.
+
+        Tracing: ``trace`` is the PACK's span (outer header field);
+        ``member_spans`` (one id per member, same order) ride the fused
+        body's optional trailer so the server can stamp per-member child
+        spans.  Both are fixed per frame — retries keep their spans."""
         import struct as _struct
 
         from byteps_tpu.comm.transport import (
@@ -1337,7 +1386,7 @@ class PSClient:
             encode_fused_push,
         )
 
-        frame = encode_fused_push(members)
+        frame = encode_fused_push(members, span_ids=member_spans)
         route_key = members[0][0]
         flags = self._worker_flag()
         # generation fence: the pack was grouped under the CURRENT server
@@ -1365,7 +1414,7 @@ class PSClient:
         self._async_rpc(
             lambda seq: Message(
                 Op.FUSED, key=route_key, seq=seq, payload=frame,
-                cmd=len(members), flags=flags,
+                cmd=len(members), flags=flags, trace=trace,
             ),
             route_key,
             deliver=deliver,
@@ -1385,6 +1434,7 @@ class PSClient:
         payload: bytes = b"",
         sink: Optional[memoryview] = None,
         abort_check: Optional[Callable[[], bool]] = None,
+        trace: Optional[tuple] = None,
     ) -> None:
         """Async pull; ``cb`` receives the aggregated payload (ZPull,
         core_loops.cc:584-618); ``on_error`` fires if the server connection
@@ -1402,7 +1452,7 @@ class PSClient:
         self._async_rpc(
             lambda seq: Message(
                 Op.PULL, key=key, seq=seq, payload=payload, cmd=cmd,
-                version=version,
+                version=version, trace=trace,
             ),
             key,
             deliver=lambda msg: cb(msg.payload),

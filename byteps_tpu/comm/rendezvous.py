@@ -138,6 +138,15 @@ class Scheduler:
         self._parked_regs: List[Tuple[Any, Any, str, int, int]] = []
         #: a resize-initiating worker was parked; broadcast when it flushes
         self._pending_broadcast = False
+        # cluster-wide metrics aggregate (docs/observability.md): every
+        # node piggybacks metric DELTAS on its heartbeat; they fold in
+        # here — counters labeled by {role, rank} so one sick node stays
+        # visible, histograms merged bucket-wise into the cluster shape.
+        # Served on BYTEPS_METRICS_PORT: one scrape sees the whole job.
+        from byteps_tpu.core.telemetry import MetricsRegistry
+
+        self.metrics_agg = MetricsRegistry()
+        self._metrics_http = None
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, name="sched-accept", daemon=True)
@@ -149,6 +158,13 @@ class Scheduler:
             )
             m.start()
             self._threads.append(m)
+        port = int(os.environ.get("BYTEPS_METRICS_PORT", "0") or 0)
+        if port > 0:
+            from byteps_tpu.core.telemetry import serve_metrics
+
+            self._metrics_http = serve_metrics(
+                port, self.metrics_agg.render_prometheus
+            )
 
     # --- liveness policy (BYTEPS_DEAD_NODE_TIMEOUT_S) --------------------
 
@@ -240,6 +256,9 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._metrics_http is not None:
+            self._metrics_http.close()
+            self._metrics_http = None
         try:
             self._sock.close()
         except OSError:
@@ -269,6 +288,8 @@ class Scheduler:
                 elif msg.op == Op.BARRIER:
                     self._handle_barrier(conn, send_lock, msg)
                 elif msg.op == Op.PING:
+                    if msg.payload:
+                        self._merge_metric_delta(conn, msg.payload)
                     send_message(conn, Message(Op.PING, seq=msg.seq), send_lock)
                 elif msg.op == Op.QUERY:
                     send_message(
@@ -297,6 +318,29 @@ class Scheduler:
             with self._lock:
                 self._conn_ids.pop(conn, None)
                 self._recovered_conns.discard(conn)
+
+    def _merge_metric_delta(self, conn, payload: bytes) -> None:
+        """Fold one node's heartbeat-piggybacked metric delta into the
+        cluster aggregate.  Unregistered/unknown senders merge unlabeled;
+        a malformed payload is dropped — metrics must never take down the
+        control plane."""
+        try:
+            delta = json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        if not isinstance(delta, dict):
+            return
+        with self._lock:
+            ident = self._conn_ids.get(conn)
+        labels = (
+            {"role": ident[0], "rank": str(ident[1])} if ident else None
+        )
+        try:
+            self.metrics_agg.merge_delta(delta, labels=labels)
+        except Exception as e:  # noqa: BLE001
+            from byteps_tpu.common import logging as bpslog
+
+            bpslog.warning("metric delta merge failed: %r", e)
 
     def _touch(self, conn) -> None:
         with self._lock:

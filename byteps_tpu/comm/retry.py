@@ -46,7 +46,14 @@ class Backoff:
         self.attempt += 1
         # full jitter, but never 0: a zero sleep turns a dead-connection
         # retry loop into a busy spin
-        return ceiling * (0.1 + 0.9 * self._rng.random())
+        delay = ceiling * (0.1 + 0.9 * self._rng.random())
+        # every layer that backs off (per-RPC retry, revival, van dials)
+        # feeds one latency distribution: the "how long do we sit out
+        # waiting to retry" signal (docs/observability.md)
+        from byteps_tpu.core.telemetry import metrics
+
+        metrics().observe("retry_backoff_seconds", delay)
+        return delay
 
     def reset(self) -> None:
         self.attempt = 0

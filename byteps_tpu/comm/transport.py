@@ -17,6 +17,13 @@ Header layout (network byte order):
     u32 cmd        Cantor-encoded (RequestType, DataType) (common.cc:98)
     u32 version    round / generation
     u64 length     payload byte count
+
+Optional trace context (docs/observability.md): when ``status`` carries
+``TRACE_FLAG`` (bit 7 — requests are otherwise status 0, so the bit is
+free on the request direction), a 16-byte block ``u64 trace_id + u64
+span_id`` follows the header, BEFORE the payload; ``length`` still
+counts only the payload.  Decoders that don't trace (the native C++
+engine) skip the block — old and new frames interoperate both ways.
 """
 
 from __future__ import annotations
@@ -31,6 +38,12 @@ MAGIC = 0xB5
 HEADER_FMT = "!BBBBIQIIQ"
 HEADER_SIZE = struct.calcsize(HEADER_FMT)
 assert HEADER_SIZE == 32
+
+#: status-byte bit: a 16-byte (trace_id, span_id) block follows the header
+TRACE_FLAG = 0x80
+_TRACE_FMT = "!QQ"
+TRACE_SIZE = struct.calcsize(_TRACE_FMT)
+assert TRACE_SIZE == 16
 
 
 class Op(enum.IntEnum):
@@ -55,7 +68,10 @@ class Op(enum.IntEnum):
 
 
 class Message:
-    __slots__ = ("op", "status", "flags", "seq", "key", "cmd", "version", "payload")
+    __slots__ = (
+        "op", "status", "flags", "seq", "key", "cmd", "version", "payload",
+        "trace",
+    )
 
     def __init__(
         self,
@@ -67,6 +83,7 @@ class Message:
         version: int = 0,
         status: int = 0,
         flags: int = 0,
+        trace: Optional[Tuple[int, int]] = None,
     ) -> None:
         self.op = op
         self.status = status
@@ -76,13 +93,16 @@ class Message:
         self.cmd = cmd
         self.version = version
         self.payload = payload
+        #: optional (trace_id, span_id) propagated in the trace-context
+        #: header field (docs/observability.md); None = untraced frame
+        self.trace = trace
 
     def encode_header(self) -> bytes:
-        return struct.pack(
+        hdr = struct.pack(
             HEADER_FMT,
             MAGIC,
             int(self.op),
-            self.status,
+            self.status | (TRACE_FLAG if self.trace is not None else 0),
             self.flags,
             self.seq,
             self.key,
@@ -90,6 +110,9 @@ class Message:
             self.version,
             len(self.payload),
         )
+        if self.trace is not None:
+            hdr += struct.pack(_TRACE_FMT, self.trace[0], self.trace[1])
+        return hdr
 
     def encode(self) -> bytes:
         return self.encode_header() + self.payload
@@ -114,24 +137,39 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def recv_header(sock: socket.socket) -> tuple:
-    """Read + parse one 32-byte header; returns
-    (op, status, flags, seq, key, cmd, version, length)."""
+def recv_header_ex(sock: socket.socket) -> tuple:
+    """Read + parse one header, trace-context aware; returns
+    (op, status, flags, seq, key, cmd, version, length, trace) where
+    ``trace`` is (trace_id, span_id) or None.  The TRACE_FLAG bit is
+    consumed here — ``status`` comes back clean, so frames from tracing
+    and non-tracing peers are indistinguishable downstream."""
     hdr = _recv_exact(sock, HEADER_SIZE)
     magic, op, status, flags, seq, key, cmd, version, length = struct.unpack(
         HEADER_FMT, hdr
     )
     if magic != MAGIC:
         raise ConnectionError(f"bad magic {magic:#x}")
-    return Op(op), status, flags, seq, key, cmd, version, length
+    trace = None
+    if status & TRACE_FLAG:
+        trace = struct.unpack(_TRACE_FMT, _recv_exact(sock, TRACE_SIZE))
+        status &= ~TRACE_FLAG
+    return Op(op), status, flags, seq, key, cmd, version, length, trace
+
+
+def recv_header(sock: socket.socket) -> tuple:
+    """Read + parse one header; returns
+    (op, status, flags, seq, key, cmd, version, length).  Any trace
+    context on the frame is read off the stream and dropped (the
+    optional-on-decode guarantee: a non-tracing consumer stays framed)."""
+    return recv_header_ex(sock)[:8]
 
 
 def recv_message(sock: socket.socket) -> Message:
-    op, status, flags, seq, key, cmd, version, length = recv_header(sock)
+    op, status, flags, seq, key, cmd, version, length, trace = recv_header_ex(sock)
     payload = _recv_exact(sock, length) if length else b""
     return Message(
         op, key=key, payload=payload, seq=seq, cmd=cmd, version=version,
-        status=status, flags=flags,
+        status=status, flags=flags, trace=trace,
     )
 
 
@@ -190,6 +228,12 @@ def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:
 # Cantor-encoded cmd, and round version so the server sums every sub-push
 # through the per-(worker, key) exactly-once ledger — a retried frame
 # dedupes atomically per member key.
+#
+# Tracing (docs/observability.md): the PACK's span rides the outer
+# header's trace-context field; the MEMBER span ids ride an optional
+# trailer of count × u64 after the last member.  decode_fused_push reads
+# exactly ``count`` members and ignores the trailer, so pre-observability
+# decoders stay compatible; decode_fused_spans recovers the ids.
 
 _FUSED_MEMBER_FMT = "!QIIQ"
 _FUSED_MEMBER_SIZE = struct.calcsize(_FUSED_MEMBER_FMT)
@@ -197,17 +241,23 @@ _FUSED_REPLY_FMT = "!QIQ"
 _FUSED_REPLY_SIZE = struct.calcsize(_FUSED_REPLY_FMT)
 
 
-def encode_fused_push(members) -> bytes:
-    """Pack ``[(key, cmd, version, payload), ...]`` into one frame body."""
+def encode_fused_push(members, span_ids=None) -> bytes:
+    """Pack ``[(key, cmd, version, payload), ...]`` into one frame body.
+    ``span_ids`` (one u64 per member, same order) appends the optional
+    member-span trailer for distributed tracing."""
     parts = [struct.pack("!I", len(members))]
     for key, cmd, version, payload in members:
         parts.append(struct.pack(_FUSED_MEMBER_FMT, key, cmd, version, len(payload)))
         parts.append(bytes(payload) if not isinstance(payload, bytes) else payload)
+    if span_ids:
+        if len(span_ids) != len(members):
+            raise ValueError("span_ids must match members 1:1")
+        parts.append(struct.pack(f"!{len(span_ids)}Q", *span_ids))
     return b"".join(parts)
 
 
-def decode_fused_push(body: bytes) -> list:
-    """Inverse of :func:`encode_fused_push` → [(key, cmd, version, bytes)]."""
+def _walk_fused_members(body: bytes) -> tuple:
+    """→ (members, offset-after-last-member)."""
     (count,) = struct.unpack_from("!I", body, 0)
     off = 4
     members = []
@@ -218,7 +268,22 @@ def decode_fused_push(body: bytes) -> list:
             raise ValueError("fused frame truncated")
         members.append((key, cmd, version, body[off : off + length]))
         off += length
-    return members
+    return members, off
+
+
+def decode_fused_push(body: bytes) -> list:
+    """Inverse of :func:`encode_fused_push` → [(key, cmd, version, bytes)].
+    A member-span trailer, if present, is ignored (old-decoder parity)."""
+    return _walk_fused_members(body)[0]
+
+
+def decode_fused_spans(body: bytes):
+    """The member-span trailer of a fused frame → [span_id, ...], or
+    None when the frame carries none (pre-observability sender)."""
+    members, off = _walk_fused_members(body)
+    if len(body) - off == 8 * len(members) and members:
+        return list(struct.unpack_from(f"!{len(members)}Q", body, off))
+    return None
 
 
 def encode_fused_reply(members) -> bytes:

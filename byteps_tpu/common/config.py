@@ -142,13 +142,23 @@ class Config:
     # built; the shm van keeps the Python client (mmap bulk path).
     native_client: bool = False  # BYTEPS_NATIVE_CLIENT
 
-    # --- debug / trace (global.cc:113-124) ---
+    # --- debug / trace / observability (global.cc:113-124; docs/observability.md) ---
     log_level: str = "WARNING"
     trace_on: bool = False
     trace_start_step: int = 10
     trace_end_step: int = 20
     trace_dir: str = "."
+    # distributed spans (docs/observability.md): with tracing on, engine
+    # tasks get trace/span ids that ride every framed RPC and the server
+    # stamps child spans.  BYTEPS_TRACE_SPANS=0 keeps the classic
+    # per-tensor stage envelopes but drops span events + wire context.
+    trace_spans: bool = True  # BYTEPS_TRACE_SPANS
     telemetry_on: bool = False
+    # Prometheus text exposition port, served per process (worker,
+    # server, and the scheduler's cluster aggregate).  0 disables.  When
+    # several processes share a host and the port is taken, the process
+    # falls back to an ephemeral port and logs it.
+    metrics_port: int = 0  # BYTEPS_METRICS_PORT
     force_distributed: bool = False  # BYTEPS_FORCE_DISTRIBUTED (global.cc:149-152)
     debug_sample_tensor: str = ""
 
@@ -235,7 +245,9 @@ class Config:
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "."),
+            trace_spans=_env_bool("BYTEPS_TRACE_SPANS", True),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON"),
+            metrics_port=max(0, _env_int("BYTEPS_METRICS_PORT", 0)),
             force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             mesh_shape=_env_str("BYTEPS_TPU_MESH", ""),

@@ -261,6 +261,17 @@ class TensorTableEntry:
     # members already passed their per-key round gates at the FUSE queue,
     # re-gating the pack under its route key would deadlock it)
     gate_exempt: bool = False
+    # distributed tracing (docs/observability.md): the job's trace id and
+    # this partition-task's span id — propagated on every framed RPC the
+    # task issues, so server-side child spans join the worker timeline.
+    # 0 = tracing off.
+    trace_id: int = 0
+    span_id: int = 0
+    # stamped by ScheduledQueue.add_task on every stage entry: monotonic
+    # for the stage-dwell histogram (ENQUEUE→done), wall-clock for the
+    # span timeline (cross-process alignment)
+    enqueued_at: float = 0.0
+    enqueued_wall: float = 0.0
 
     def current_stage(self) -> Optional[QueueType]:
         return self.queue_list[0] if self.queue_list else None

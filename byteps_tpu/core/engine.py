@@ -50,7 +50,7 @@ class _Job:
     __slots__ = (
         "name", "ctx", "flat", "result", "dtype_id", "average", "handle",
         "pending", "lock", "shape", "np_dtype", "is_jax", "version", "t0",
-        "rowsparse", "device_parts", "failed",
+        "rowsparse", "device_parts", "failed", "trace_id",
     )
 
     def __init__(self, name, ctx, flat, result, dtype_id, average, handle,
@@ -82,6 +82,9 @@ class _Job:
         # an abandoned round must not replay into the re-initialized
         # next generation (its cleared dedupe ledger would re-sum it)
         self.failed = False
+        # distributed tracing: one trace id per push_pull invocation;
+        # every partition task's span joins it (0 = tracing off)
+        self.trace_id = 0
 
 
 class _FusionGroup:
@@ -207,9 +210,20 @@ class _Fuser:
         outranks every bulkier push below that urgency) and the summed
         length (credit accounting); ``gate_exempt`` skips the per-key round
         gate the members already passed at the FUSE queue."""
-        from byteps_tpu.core.telemetry import counters
+        from byteps_tpu.core.telemetry import COUNT_BUCKETS, counters, metrics
 
         counters().bump(f"fusion_flush_{reason}")
+        # pack-quality histograms (docs/observability.md): density tells
+        # whether the threshold actually coalesces (p50 of 1 = fusion is
+        # pure overhead), flush age is the latency the pack COST its
+        # oldest member — the two knobs BYTEPS_FUSION_BYTES /
+        # BYTEPS_FUSION_CYCLE_MS trade against each other
+        metrics().observe(
+            "fused_pack_keys", len(buf.members), buckets=COUNT_BUCKETS
+        )
+        metrics().observe(
+            "fused_flush_age_seconds", time.monotonic() - buf.oldest
+        )
         members = buf.members
         group = TensorTableEntry(
             tensor_name="<fused>",
@@ -463,6 +477,10 @@ class PipelineEngine:
         # out of the codec path anyway)
         fuse_limit = 0 if compressed else self.cfg.fusion_threshold
         itemsize = np_dtype.itemsize
+        if self._traced():
+            from byteps_tpu.core.tracing import new_trace_id
+
+            job.trace_id = new_trace_id()
         for part in ctx.partitions:
             small = fuse_limit and part.length * itemsize <= fuse_limit
             if small:
@@ -479,6 +497,7 @@ class PipelineEngine:
                 queue_list=list(self.STAGES_FUSED if small else stages),
                 context=job,
             )
+            self._stamp_task_trace(task, job)
             self.queues[QueueType.COPYD2H].add_task(task)
 
     def _prepare_round(self, ctx, dtype_id, n_elements, build_partitions,
@@ -524,7 +543,24 @@ class PipelineEngine:
                 if not ctx.partitions:
                     build_partitions(ctx)
                 for part in ctx.partitions:
-                    self.client.init_tensor(part.key, part.length, dtype_id)
+                    if self._traced():
+                        from byteps_tpu.core.tracing import (
+                            new_trace_id,
+                            span_args,
+                        )
+
+                        t_id, s_id = new_trace_id(), new_trace_id()
+                        t0 = time.time()
+                        self.client.init_tensor(
+                            part.key, part.length, dtype_id,
+                            trace=(t_id, s_id),
+                        )
+                        self.tracer.record_span(
+                            ctx.name, "INIT", t0, time.time() - t0,
+                            span_args(t_id, s_id, key=part.key),
+                        )
+                    else:
+                        self.client.init_tensor(part.key, part.length, dtype_id)
                 if ctx.initialized:
                     self._reship_compressors(ctx)
                     ctx.version = 0
@@ -592,6 +628,10 @@ class PipelineEngine:
             pending=1, shape=(nrows, row_len), np_dtype=vals.dtype,
             is_jax=False, version=ctx.version, rowsparse=rowsparse,
         )
+        if self._traced():
+            from byteps_tpu.core.tracing import new_trace_id
+
+            job.trace_id = new_trace_id()
         task = TensorTableEntry(
             tensor_name=name,
             key=key,
@@ -603,6 +643,7 @@ class PipelineEngine:
             queue_list=[QueueType.PUSH, QueueType.PULL],
             context=job,
         )
+        self._stamp_task_trace(task, job)
         self.queues[QueueType.PUSH].add_task(task)
 
     def _maybe_setup_compression(self, ctx, np_dtype: np.dtype, nbytes: int) -> None:
@@ -679,6 +720,30 @@ class PipelineEngine:
             self.client.set_compression_lr(self._compression_lr)
             self._lr_sent_to_servers = self._compression_lr
 
+    # --- observability helpers (docs/observability.md) -------------------
+
+    def _traced(self) -> bool:
+        return (
+            self.tracer is not None
+            and self.tracer.enabled
+            and getattr(self.tracer, "spans_enabled", True)
+        )
+
+    def _stamp_task_trace(self, task: TensorTableEntry, job: _Job) -> None:
+        """Give a partition task its span under the job's trace.  The
+        span id is FIXED for the task's lifetime: every RPC attempt
+        (retries included) carries the same id, so the server's
+        dedupe-annotated child spans join the right worker span."""
+        if job.trace_id:
+            from byteps_tpu.core.tracing import new_trace_id
+
+            task.trace_id = job.trace_id
+            task.span_id = new_trace_id()
+
+    def _task_trace(self, task: TensorTableEntry):
+        """Wire trace context for a task's RPCs, or None when off."""
+        return (task.trace_id, task.span_id) if task.trace_id else None
+
     # --- stage bodies ----------------------------------------------------
 
     def _proceed(self, task: TensorTableEntry) -> None:
@@ -719,6 +784,25 @@ class PipelineEngine:
         if self.tracer is not None:
             self.tracer.record(
                 job.name, finished.name, job.t0, time.time() - job.t0, job.version
+            )
+        # per-stage dwell, ENQUEUE→done: the latency dimension the flat
+        # counters never had — p99 here names the stalled stage directly
+        if task.enqueued_at:
+            from byteps_tpu.core.telemetry import metrics
+
+            metrics().observe(
+                "stage_dwell_seconds",
+                time.monotonic() - task.enqueued_at,
+                labels={"stage": finished.name},
+            )
+        if task.trace_id and self._traced():
+            from byteps_tpu.core.tracing import span_args
+
+            self.tracer.record_span(
+                job.name, finished.name, task.enqueued_wall,
+                time.time() - task.enqueued_wall,
+                span_args(task.trace_id, task.span_id, key=task.key,
+                          version=task.version),
             )
         self.queues[finished].report_finish(task)
         if task.queue_list:
@@ -945,9 +1029,30 @@ class PipelineEngine:
         counters().bump("fused_frames")
         counters().bump("fused_keys", len(members))
 
+        # pack span: its own trace (members each belong to their jobs'
+        # traces; their span ids ride the fused body's trailer so the
+        # server can stamp per-member children) — fixed per frame, so a
+        # RETRIED frame keeps the pack span and every member span
+        pack_trace = None
+        member_spans = None
+        t_pack = time.time()
+        if self._traced():
+            from byteps_tpu.core.tracing import new_trace_id
+
+            pack_trace = (new_trace_id(), new_trace_id())
+            member_spans = [mtask.span_id for mtask, _ in members]
+
         def deliver(replies: list) -> None:
             if not finish_group():
                 return
+            if pack_trace is not None:
+                from byteps_tpu.core.tracing import span_args
+
+                self.tracer.record_span(
+                    "<fused>", "FUSED_RPC", t_pack, time.time() - t_pack,
+                    span_args(pack_trace[0], pack_trace[1],
+                              keys=len(members)),
+                )
             by_key = {key: payload for key, _ver, payload in replies}
             for mtask, _ in members:
                 payload = by_key.get(mtask.key)
@@ -982,6 +1087,8 @@ class PipelineEngine:
             # one live member keeps the whole pack (and its siblings'
             # cleanup-by-delivery) in flight
             abort_check=lambda: all(m.context.failed for m, _ in members),
+            trace=pack_trace,
+            member_spans=member_spans,
         )
 
     def _unfuse_members(self, group: _FusionGroup, reason: str) -> None:
@@ -1040,6 +1147,7 @@ class PipelineEngine:
                 task, QueueType.PUSH, "server connection lost", degraded=True
             ),
             abort_check=lambda: job.failed,
+            trace=self._task_trace(task),
         )
 
     def _pull_once(self, task: TensorTableEntry) -> None:
@@ -1078,6 +1186,7 @@ class PipelineEngine:
                     degraded=True,
                 ),
                 abort_check=lambda: job.failed,
+                trace=self._task_trace(task),
             )
             return
 
@@ -1122,6 +1231,7 @@ class PipelineEngine:
                 task, QueueType.PULL, "server connection lost", degraded=True
             ),
             abort_check=lambda: job.failed,
+            trace=self._task_trace(task),
         )
 
     def _decompress_once(self, task: TensorTableEntry) -> None:

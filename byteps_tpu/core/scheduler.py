@@ -65,6 +65,12 @@ class ScheduledQueue:
     def add_task(self, task: TensorTableEntry) -> None:
         import bisect
 
+        # stage-entry stamps: the dwell histogram measures ENQUEUE→done
+        # per stage, and span events start here — so queue wait (the
+        # thing priority scheduling and credits actually change) is part
+        # of every stage's recorded latency, not silently dropped
+        task.enqueued_at = time.monotonic()
+        task.enqueued_wall = time.time()
         with self._cv:
             if self.discipline == "fifo":
                 self._tasks.append(task)

@@ -27,6 +27,7 @@ class RuntimeState:
         self.ps_client = None  # comm.ps_client.PSClient
         self.telemetry = None  # core.telemetry.PushPullSpeed
         self.tracer = None  # core.tracing.Tracer
+        self.metrics_http = None  # core.telemetry.MetricsHTTPServer
         self.initialized = False
         self.resuming = False
         # stable across suspend/resume so the scheduler matches the rejoin
@@ -126,7 +127,19 @@ def init_state(fresh_env: bool = True) -> RuntimeState:
             end_step=cfg.trace_end_step,
             trace_dir=cfg.trace_dir,
             local_rank=cfg.local_rank,
+            spans_enabled=cfg.trace_spans,
         )
+        # observability plane (docs/observability.md): chaos/ps layers
+        # stamp events on the process tracer; the Prometheus endpoint
+        # serves the process-global registry; push/pull MB/s rides along
+        # as a lazy gauge so a scrape sees throughput next to latency
+        from byteps_tpu.core.telemetry import metrics, serve_metrics
+        from byteps_tpu.core.tracing import set_process_tracer
+
+        set_process_tracer(st.tracer)
+        metrics().gauge_fn("pushpull_mbps", st.telemetry.mbps)
+        if cfg.metrics_port > 0 and st.metrics_http is None:
+            st.metrics_http = serve_metrics(cfg.metrics_port)
         if cfg.is_distributed:
             # Distributed mode: bring up the PS client (rendezvous with the
             # scheduler, learn server addresses) and the staged host engine
@@ -140,6 +153,10 @@ def init_state(fresh_env: bool = True) -> RuntimeState:
                 st.node_uid = resolve_node_uid()
             st.ps_client = PSClient(cfg, node_uid=st.node_uid)
             st.ps_client.connect()
+            # cross-process span identity: the scheduler-assigned rank
+            # names this process's track in merged timelines
+            if st.ps_client.rank is not None:
+                st.tracer.process_name = f"worker{st.ps_client.rank}"
             st.engine = PipelineEngine(cfg, st.ps_client, st.telemetry, st.tracer)
             st.engine.start()
         st.initialized = True
@@ -160,6 +177,9 @@ def shutdown_state() -> None:
             st.ps_client = None
         if st.tracer is not None:
             st.tracer.flush()
+        if st.metrics_http is not None:
+            st.metrics_http.close()
+            st.metrics_http = None
         st.handles.clear()
         st.initialized = False
 

@@ -1,24 +1,34 @@
-"""Push/pull speed telemetry + robustness counters.
+"""Metrics plane: push/pull speed telemetry, robustness counters, and the
+cluster-scrapeable metrics registry (docs/observability.md).
 
-Re-design of ``BytePSGlobal::PushPullSpeed`` (global.cc:697-752): a windowed
-MB/s counter over recent push_pull byte volume, exposed to Python as
-``bps.get_pushpull_speed()`` (common/__init__.py:131-139).  Gate:
-``BYTEPS_TELEMETRY_ON``.
+Three layers, grown in place:
 
-The robustness counters (:func:`counters`) make data-plane degradation
-observable: every retry, deadline expiry, connection revival, server-side
-duplicate-push suppression, chaos-van injected fault, and membership
-eviction bumps a named counter.  They are process-global and always on —
-a counter bump is one dict update under a lock, and the self-healing
-paths they instrument are rare by construction.
+- :class:`PushPullSpeed` — the reference's ``BytePSGlobal::PushPullSpeed``
+  (global.cc:697-752): a windowed MB/s counter over recent push_pull byte
+  volume, exposed as ``bps.get_pushpull_speed()``.  Gate:
+  ``BYTEPS_TELEMETRY_ON``.
+- :class:`RobustnessCounters` (:func:`counters`) — named monotonic
+  counters for data-plane degradation events, always on.  Since the
+  observability PR they optionally carry a LABEL dimension (e.g.
+  ``server="2"``) so a single sick peer is visible; flat totals are kept
+  for back-compat (``get_robustness_counters``).
+- :class:`MetricsRegistry` (:func:`metrics`) — counters + gauges +
+  fixed-bucket histograms with p50/p90/p99 snapshots, a Prometheus text
+  exposition endpoint (``BYTEPS_METRICS_PORT``), and delta snapshots that
+  piggyback on the scheduler heartbeat so the scheduler can serve a
+  cluster-wide aggregate.
+
+Every metric name must appear in the docs/observability.md catalog —
+``tools/check_metrics_doc.py`` (a tier-1 test) fails the build otherwise.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 WINDOW_SEC = 10.0  # reference uses a 10-second window (global.cc:703)
 
@@ -55,10 +65,25 @@ class PushPullSpeed:
             return self._total_bytes / span / 1e6
 
 
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    """Canonical, hashable form of a label set."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
 class RobustnessCounters:
     """Named monotonic counters for data-plane degradation events.
 
-    Canonical names (consumers may add others):
+    Canonical names (consumers may add others; the full catalog with
+    per-name guidance lives in docs/observability.md):
 
     - ``rpc_retry``            — a push/pull/init attempt was re-sent
     - ``rpc_deadline_expired`` — a per-RPC deadline fired (hung server)
@@ -87,15 +112,33 @@ class RobustnessCounters:
       RPCs (server resize under the pack, or fused retries exhausted)
     - ``fused_reply_malformed`` — fused replies that failed to decode
       (routed to the frame's error path instead of the recv lane)
+
+    ``bump(name, n, labels={"server": "2"})`` additionally records the
+    count under that label set: ``rpc_retry``/``rpc_deadline_expired``/
+    ``conn_revive`` carry a per-server-rank dimension so ONE sick server
+    stands out of the flat total.  ``snapshot()`` stays flat ints
+    (back-compat); :meth:`snapshot_labeled` exposes the dimension.
     """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {}
+        # name → {label_key_tuple: count}; flat totals above INCLUDE these
+        self._labeled: Dict[str, Dict[tuple, int]] = {}
 
-    def bump(self, name: str, n: int = 1) -> None:
+    def bump(self, name: str, n: int = 1,
+             labels: Optional[Dict[str, str]] = None,
+             flat: bool = True) -> None:
+        """``flat=False`` records only the labeled slice — used when the
+        flat total is accounted separately (scheduler delta merge, where
+        the unlabeled delta already includes the labeled bumps)."""
         with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + n
+            if flat:
+                self._counts[name] = self._counts.get(name, 0) + n
+            if labels:
+                key = _label_key(labels)
+                per = self._labeled.setdefault(name, {})
+                per[key] = per.get(key, 0) + n
 
     def set_floor(self, name: str, value: int) -> None:
         """Raise a counter to ``value`` if below it — used for cumulative
@@ -112,14 +155,479 @@ class RobustnessCounters:
         with self._lock:
             return dict(self._counts)
 
+    def snapshot_labeled(self) -> Dict[str, Dict[tuple, int]]:
+        """{name: {((label, value), ...): count}} for the labeled slice."""
+        with self._lock:
+            return {n: dict(per) for n, per in self._labeled.items()}
+
     def reset(self) -> None:
         with self._lock:
             self._counts.clear()
+            self._labeled.clear()
+
+
+# Default latency buckets (seconds): 100µs → ~algo 100s, exponential —
+# wide enough for a local UDS round trip and a cross-region DCN stall in
+# the same histogram.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 100.0,
+)
+
+#: pack-density buckets (member counts per fused frame)
+COUNT_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class Histogram:
+    """Fixed-bucket histogram with cheap percentile snapshots.
+
+    Buckets are CUMULATIVE upper bounds (Prometheus ``le`` semantics)
+    with an implicit +Inf bucket.  ``observe`` is one bisect + two adds
+    under a lock — cheap enough to stay always-on in the data plane.
+    Percentiles interpolate linearly inside the bucket that crosses the
+    rank; observations past the last finite bound report that bound
+    (the histogram's honest resolution limit).
+    """
+
+    __slots__ = ("name", "bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str,
+                 buckets: Tuple[float, ...] = LATENCY_BUCKETS) -> None:
+        self.name = name
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in buckets)
+        self._counts = [0] * (len(self.bounds) + 1)  # +1 = the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        import bisect
+
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        """{"count", "sum", "buckets": [(le, cumulative_count), ...]}
+        with a trailing ("+Inf", count) entry."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        out, cum = [], 0
+        for le, c in zip(self.bounds, counts):
+            cum += c
+            out.append((le, cum))
+        out.append((float("inf"), total))
+        return {"count": total, "sum": s, "buckets": out}
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1]; 0.0 on an empty histogram."""
+        snap = self.snapshot()
+        total = snap["count"]
+        if total == 0:
+            return 0.0
+        rank = q * total
+        prev_le, prev_cum = 0.0, 0
+        for le, cum in snap["buckets"]:
+            if cum >= rank and cum > prev_cum:
+                if le == float("inf"):
+                    return self.bounds[-1] if self.bounds else prev_le
+                span = cum - prev_cum
+                frac = (rank - prev_cum) / span if span else 1.0
+                return prev_le + (le - prev_le) * min(1.0, max(0.0, frac))
+            prev_le, prev_cum = (0.0 if le == float("inf") else le), cum
+        return self.bounds[-1] if self.bounds else 0.0
+
+    def merge_counts(self, bucket_counts: List[int], vsum: float,
+                     count: int) -> None:
+        """Fold another histogram's RAW (non-cumulative) per-bucket counts
+        in — the scheduler-side aggregation path.  Lengths must match."""
+        with self._lock:
+            for i, c in enumerate(bucket_counts[: len(self._counts)]):
+                self._counts[i] += int(c)
+            self._sum += vsum
+            self._count += count
+
+    def raw_counts(self) -> List[int]:
+        with self._lock:
+            return list(self._counts)
+
+    def raw_state(self) -> Tuple[List[int], float, int]:
+        """(non-cumulative bucket counts, sum, count) read under ONE lock
+        acquisition — the delta path needs the three consistent with each
+        other, or a racing observe() would ship a count with no bucket
+        and skew the aggregate's percentiles until the next beat."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+
+class MetricsRegistry:
+    """Counters + gauges + histograms behind one scrape surface.
+
+    Counters live in a :class:`RobustnessCounters` (so the pre-existing
+    ``counters()`` surface IS the registry's counter store).  Histograms
+    are keyed by (name, label set) — each label combination gets its own
+    bucket array; exposition groups them under one metric family.
+    Gauges are either set values or zero-argument callables sampled at
+    render time.
+    """
+
+    def __init__(self, counter_store: Optional[RobustnessCounters] = None) -> None:
+        self.counters = counter_store if counter_store is not None else RobustnessCounters()
+        self._lock = threading.Lock()
+        self._hists: Dict[Tuple[str, tuple], Histogram] = {}
+        self._gauges: Dict[str, float] = {}
+        self._gauge_fns: Dict[str, Callable[[], float]] = {}
+        # delta baseline for heartbeat piggyback.  Normally one consumer
+        # per process (the heartbeat loop), but in-process test clusters
+        # run worker + server beats against one shared registry — the
+        # lock keeps each increment shipped exactly once.
+        self._delta_lock = threading.Lock()
+        self._requeued: List[dict] = []  # failed-send deltas to re-ship
+        self._shipped_counts: Dict[str, int] = {}
+        self._shipped_labeled: Dict[str, Dict[tuple, int]] = {}
+        self._shipped_hists: Dict[Tuple[str, tuple], Tuple[List[int], float, int]] = {}
+
+    # --- registration / recording ---------------------------------------
+
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None,
+                  buckets: Tuple[float, ...] = LATENCY_BUCKETS) -> Histogram:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram(name, buckets)
+            return h
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None,
+                buckets: Tuple[float, ...] = LATENCY_BUCKETS) -> None:
+        self.histogram(name, labels, buckets).observe(value)
+
+    def gauge_set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge_fn(self, name: str, fn: Callable[[], float]) -> None:
+        """Lazy gauge: ``fn()`` is sampled at exposition time."""
+        with self._lock:
+            self._gauge_fns[name] = fn
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hists.clear()
+            self._gauges.clear()
+            self._gauge_fns.clear()
+        with self._delta_lock:
+            self._requeued.clear()
+            self._shipped_counts.clear()
+            self._shipped_labeled.clear()
+            self._shipped_hists.clear()
+        self.counters.reset()
+
+    # --- snapshots -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Full structured snapshot: counters (flat + labeled), gauges,
+        histogram percentiles — the in-process observability surface
+        (``bps.get_metrics()``)."""
+        with self._lock:
+            hists = dict(self._hists)
+            gauges = dict(self._gauges)
+            gauge_fns = dict(self._gauge_fns)
+        out = {
+            "counters": self.counters.snapshot(),
+            "counters_labeled": {
+                name: {_render_labels(k) or "{}": v for k, v in per.items()}
+                for name, per in self.counters.snapshot_labeled().items()
+            },
+            "gauges": dict(gauges),
+            "histograms": {},
+        }
+        for name, fn in gauge_fns.items():
+            try:
+                out["gauges"][name] = float(fn())
+            except Exception:  # noqa: BLE001 — a broken gauge can't break scrape
+                continue
+        for (name, lkey), h in hists.items():
+            snap = h.snapshot()
+            out["histograms"][name + _render_labels(lkey)] = {
+                "count": snap["count"],
+                "sum": snap["sum"],
+                "p50": h.percentile(0.50),
+                "p90": h.percentile(0.90),
+                "p99": h.percentile(0.99),
+            }
+        return out
+
+    # --- Prometheus text exposition --------------------------------------
+
+    def render_prometheus(self, prefix: str = "byteps_") -> str:
+        """Text exposition format 0.0.4.  Histograms export the classic
+        ``_bucket``/``_sum``/``_count`` family PLUS ``_p50``/``_p90``/
+        ``_p99`` gauges so a bare ``curl`` (no PromQL) already answers
+        "how slow is the tail"."""
+        lines: List[str] = []
+        flat = self.counters.snapshot()
+        labeled = self.counters.snapshot_labeled()
+        for name in sorted(flat):
+            metric = f"{prefix}{name}_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {flat[name]}")
+            if labeled.get(name):
+                # the per-label breakdown is a SEPARATE family: the flat
+                # total already includes the labeled bumps, so exporting
+                # both under one name would make sum() double-count
+                # (Prometheus series of one metric must be label-disjoint)
+                lmetric = f"{prefix}{name}_labeled_total"
+                lines.append(f"# TYPE {lmetric} counter")
+                for lkey in sorted(labeled[name]):
+                    lines.append(
+                        f"{lmetric}{_render_labels(lkey)} {labeled[name][lkey]}"
+                    )
+        with self._lock:
+            gauges = dict(self._gauges)
+            gauge_fns = dict(self._gauge_fns)
+            hists = dict(self._hists)
+        for name, fn in gauge_fns.items():
+            try:
+                gauges[name] = float(fn())
+            except Exception:  # noqa: BLE001
+                continue
+        for name in sorted(gauges):
+            metric = f"{prefix}{name}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {gauges[name]}")
+        by_family: Dict[str, List[Tuple[tuple, Histogram]]] = {}
+        for (name, lkey), h in hists.items():
+            by_family.setdefault(name, []).append((lkey, h))
+        for name in sorted(by_family):
+            metric = f"{prefix}{name}"
+            lines.append(f"# TYPE {metric} histogram")
+            for lkey, h in sorted(by_family[name], key=lambda kv: kv[0]):
+                snap = h.snapshot()
+                for le, cum in snap["buckets"]:
+                    le_s = "+Inf" if le == float("inf") else repr(le)
+                    labels = dict(lkey) | {"le": le_s}
+                    lines.append(
+                        f"{metric}_bucket{_render_labels(_label_key(labels))} {cum}"
+                    )
+                lines.append(
+                    f"{metric}_sum{_render_labels(lkey)} {snap['sum']}"
+                )
+                lines.append(
+                    f"{metric}_count{_render_labels(lkey)} {snap['count']}"
+                )
+            for q, tag in ((0.50, "p50"), (0.90, "p90"), (0.99, "p99")):
+                qmetric = f"{metric}_{tag}"
+                lines.append(f"# TYPE {qmetric} gauge")
+                for lkey, h in sorted(by_family[name], key=lambda kv: kv[0]):
+                    lines.append(
+                        f"{qmetric}{_render_labels(lkey)} {h.percentile(q)}"
+                    )
+        return "\n".join(lines) + "\n"
+
+    # --- heartbeat delta piggyback (worker/server → scheduler) -----------
+
+    def delta_snapshot(self) -> dict:
+        """Counter/histogram increments since the previous call — the
+        payload piggybacked on the scheduler heartbeat.  One consumer per
+        process (the heartbeat loop); gauges are sent as current values.
+        Empty dict when nothing changed (the heartbeat then ships no
+        payload at all)."""
+        with self._delta_lock:
+            return self._delta_snapshot_locked()
+
+    def _delta_snapshot_locked(self) -> dict:
+        out: dict = {}
+        flat = self.counters.snapshot()
+        labeled = self.counters.snapshot_labeled()
+        c_delta = {}
+        for name, v in flat.items():
+            d = v - self._shipped_counts.get(name, 0)
+            if d:
+                c_delta[name] = d
+        if c_delta:
+            out["c"] = c_delta
+        lc_delta: Dict[str, Dict[str, int]] = {}
+        for name, per in labeled.items():
+            shipped = self._shipped_labeled.get(name, {})
+            for lkey, v in per.items():
+                d = v - shipped.get(lkey, 0)
+                if d:
+                    lc_delta.setdefault(name, {})[json.dumps(lkey)] = d
+        if lc_delta:
+            out["lc"] = lc_delta
+        with self._lock:
+            hists = dict(self._hists)
+        h_delta = []
+        for (name, lkey), h in hists.items():
+            raw, vsum, count = h.raw_state()
+            prev = self._shipped_hists.get(
+                (name, lkey), ([0] * len(raw), 0.0, 0)
+            )
+            d_counts = [a - b for a, b in zip(raw, prev[0])]
+            d_count = count - prev[2]
+            if d_count:
+                h_delta.append({
+                    "name": name,
+                    "l": [list(kv) for kv in lkey],
+                    "le": list(h.bounds),
+                    "b": d_counts,
+                    "s": vsum - prev[1],
+                    "n": d_count,
+                })
+            self._shipped_hists[(name, lkey)] = (raw, vsum, count)
+        if h_delta:
+            out["h"] = h_delta
+        self._shipped_counts = flat
+        self._shipped_labeled = labeled
+        # fold back any delta whose heartbeat FAILED to send: its
+        # increments were already consumed from the baselines above and
+        # must ride the next successful beat, not vanish
+        requeued, self._requeued = self._requeued, []
+        for old in requeued:
+            for name, d in (old.get("c") or {}).items():
+                out.setdefault("c", {})
+                out["c"][name] = out["c"].get(name, 0) + int(d)
+            for name, per in (old.get("lc") or {}).items():
+                dst = out.setdefault("lc", {}).setdefault(name, {})
+                for lkey_json, d in per.items():
+                    dst[lkey_json] = dst.get(lkey_json, 0) + int(d)
+            if old.get("h"):
+                # merge_delta adds records independently, so duplicate
+                # (name, labels) entries in one payload sum correctly
+                out.setdefault("h", []).extend(old["h"])
+        return out
+
+    def requeue_delta(self, delta: dict) -> None:
+        """Give back a delta whose send failed; the next
+        :meth:`delta_snapshot` includes it (at-least-once delivery of
+        increments toward the scheduler aggregate)."""
+        if not delta:
+            return
+        with self._delta_lock:
+            self._requeued.append(delta)
+
+    def merge_delta(self, delta: dict,
+                    labels: Optional[Dict[str, str]] = None) -> None:
+        """Fold one node's delta into this (scheduler-side aggregate)
+        registry.  ``labels`` (e.g. {"role": "worker", "rank": "1"}) tag
+        the counter contributions so a sick node stays visible in the
+        aggregate; histograms merge flat (cluster-wide latency shape)."""
+        for name, d in (delta.get("c") or {}).items():
+            self.counters.bump(str(name), int(d), labels=labels)
+        for name, per in (delta.get("lc") or {}).items():
+            for lkey_json, d in per.items():
+                try:
+                    node_labels = dict(tuple(kv) for kv in json.loads(lkey_json))
+                except (ValueError, TypeError):
+                    node_labels = {}
+                if labels:
+                    node_labels.update(labels)
+                # flat=False: the unlabeled "c" delta above already
+                # carried these bumps — re-adding would double-count
+                self.counters.bump(
+                    str(name), int(d), labels=node_labels, flat=False
+                )
+        for rec in delta.get("h") or ():
+            try:
+                bounds = tuple(float(b) for b in rec["le"])
+                node_labels = dict(tuple(kv) for kv in rec.get("l") or ())
+                h = self.histogram(
+                    str(rec["name"]), labels=node_labels or None,
+                    buckets=bounds,
+                )
+                h.merge_counts(
+                    [int(c) for c in rec["b"]], float(rec["s"]), int(rec["n"])
+                )
+            except (KeyError, ValueError, TypeError):
+                continue  # malformed delta: drop, never poison the scrape
+
+
+class MetricsHTTPServer:
+    """Tiny threaded HTTP exposition server for one render callback.
+
+    Binds ``port`` (0 = ephemeral); when the requested port is taken —
+    several byteps processes sharing one host and one
+    ``BYTEPS_METRICS_PORT`` — falls back to an ephemeral port and logs
+    the actual one, so every process still gets a scrape surface.
+    """
+
+    def __init__(self, port: int, render: Callable[[], str],
+                 host: str = "0.0.0.0") -> None:
+        import http.server
+
+        render_fn = render
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    body = render_fn().encode()
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(repr(e).encode())
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        try:
+            self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
+        except OSError:
+            from byteps_tpu.common import logging as bpslog
+
+            self._httpd = http.server.ThreadingHTTPServer((host, 0), _Handler)
+            bpslog.warning(
+                "BYTEPS_METRICS_PORT=%d in use; serving metrics on %d instead",
+                port, self._httpd.server_address[1],
+            )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="bps-metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+
+def serve_metrics(port: int, render: Optional[Callable[[], str]] = None,
+                  host: str = "0.0.0.0") -> MetricsHTTPServer:
+    """Start the Prometheus exposition endpoint; default renders the
+    process-global registry."""
+    return MetricsHTTPServer(
+        port, render if render is not None else metrics().render_prometheus,
+        host=host,
+    )
 
 
 _counters = RobustnessCounters()
+_registry = MetricsRegistry(counter_store=_counters)
 
 
 def counters() -> RobustnessCounters:
     """The process-global robustness counter set."""
     return _counters
+
+
+def metrics() -> MetricsRegistry:
+    """The process-global metrics registry (counters + gauges +
+    histograms behind one scrape surface)."""
+    return _registry

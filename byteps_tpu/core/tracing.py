@@ -1,26 +1,67 @@
-"""Chrome-trace timeline of communication stages.
+"""Chrome-trace timeline of communication stages + distributed spans.
 
-Re-design of the reference's tracing subsystem (global.cc:448-564,
-docs/timeline.md): per named tensor, per pipeline stage, record
-``{start, duration}`` intervals between trace_start_step and trace_end_step
-and emit ``<dir>/<local_rank>/comm.json`` in Chrome trace-event format
-(load via chrome://tracing or Perfetto).
+Two event families share one tracer (docs/observability.md):
+
+- **Stage envelopes** (:meth:`Tracer.record`) — the reference's tracing
+  subsystem (global.cc:448-564, docs/timeline.md): per named tensor, per
+  pipeline stage, ``{start, duration}`` intervals between
+  trace_start_step and trace_end_step, one trace row per tensor.
+- **Spans** (:meth:`Tracer.record_span`) — cross-process distributed
+  tracing: every engine task gets a (trace id, span id) pair, the ids
+  ride each framed RPC in the optional trace-context header field
+  (transport.py), and the server stamps child spans
+  (recv→sum→publish→reply) that share the worker's trace id.
+  ``tools/trace_merge.py`` stitches the per-process files into one
+  Perfetto-loadable timeline joined on those ids.
+
+Emission is ``<dir>/<local_rank>/comm.json`` in Chrome trace-event
+format (load via chrome://tracing or Perfetto).  ``flush()`` writes the
+CURRENT window and clears the buffer, so ``profiler.trace()`` can
+capture any number of windows per process (the pre-observability tracer
+had a one-shot latch: the second flush silently dropped all events).
 
 Host stages are stamped by the pipeline engine; device-side collective
-timing is XLA's domain (use jax.profiler for that) — the tracer records the
-host-visible envelope, which is what the reference records too.
+timing is XLA's domain (use jax.profiler for that) — the tracer records
+the host-visible envelope, which is what the reference records too.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+_id_rng = random.SystemRandom()
+
+
+def new_trace_id() -> int:
+    """Nonzero 63-bit id for a trace or span.  SystemRandom: training
+    code may have seeded the global RNG for data order, and two workers
+    seeding identically must never mint colliding trace ids."""
+    return _id_rng.getrandbits(63) | 1
+
+
+def span_args(trace_id: int, span_id: int, parent_id: int = 0,
+              **extra) -> dict:
+    """Canonical args dict for a span event — hex strings so Perfetto's
+    JSON importer (which parses large ints as doubles) never rounds an
+    id."""
+    args = {"trace": format(trace_id, "x"), "span": format(span_id, "x")}
+    if parent_id:
+        args["parent"] = format(parent_id, "x")
+    args.update(extra)
+    return args
 
 
 class Tracer:
+    #: in-memory event cap: span events are window-free, so a long run
+    #: with tracing on must not grow the buffer unboundedly — beyond the
+    #: cap new events are dropped (counted; flush logs the loss)
+    MAX_EVENTS = 1 << 18
+
     def __init__(
         self,
         enabled: bool = False,
@@ -28,16 +69,24 @@ class Tracer:
         end_step: int = 20,
         trace_dir: str = ".",
         local_rank: int = 0,
+        process_name: str = "",
+        spans_enabled: bool = True,
     ) -> None:
         self.enabled = enabled
         self.start_step = start_step
         self.end_step = end_step
         self.trace_dir = trace_dir
         self.local_rank = local_rank
+        #: BYTEPS_TRACE_SPANS gate: False keeps the per-tensor stage
+        #: envelopes but drops span events (and wire trace context)
+        self.spans_enabled = spans_enabled
+        #: cross-process identity stamped on span events ("worker0",
+        #: "server1"); set once the scheduler assigns a rank
+        self.process_name = process_name or f"rank{local_rank}"
         self._lock = threading.Lock()
         self._events: List[dict] = []
+        self._dropped = 0  # events past MAX_EVENTS since the last flush
         self._steps: Dict[str, int] = {}  # per-tensor version counter
-        self._flushed = False
 
     def _active(self, step: int) -> bool:
         return self.enabled and self.start_step <= step <= self.end_step
@@ -52,13 +101,23 @@ class Tracer:
             self._steps[name] = s
             return s
 
+    def _append_locked(self, event: dict) -> None:
+        """Caller holds ``self._lock``.  Enforces MAX_EVENTS: a capped
+        buffer drops (and counts) instead of growing until OOM — span
+        events have no step window, so a long tracing-on run would
+        otherwise accumulate forever between flushes."""
+        if len(self._events) >= self.MAX_EVENTS:
+            self._dropped += 1
+            return
+        self._events.append(event)
+
     def record(self, name: str, stage: str, start: float, dur: float, step: int) -> None:
         """One complete-event per (tensor, stage) interval
         (global.cc:478-530 emits type 'X' events keyed the same way)."""
         if not self._active(step):
             return
         with self._lock:
-            self._events.append(
+            self._append_locked(
                 {
                     "name": stage,
                     "cat": "comm",
@@ -70,17 +129,92 @@ class Tracer:
                 }
             )
 
+    # --- distributed spans (docs/observability.md) -----------------------
+
+    def record_span(self, track: str, name: str, start: float, dur: float,
+                    args: Optional[dict] = None) -> None:
+        """One complete-event span on this process's timeline.  ``track``
+        groups related spans on one row (tensor name, "engine", …);
+        ``args`` should come from :func:`span_args` so merge joins work.
+        Timestamps are wall-clock (``time.time()``) so per-host worker
+        and server spans align on one merged timeline."""
+        if not self.enabled or not self.spans_enabled:
+            return
+        with self._lock:
+            self._append_locked(
+                {
+                    "name": name,
+                    "cat": "span",
+                    "ph": "X",
+                    "ts": start * 1e6,
+                    "dur": dur * 1e6,
+                    "pid": self.process_name,
+                    "tid": track,
+                    "args": args or {},
+                }
+            )
+
+    def record_instant(self, track: str, name: str,
+                       args: Optional[dict] = None,
+                       ts: Optional[float] = None) -> None:
+        """Zero-duration marker (chaos fault tags, eviction moments)."""
+        if not self.enabled or not self.spans_enabled:
+            return
+        with self._lock:
+            self._append_locked(
+                {
+                    "name": name,
+                    "cat": "span",
+                    "ph": "i",
+                    "s": "t",  # thread-scoped instant
+                    "ts": (time.time() if ts is None else ts) * 1e6,
+                    "pid": self.process_name,
+                    "tid": track,
+                    "args": args or {},
+                }
+            )
+
+    def pending_events(self) -> int:
+        with self._lock:
+            return len(self._events)
+
     def flush(self) -> str:
-        if not self.enabled or self._flushed:
+        """Write the current window and clear the buffer; returns the
+        output path, or "" when disabled or nothing was recorded.
+        Multiple windows per process are supported: each
+        ``profiler.trace()`` exit flushes its own window.  A window
+        NEVER clobbers an earlier one — when ``comm.json`` already
+        exists in the target directory (e.g. the shutdown flush landing
+        in a dir a profiler window already used), the new window goes to
+        ``comm.<n>.json``; ``tools/trace_merge.py`` globs ``comm*.json``
+        so every window joins the merged timeline."""
+        if not self.enabled:
             return ""
+        with self._lock:
+            if not self._events:
+                return ""
+            events, self._events = self._events, []
+            dropped, self._dropped = self._dropped, 0
+        if dropped:
+            from byteps_tpu.common import logging as bpslog
+
+            bpslog.warning(
+                "tracer dropped %d events past the %d-event buffer cap "
+                "(flush more often, or narrow the trace window)",
+                dropped, self.MAX_EVENTS,
+            )
         out_dir = os.path.join(self.trace_dir, str(self.local_rank))
         os.makedirs(out_dir, exist_ok=True)
         path = os.path.join(out_dir, "comm.json")
-        with self._lock:
-            payload = {"traceEvents": self._events, "displayTimeUnit": "ms"}
+        n = 2
+        while os.path.exists(path):
+            path = os.path.join(out_dir, f"comm.{n}.json")
+            n += 1
+        payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if dropped:
+            payload["otherData"] = {"dropped_events": dropped}
         with open(path, "w") as f:
             json.dump(payload, f)
-        self._flushed = True
         return path
 
 
@@ -100,3 +234,18 @@ class StageTimer:
     def __exit__(self, *exc):
         self.tracer.record(self.name, self.stage, self.t0, time.time() - self.t0, self.step)
         return False
+
+
+#: process-global tracer — set by init_state (workers) / PSServer
+#: (servers) so layers without runtime-state access (chaos van, PS
+#: client) can stamp events on the owning process's timeline
+_process_tracer: Optional[Tracer] = None
+
+
+def set_process_tracer(tracer: Optional[Tracer]) -> None:
+    global _process_tracer
+    _process_tracer = tracer
+
+
+def get_process_tracer() -> Optional[Tracer]:
+    return _process_tracer

@@ -905,6 +905,28 @@ class NativeServer {
       if (!conn->recv_exact(&h, sizeof(h))) { NDBG("serve: header recv failed"); break; }
       if (h.magic != kMagic) { NDBG("serve: BAD MAGIC 0x%02x (desync)", h.magic); break; }
 
+      // Optional trace context (transport.py TRACE_FLAG, status bit 7):
+      // a tracing worker appends 16 bytes (u64 trace_id + u64 span_id)
+      // after the header.  The native engine does not stamp spans —
+      // skip the block so the stream stays framed, and say so once per
+      // process so an operator wondering where the server child spans
+      // went gets an answer (the Python engine is the traced one).
+      if (h.status & 0x80) {
+        uint8_t trace_ctx[16];
+        if (!conn->recv_exact(trace_ctx, sizeof(trace_ctx))) {
+          NDBG("serve: trace-context recv failed");
+          break;
+        }
+        static std::atomic<bool> warned{false};
+        if (!warned.exchange(true)) {
+          fprintf(stderr,
+                  "byteps-native: ignoring trace context on incoming frames "
+                  "(the C++ engine emits no spans; use the Python server "
+                  "for server-side tracing)\n");
+        }
+        h.status &= static_cast<uint8_t>(~0x80);
+      }
+
       uint32_t seq = ntohl(h.seq);
       uint64_t key = be64toh(h.key);
       uint32_t cmd = ntohl(h.cmd);

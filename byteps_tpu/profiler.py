@@ -17,7 +17,14 @@ from typing import Iterator
 @contextlib.contextmanager
 def trace(log_dir: str, host_tracing: bool = True) -> Iterator[None]:
     """Capture an XLA device profile (and flush the host comm trace into
-    the same directory on exit)."""
+    the same directory on exit).
+
+    Re-entrant across windows: each exit flushes the events recorded
+    DURING this window into ``log_dir`` and clears the buffer, so a
+    process can capture any number of windows (the pre-observability
+    tracer latched after the first flush and silently dropped the rest).
+    Cross-process span files merge via ``tools/trace_merge.py``
+    (docs/observability.md)."""
     import jax
 
     jax.profiler.start_trace(log_dir)

@@ -268,6 +268,26 @@ class PSServer:
         # stable identity for scheduler rejoin matching (the listen address
         # is also stable, but a restarted server gets a fresh ephemeral port)
         self.node_uid = resolve_node_uid()
+        # observability plane (docs/observability.md): the server emits
+        # child spans (recv→sum→publish→reply) joined to worker traces by
+        # the wire-propagated ids, plus sum/publish latency histograms
+        # and a Prometheus endpoint.  The tracer writes its own
+        # "server<rank>" subdir so a same-host worker's file is never
+        # clobbered; tools/trace_merge.py stitches them.
+        from byteps_tpu.core.tracing import Tracer, get_process_tracer, set_process_tracer
+
+        self.tracer = Tracer(
+            enabled=cfg.trace_on,
+            trace_dir=cfg.trace_dir,
+            local_rank="server",
+            process_name="server",
+            spans_enabled=cfg.trace_spans,
+        )
+        if get_process_tracer() is None:
+            # a dedicated server process tags chaos faults on this tracer;
+            # in-process test clusters keep the worker's tracer
+            set_process_tracer(self.tracer)
+        self._metrics_http = None
 
     # --- lifecycle -------------------------------------------------------
 
@@ -281,11 +301,19 @@ class PSServer:
         t = threading.Thread(target=self._accept_loop, name="ps-accept", daemon=True)
         t.start()
         self._threads.append(t)
+        if self.cfg.metrics_port > 0 and self._metrics_http is None:
+            from byteps_tpu.core.telemetry import serve_metrics
+
+            self._metrics_http = serve_metrics(self.cfg.metrics_port)
         if register:
             self._register_with_scheduler()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._metrics_http is not None:
+            self._metrics_http.close()
+            self._metrics_http = None
+        self.tracer.flush()
         try:
             self._sock.close()  # listener: no peer to FIN
         except OSError:
@@ -328,6 +356,12 @@ class PSServer:
         self.rank = book["rank"]
         self.num_workers = book["num_workers"]
         self._adopt_worker_ranks(book)
+        # cross-process span identity (getattr: NativePSServer borrows
+        # this method and has no Python-side tracer)
+        tracer = getattr(self, "tracer", None)
+        if tracer is not None:
+            tracer.process_name = f"server{self.rank}"
+            tracer.local_rank = f"server{self.rank}"
         # global barrier before serving (server.cc:506)
         send_message(conn, Message(Op.BARRIER, flags=GROUP_ALL))
         recv_message(conn)
@@ -360,17 +394,35 @@ class PSServer:
             books apply within ~0.3s instead of a heartbeat interval."""
             import select as _select
 
+            from byteps_tpu.core.telemetry import metrics
+
             next_beat = time.monotonic() + hb if hb > 0 else None
+            delta: dict = {}
             try:
                 while not self._stop.is_set():
                     now = time.monotonic()
                     if next_beat is not None and now >= next_beat:
-                        send_message(conn, Message(Op.PING))
+                        # metric deltas piggyback on the beat — the
+                        # scheduler aggregates them cluster-wide
+                        # (docs/observability.md), same as the workers
+                        delta = metrics().delta_snapshot()
+                        send_message(
+                            conn,
+                            Message(
+                                Op.PING,
+                                payload=json.dumps(delta).encode()
+                                if delta else b"",
+                            ),
+                        )
+                        delta = {}  # delivered (send_all returned)
                         next_beat = now + hb
                     readable, _, _ = _select.select([conn], [], [], 0.3)
                     if readable:
                         handle_control(recv_message(conn))
             except (ConnectionError, OSError, ValueError):
+                # a delta consumed but not delivered rides the next
+                # successful beat instead of vanishing
+                metrics().requeue_delta(delta)
                 return
 
         threading.Thread(
@@ -465,6 +517,21 @@ class PSServer:
         except (ConnectionError, OSError):
             return
 
+    def _child_span(self, trace, key: int, name: str, t0: float,
+                    dur: float, **extra) -> None:
+        """One server-side child span joined to a worker span: same trace
+        id, parent = the wire-propagated worker span id.  ``trace`` is
+        the (trace_id, parent_span_id) pair off the frame; no-op for
+        untraced frames or a disabled tracer."""
+        if trace is None or not (self.tracer.enabled and self.tracer.spans_enabled):
+            return
+        from byteps_tpu.core.tracing import new_trace_id, span_args
+
+        self.tracer.record_span(
+            f"key{key}", name, t0, dur,
+            span_args(trace[0], new_trace_id(), parent_id=trace[1], **extra),
+        )
+
     def _key_state(self, key: int) -> _KeyState:
         with self._keys_lock:
             ks = self._keys.get(key)
@@ -484,8 +551,12 @@ class PSServer:
     def _enqueue(self, msg: Message, conn, send_lock) -> None:
         tid = self._thread_for(msg.key, len(msg.payload))
         ks = self._key_state(msg.key)
-        # anti-starvation: fewest accumulated pushes first (queue.h:49-97)
-        self._queues[tid].put(ks.pushed_total, (msg, conn, send_lock))
+        # anti-starvation: fewest accumulated pushes first (queue.h:49-97).
+        # The wall-clock stamp bounds the "recv" child span: engine-queue
+        # dwell is part of the server-side latency a worker observes.
+        self._queues[tid].put(
+            ks.pushed_total, (msg, conn, send_lock, time.time())
+        )
 
     # --- engine plane ----------------------------------------------------
 
@@ -494,16 +565,16 @@ class PSServer:
             item = q.get(timeout=0.2)
             if item is None:
                 continue
-            msg, conn, send_lock = item
+            msg, conn, send_lock, t_enq = item
             try:
                 if msg.op == Op.INIT:
                     self._handle_init(msg, conn, send_lock)
                 elif msg.op == Op.PUSH:
-                    self._handle_push(msg, conn, send_lock)
+                    self._handle_push(msg, conn, send_lock, t_enq)
                 elif msg.op == Op.PULL:
-                    self._handle_pull(msg, conn, send_lock)
+                    self._handle_pull(msg, conn, send_lock, t_enq)
                 elif msg.op == Op.FUSED:
-                    self._handle_fused(msg, conn, send_lock)
+                    self._handle_fused(msg, conn, send_lock, t_enq)
             except (ConnectionError, OSError):
                 continue
             except Exception as e:  # noqa: BLE001
@@ -710,7 +781,8 @@ class PSServer:
         ks.pushed_total += 1
         self._record_push_locked(ks, msg)
 
-    def _handle_push(self, msg: Message, conn, send_lock) -> None:
+    def _handle_push(self, msg: Message, conn, send_lock,
+                     t_enq: Optional[float] = None) -> None:
         ks = self._key_state(msg.key)
         rtype, dtype_id = decode_command_type(msg.cmd)
         if rtype == RequestType.ROW_SPARSE_PUSH_PULL:
@@ -730,7 +802,17 @@ class PSServer:
         arr = None
         if not compressed:
             arr = np.frombuffer(msg.payload, dtype=to_numpy_dtype(DataType(dtype_id)))
+        from byteps_tpu.core.telemetry import metrics
+
+        t_start = time.time()
+        if t_enq is not None:
+            # engine-queue dwell: the frame's wait between the serve
+            # thread and this engine thread
+            self._child_span(msg.trace, msg.key, "recv", t_enq,
+                             t_start - t_enq)
         flush: List = []
+        dedupe = False
+        published = 0.0
         with ks.lock:
             if ks.store is None:
                 # RuntimeError (not ConnectionError): the engine loop's
@@ -739,16 +821,30 @@ class PSServer:
                 # native server's return-false-drop)
                 raise RuntimeError(f"push for uninitialized key {msg.key}")
             if self._is_replayed_push_locked(ks, msg):
-                pass  # ack-only (below): the original was already summed
+                dedupe = True  # ack-only (below): the original was summed
             else:
                 self._sum_push_locked(ks, msg, compressed, arr)
                 if (not self.cfg.enable_async
                         and ks.recv_count >= self.num_workers):
+                    p0 = time.time()
                     flush.extend(self._publish_round_locked(ks, compressed))
+                    published = time.time() - p0
+        t_summed = time.time()
+        sum_dur = (t_summed - t_start) - published
+        metrics().observe("server_sum_seconds", max(0.0, sum_dur))
+        self._child_span(msg.trace, msg.key, "sum", t_start,
+                         max(0.0, sum_dur), dedupe=dedupe)
+        if published:
+            metrics().observe("server_publish_seconds", published)
+            self._child_span(msg.trace, msg.key, "publish",
+                             t_summed - published, published)
         send_message(conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version), send_lock)
+        self._child_span(msg.trace, msg.key, "reply", t_summed,
+                         time.time() - t_summed)
         self._flush_pulls(msg.key, flush)
 
-    def _handle_fused(self, msg: Message, conn, send_lock) -> None:
+    def _handle_fused(self, msg: Message, conn, send_lock,
+                      t_enq: Optional[float] = None) -> None:
         """Op.FUSED: unpack one multi-key fused frame, run every sub-push
         through the per-(worker, key) exactly-once ledger, and answer with
         ONE multi-key reply once every member's round is published.
@@ -763,7 +859,7 @@ class PSServer:
         The pull halves that cannot answer yet (peer workers still owe
         their round) park as ``fused_waiters`` on each key; round publish
         fills them, and the LAST filled slot queues the one reply frame."""
-        from byteps_tpu.comm.transport import decode_fused_push
+        from byteps_tpu.comm.transport import decode_fused_push, decode_fused_spans
 
         members = decode_fused_push(msg.payload)
         if not members:
@@ -775,6 +871,16 @@ class PSServer:
                 "server fused frame keys=%d bytes=%d v0=%d",
                 len(members), len(msg.payload), members[0][2],
             )
+        # member span ids from the fused body's optional trailer: each
+        # member's "sum" child span parents onto ITS worker-side span
+        # (the pack's own span rides the outer header and bounds recv)
+        member_spans = decode_fused_spans(msg.payload) if msg.trace else None
+        t_start = time.time()
+        if t_enq is not None:
+            self._child_span(msg.trace, msg.key, "recv", t_enq,
+                             t_start - t_enq, keys=len(members))
+        from byteps_tpu.core.telemetry import metrics
+
         reply = _FusedReply(
             conn, send_lock, msg.seq, msg.key, [m[0] for m in members]
         )
@@ -797,16 +903,23 @@ class PSServer:
                     payload, dtype=to_numpy_dtype(DataType(dtype_id))
                 )
             flush: List = []
+            dedupe = False
+            published = 0.0
+            t_m0 = time.time()
             with ks.lock:
                 if ks.store is None:
                     raise RuntimeError(f"push for uninitialized key {key}")
-                if not self._is_replayed_push_locked(ks, sub):
+                if self._is_replayed_push_locked(ks, sub):
+                    dedupe = True
+                else:
                     self._sum_push_locked(ks, sub, compressed, arr)
                     if (not self.cfg.enable_async
                             and ks.recv_count >= self.num_workers):
+                        p0 = time.time()
                         flush.extend(
                             self._publish_round_locked(ks, compressed)
                         )
+                        published = time.time() - p0
                 # this member's pull half: answered now if its round is
                 # published (async mode always is), else parked on the key
                 if self.cfg.enable_async or version <= ks.store_version:
@@ -818,7 +931,31 @@ class PSServer:
                         flush.append(reply)
                 else:
                     ks.fused_waiters.append((version, reply, slot, compressed))
+            t_m1 = time.time()
+            sum_dur = max(0.0, (t_m1 - t_m0) - published)
+            metrics().observe("server_sum_seconds", sum_dur)
+            if published:
+                metrics().observe("server_publish_seconds", published)
+            if msg.trace is not None:
+                # parent on the MEMBER's worker span when the trailer
+                # carried one; the pack span otherwise
+                parent = (
+                    member_spans[slot]
+                    if member_spans is not None else msg.trace[1]
+                )
+                self._child_span(
+                    (msg.trace[0], parent), key, "sum", t_m0, sum_dur,
+                    dedupe=dedupe, fused=True,
+                )
+                if published:
+                    self._child_span(
+                        (msg.trace[0], parent), key, "publish",
+                        t_m1 - published, published, fused=True,
+                    )
             self._flush_pulls(key, flush)
+        # no unconditional "reply" span here: the ONE fused reply leaves
+        # when its last member's round publishes — which may be this call
+        # (flushed above) or a later worker's push entirely
 
     def _handle_push_rowsparse(self, msg: Message, conn, send_lock, ks) -> None:
         """Row-sparse push (RequestType::kRowSparsePushPull,
@@ -946,11 +1083,16 @@ class PSServer:
                     flush = self._publish_round_locked(ks, ks.compressor is not None)
             self._flush_pulls(key, flush)
 
-    def _handle_pull(self, msg: Message, conn, send_lock) -> None:
+    def _handle_pull(self, msg: Message, conn, send_lock,
+                     t_enq: Optional[float] = None) -> None:
         ks = self._key_state(msg.key)
         rtype, _ = decode_command_type(msg.cmd)
         wants_compressed = rtype == RequestType.COMPRESSED_PUSH_PULL
         rowsparse = rtype == RequestType.ROW_SPARSE_PUSH_PULL
+        t_start = time.time()
+        if t_enq is not None:
+            self._child_span(msg.trace, msg.key, "recv", t_enq,
+                             t_start - t_enq)
         with ks.lock:
             if ks.store is None:
                 raise RuntimeError(f"pull for uninitialized key {msg.key}")
@@ -963,14 +1105,20 @@ class PSServer:
                 )
                 ver = ks.store_version
             else:
+                # parked: the round publish answers it; the worker-side
+                # PULL span keeps the whole wait attributable, so no
+                # server span is stamped for the park itself
                 ks.pending_pulls.append(
                     (msg.version, conn, send_lock, msg.seq, wants_compressed,
                      msg.payload if rowsparse else None)
                 )
                 return
+        t_ready = time.time()
         send_message(
             conn, Message(Op.PULL, key=msg.key, payload=payload, seq=msg.seq, version=ver), send_lock
         )
+        self._child_span(msg.trace, msg.key, "reply", t_ready,
+                         time.time() - t_ready)
 
 
 class NativePSServer:
@@ -1048,6 +1196,7 @@ class NativePSServer:
         self.num_workers = cfg.num_worker
         self._stop = threading.Event()
         self._sched_conn: Optional[socket.socket] = None
+        self._metrics_http = None
         from byteps_tpu.common.config import resolve_node_uid
 
         self.node_uid = resolve_node_uid()
@@ -1064,6 +1213,13 @@ class NativePSServer:
     _adopt_worker_ranks = PSServer._adopt_worker_ranks
 
     def start(self, register: bool = True) -> None:
+        # scrape surface even with the C++ data plane: the process-global
+        # registry still carries control-plane counters and gauges (the
+        # engine's per-RPC latency stays native-side, untracked)
+        if self.cfg.metrics_port > 0 and self._metrics_http is None:
+            from byteps_tpu.core.telemetry import serve_metrics
+
+            self._metrics_http = serve_metrics(self.cfg.metrics_port)
         if register:
             # identical control-plane bring-up to the Python server
             PSServer._register_with_scheduler(self)  # type: ignore[arg-type]
@@ -1073,6 +1229,9 @@ class NativePSServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._metrics_http is not None:
+            self._metrics_http.close()
+            self._metrics_http = None
         self._lib.bps_native_server_stop(self._id)
         close_socket(self._sched_conn)
 
@@ -1091,6 +1250,29 @@ def _make_reducer():
         return _numpy_sum
 
 
+def _serve_until_signaled(node) -> None:
+    """Park the entry-point thread; SIGTERM/SIGINT run ``node.stop()``
+    first — a plain kill would otherwise skip the trace flush and the
+    metrics-endpoint teardown, losing the server-side half of every
+    cross-process timeline (docs/observability.md)."""
+    import signal
+
+    done = threading.Event()
+
+    def _graceful(_signum, _frame):
+        try:
+            node.stop()
+        finally:
+            done.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _graceful)
+        except ValueError:
+            pass  # non-main thread (embedded use): no handler, park only
+    done.wait()
+
+
 def run_server() -> None:
     """Process entry: become scheduler or server per DMLC_ROLE
     (server/__init__.py:21-27)."""
@@ -1104,7 +1286,8 @@ def run_server() -> None:
             dead_node_timeout=cfg.dead_node_timeout_s,
         )
         sched.start()
-        threading.Event().wait()  # serve forever
+        _serve_until_signaled(sched)
+        return
     elif cfg.role == "server":
         import os
 
@@ -1123,6 +1306,6 @@ def run_server() -> None:
         else:
             srv = PSServer(cfg, host=cfg.node_host or "127.0.0.1")
         srv.start()
-        threading.Event().wait()
+        _serve_until_signaled(srv)
     else:
         raise SystemExit(f"run_server: unsupported role {cfg.role!r}")

@@ -218,7 +218,8 @@ class TestFusedFallback:
         bps.push_pull(x0, name="fb.a", average=False)  # init round
         client = get_state().ps_client
 
-        def broken_push_fused(members, cb, on_error=None, abort_check=None):
+        def broken_push_fused(members, cb, on_error=None, abort_check=None,
+                              **kwargs):
             on_error()  # every fused frame "exhausts its retries"
 
         orig = client.push_fused

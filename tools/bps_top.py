@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""bps_top — live terminal view of the byteps metrics plane.
+
+Polls one or more Prometheus exposition endpoints (``BYTEPS_METRICS_PORT``
+per process, or the scheduler's cluster aggregate) and renders the
+signals docs/observability.md says to read first: RPC round-trip
+percentiles, per-stage dwell, retry/dedupe/chaos counters (with per-server
+breakdown when present), fusion pack quality, server sum/publish latency,
+and push/pull throughput.  Counter RATES are computed between polls.
+
+Usage:
+
+    python tools/bps_top.py http://127.0.0.1:9102            # one endpoint
+    python tools/bps_top.py http://w0:9102 http://sched:9102 # several
+    python tools/bps_top.py --once http://127.0.0.1:9102     # single frame
+
+No dependencies beyond the stdlib; parses the text exposition directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import urllib.request
+from typing import Dict, Tuple
+
+Sample = Dict[Tuple[str, str], float]  # (metric, label-string) → value
+
+
+def scrape(url: str, timeout: float = 2.0) -> Sample:
+    if "://" not in url:
+        url = "http://" + url
+    body = urllib.request.urlopen(url, timeout=timeout).read().decode()
+    out: Sample = {}
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+            name, _, labels = series.partition("{")
+            out[(name, "{" + labels if labels else "")] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:7.2f}s "
+    if v >= 1e-3:
+        return f"{v * 1e3:7.2f}ms"
+    return f"{v * 1e6:7.1f}µs"
+
+
+def _histo_rows(s: Sample) -> list:
+    rows = []
+    fams = sorted({
+        n[: -len("_p50")] for (n, _lbl) in s if n.endswith("_p50")
+    })
+    for fam in fams:
+        for lbl in sorted({l for (n, l) in s if n == fam + "_p50"}):
+            count = s.get((fam + "_count", lbl), 0)
+            rows.append((
+                fam.replace("byteps_", "") + (lbl or ""),
+                int(count),
+                s.get((fam + "_p50", lbl), 0.0),
+                s.get((fam + "_p90", lbl), 0.0),
+                s.get((fam + "_p99", lbl), 0.0),
+            ))
+    return rows
+
+
+def render(url: str, cur: Sample, prev: Sample, dt: float) -> str:
+    lines = [f"── {url} " + "─" * max(0, 60 - len(url))]
+    # gauges
+    for (name, lbl), v in sorted(cur.items()):
+        if name == "byteps_pushpull_mbps":
+            lines.append(f"  push/pull throughput : {v:10.2f} MB/s")
+    # latency families
+    rows = _histo_rows(cur)
+    if rows:
+        lines.append(f"  {'latency':42s} {'count':>8s} {'p50':>9s} {'p90':>9s} {'p99':>9s}")
+        for fam, count, p50, p90, p99 in rows:
+            lines.append(
+                f"  {fam:42s} {count:8d} {_fmt_s(p50)} {_fmt_s(p90)} {_fmt_s(p99)}"
+            )
+    # counters + rates (totals only; labeled series shown when nonzero)
+    counter_rows = []
+    for (name, lbl), v in sorted(cur.items()):
+        if not name.endswith("_total"):
+            continue
+        rate = ""
+        if dt > 0 and (name, lbl) in prev:
+            r = (v - prev[(name, lbl)]) / dt
+            if r:
+                rate = f"{r:9.1f}/s"
+        if v or rate:
+            counter_rows.append(
+                f"  {name.replace('byteps_', '')[: -len('_total')] + (lbl or ''):42s}"
+                f" {int(v):10d} {rate}"
+            )
+    if counter_rows:
+        lines.append(f"  {'counter':42s} {'total':>10s}   rate")
+        lines.extend(counter_rows)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("urls", nargs="+", help="metrics endpoints to poll")
+    ap.add_argument("-i", "--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clearing)")
+    args = ap.parse_args(argv)
+    prev: Dict[str, Sample] = {}
+    t_prev = time.monotonic()
+    while True:
+        frames = []
+        now = time.monotonic()
+        dt = now - t_prev
+        for url in args.urls:
+            try:
+                cur = scrape(url)
+            except Exception as e:  # noqa: BLE001 — a dead peer is a display fact
+                frames.append(f"── {url}\n  unreachable: {e}")
+                continue
+            frames.append(render(url, cur, prev.get(url, {}), dt))
+            prev[url] = cur
+        t_prev = now
+        out = "\n\n".join(frames)
+        if args.once:
+            print(out)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        print(f"bps_top — {time.strftime('%H:%M:%S')} "
+              f"(every {args.interval:g}s, ctrl-c to quit)\n")
+        print(out)
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

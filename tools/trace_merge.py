@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Stitch per-process byteps trace files into ONE cross-process timeline.
+
+Each worker writes ``<trace_dir>/<local_rank>/comm.json`` and each Python
+server ``<trace_dir>/server<rank>/comm.json`` (core/tracing.py).  Span
+events carry wire-propagated trace/span ids (docs/observability.md), so a
+worker's PUSH span and the server's recv→sum→publish→reply children share
+a trace id — but they live in separate files.  This tool:
+
+1. collects every ``comm.json`` under the given directories (or explicit
+   file paths),
+2. keeps per-process identity: span events already carry a ``pid`` like
+   ``worker0`` / ``server1``; per-tensor stage envelopes (whose pid is
+   the tensor name) are namespaced per source file so two workers' rows
+   don't collide,
+3. emits Chrome trace FLOW events (``ph: s/f``) linking every
+   parent→child span pair found across processes, so Perfetto draws
+   arrows from the worker RPC span into the server's child spans,
+4. writes one merged Perfetto-loadable JSON.
+
+Usage:
+
+    python tools/trace_merge.py -o merged.json TRACE_DIR [TRACE_DIR ...]
+
+Demo recipe (2 workers / 1 server, fused + chaos): docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+
+def find_trace_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, _dirs, files in os.walk(p):
+            for f in files:
+                if f.endswith(".json") and f.startswith("comm"):
+                    out.append(os.path.join(root, f))
+    return sorted(set(out))
+
+
+def _source_tag(path: str) -> str:
+    """A short per-file namespace: the containing directory name
+    (``0``, ``1``, ``server0``, …)."""
+    return os.path.basename(os.path.dirname(os.path.abspath(path))) or "trace"
+
+
+def merge(files: List[str]) -> dict:
+    events: List[dict] = []
+    #: span id (hex) → (pid, tid, ts_us, dur_us) of the span that OWNS it
+    by_span: Dict[str, Tuple[str, str, float, float]] = {}
+    #: (child span ref) parent id (hex) → list of child event tuples
+    child_refs: List[Tuple[str, str, str, float]] = []
+
+    for path in files:
+        tag = _source_tag(path)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping {path}: {e}", file=sys.stderr)
+            continue
+        for ev in payload.get("traceEvents", []):
+            ev = dict(ev)
+            args = ev.get("args") or {}
+            if ev.get("cat") == "span":
+                # cross-process identity is already in pid (worker0 …)
+                span = args.get("span")
+                if span and ev.get("ph") == "X":
+                    prev = by_span.get(span)
+                    # keep the EARLIEST event as the span's anchor (a
+                    # task's first stage), so flow arrows start where
+                    # the work did
+                    if prev is None or ev["ts"] < prev[2]:
+                        by_span[span] = (
+                            ev["pid"], ev["tid"], ev["ts"], ev.get("dur", 0)
+                        )
+                parent = args.get("parent")
+                if parent:
+                    child_refs.append(
+                        (parent, ev["pid"], ev["tid"], ev["ts"])
+                    )
+            else:
+                # per-tensor stage envelope: namespace the tensor-name pid
+                # per source process so two ranks' rows stay separate
+                ev["pid"] = f"{tag}:{ev.get('pid', '')}"
+            events.append(ev)
+
+    # flow events: arrow from the parent span (worker RPC) to each child
+    # (server-side stage).  One flow id per parent span.
+    flow_id = 0
+    seen_parent_flow: Dict[str, int] = {}
+    flows: List[dict] = []
+    for parent, cpid, ctid, cts in child_refs:
+        anchor = by_span.get(parent)
+        if anchor is None:
+            continue  # parent span's process wasn't merged in
+        ppid, ptid, pts, pdur = anchor
+        fid = seen_parent_flow.get(parent)
+        if fid is None:
+            flow_id += 1
+            fid = seen_parent_flow[parent] = flow_id
+            flows.append({
+                "name": "rpc", "cat": "flow", "ph": "s", "id": fid,
+                "ts": pts + max(0.0, pdur) / 2, "pid": ppid, "tid": ptid,
+            })
+        flows.append({
+            "name": "rpc", "cat": "flow", "ph": "f", "bp": "e", "id": fid,
+            "ts": cts, "pid": cpid, "tid": ctid,
+        })
+    events.extend(flows)
+    events.sort(key=lambda e: e.get("ts", 0))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": files,
+            "linked_spans": len(seen_parent_flow),
+            "cross_process_children": len(child_refs),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="trace dirs (searched recursively) or comm.json files")
+    ap.add_argument("-o", "--output", default="merged_trace.json")
+    args = ap.parse_args(argv)
+    files = find_trace_files(args.paths)
+    if not files:
+        print("no comm*.json trace files found", file=sys.stderr)
+        return 1
+    merged = merge(files)
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    meta = merged["otherData"]
+    print(
+        f"merged {len(files)} file(s) → {args.output}: "
+        f"{len(merged['traceEvents'])} events, "
+        f"{meta['linked_spans']} linked spans, "
+        f"{meta['cross_process_children']} cross-process children"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
