@@ -162,11 +162,16 @@ def push_pull(
     Degraded-step policy (docs/robustness.md): when the data plane
     degrades mid-step — a server died past its retry budget — the handle
     raises :class:`~byteps_tpu.common.types.DegradedError`.  With
-    ``BYTEPS_DEGRADED_STEP_RETRIES`` > 0 this wrapper resubmits the step
-    up to that many times (with backoff, so the elastic rebuild can
-    land); resubmission is exactly-once safe — the abandoned round was
-    never published and the next submit re-runs the key's init barrier.
-    Default 0: the error propagates and the training loop decides.
+    ``BYTEPS_DEGRADED_STEP_RETRIES`` > 0 this wrapper first routes the
+    failure through the in-place recovery plane (the engine resyncs the
+    live servers, replays the journaled pushes they never absorbed, and
+    pulls the completed round — docs/robustness.md "healing flow"); only
+    when in-place heal is impossible does it resubmit the step up to that
+    many times (with backoff, so the elastic rebuild can land) through
+    the full re-init barrier.  Resubmission is exactly-once safe — the
+    abandoned round was never published and the next submit re-runs the
+    key's init barrier.  Default 0: the error propagates and the
+    training loop decides.
     """
     retries = get_config().degraded_step_retries
     if retries <= 0:
@@ -182,12 +187,23 @@ def push_pull(
             return synchronize(
                 push_pull_async(tensor, name, average=average, priority=priority)
             )
-        except (DegradedError, ConnectionError):
+        except (DegradedError, ConnectionError) as e:
             # ConnectionError covers the submit-time init barrier hitting
             # a not-yet-evicted dead server — same transient class, and
             # the user opted into step retries
             if attempt >= retries:
                 raise
+            if isinstance(e, DegradedError):
+                # in-place heal first: if the degradation was one-sided
+                # (every live peer sailed on), the journal replay
+                # completes the abandoned round with its ORIGINAL
+                # payloads and the pulled result is exactly the
+                # fault-free one — no re-init barrier, peers never block
+                st = require_state()
+                if st.engine is not None:
+                    healed = st.engine.heal_degraded(name, tensor, average)
+                    if healed is not None:
+                        return healed
             import time as _time
 
             _time.sleep(bo.next_delay())
@@ -322,8 +338,10 @@ def get_pushpull_speed() -> float:
 def get_robustness_counters() -> dict:
     """Snapshot of the data-plane degradation counters: retries, deadline
     expiries, connection revivals, replay dedupes, observed evictions,
-    injected chaos faults (docs/robustness.md).  Process-wide; usable
-    before :func:`init` (counters exist independently of runtime state).
+    injected chaos faults, and the recovery plane's ``resync_attempt`` /
+    ``resync_replayed_rounds`` / ``resync_giveup`` heal outcomes
+    (docs/robustness.md).  Process-wide; usable before :func:`init`
+    (counters exist independently of runtime state).
 
     FLAT totals only, for back-compat — the per-peer dimension (which
     server a retry/deadline/revive hit) is in :func:`get_metrics` under

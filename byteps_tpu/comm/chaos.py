@@ -44,8 +44,31 @@ disconnect → truncate → corrupt; delay is rolled independently):
     BYTEPS_CHAOS_DELAY        float, default 0
     BYTEPS_CHAOS_DELAY_MS     float, default 20 (max; uniform 0..max)
 
-Every injected fault bumps a ``chaos_*`` robustness counter
-(core/telemetry.py), so tests can assert the schedule actually fired.
+Targeting (one-sided failure rehearsal — docs/robustness.md "healing
+flow"; all three compose):
+
+    BYTEPS_CHAOS_OPS          comma-separated op codes (transport.Op
+                              ints); only frames whose header op matches
+                              are faulted (RESYNC frames are ordinary
+                              frames: name 23/24 here to fault the
+                              recovery plane itself).  Empty = all ops.
+    BYTEPS_CHAOS_TARGET_PORT  fault only connections dialed to — or
+                              accepted by a listener bound at — this TCP
+                              port (one server out of the fleet).  0 =
+                              every connection.
+    BYTEPS_CHAOS_FAULT_BUDGET process-global cap on TOTAL injected
+                              faults; once spent, chaos passes through.
+                              With DROP=1.0 this makes "exactly the
+                              first N targeted frames die" a
+                              deterministic schedule — how the resync
+                              tests kill one worker's retry budget on
+                              cue.  -1 (default) = unlimited.
+
+Non-targeted frames consume no RNG rolls, so the schedule for targeted
+frames stays reproducible per (seed, connection index) regardless of
+surrounding traffic.  Every injected fault bumps a ``chaos_*``
+robustness counter (core/telemetry.py), so tests can assert the
+schedule actually fired.
 """
 
 from __future__ import annotations
@@ -84,9 +107,17 @@ class ChaosParams:
     corrupt: float = 0.0
     delay: float = 0.0
     delay_ms: float = 20.0
+    #: fault only frames with these header op codes (empty = all)
+    ops: frozenset = frozenset()
+    #: fault only connections to/from this TCP port (0 = all)
+    target_port: int = 0
 
     @staticmethod
     def from_env() -> "ChaosParams":
+        ops = frozenset(
+            int(tok) for tok in
+            os.environ.get("BYTEPS_CHAOS_OPS", "").split(",") if tok.strip()
+        )
         return ChaosParams(
             seed=int(os.environ.get("BYTEPS_CHAOS_SEED", "0") or 0),
             drop=_env_float("BYTEPS_CHAOS_DROP", 0.0),
@@ -95,7 +126,47 @@ class ChaosParams:
             corrupt=_env_float("BYTEPS_CHAOS_CORRUPT", 0.0),
             delay=_env_float("BYTEPS_CHAOS_DELAY", 0.0),
             delay_ms=_env_float("BYTEPS_CHAOS_DELAY_MS", 20.0),
+            ops=ops,
+            target_port=int(
+                os.environ.get("BYTEPS_CHAOS_TARGET_PORT", "0") or 0
+            ),
         )
+
+
+# --- process-global fault budget (BYTEPS_CHAOS_FAULT_BUDGET) --------------
+#
+# Counts TOTAL injected faults across every chaos connection in the
+# process; once spent the chaos layer passes frames through untouched.
+# Latched from env on first use; tests reset it explicitly.
+
+_budget_lock = threading.Lock()
+_budget_left: list = [None]  # [None] = unread; [-1] = unlimited
+
+
+def reset_fault_budget(n=None) -> None:
+    """Re-arm the process fault budget: ``n`` faults, or re-read
+    ``BYTEPS_CHAOS_FAULT_BUDGET`` lazily when ``n`` is None."""
+    with _budget_lock:
+        _budget_left[0] = None if n is None else int(n)
+
+
+def _budget_allows() -> bool:
+    """Consume one unit of the fault budget; False = budget spent (the
+    frame must pass through un-faulted)."""
+    with _budget_lock:
+        left = _budget_left[0]
+        if left is None:
+            left = int(
+                os.environ.get("BYTEPS_CHAOS_FAULT_BUDGET", "-1") or -1
+            )
+        if left < 0:
+            _budget_left[0] = left
+            return True
+        if left == 0:
+            _budget_left[0] = 0
+            return False
+        _budget_left[0] = left - 1
+        return True
 
 
 class ChaosSocket:
@@ -107,12 +178,18 @@ class ChaosSocket:
     frame.  Receives and teardown pass straight through.
     """
 
-    def __init__(self, sock, params: ChaosParams, conn_index: int) -> None:
+    def __init__(self, sock, params: ChaosParams, conn_index: int,
+                 peer_port: int = 0) -> None:
         self._sock = sock
         self._p = params
         # independent stream per connection, reproducible per (seed, index)
         self._rng = random.Random((params.seed << 20) ^ conn_index)
         self._send_lock = threading.Lock()  # fault decisions are ordered
+        # one-sided targeting: with target_port set, only the connection
+        # dialed to (or accepted at) that port is ever faulted
+        self._targeted = (
+            not params.target_port or peer_port == params.target_port
+        )
 
     # --- fault engine -----------------------------------------------------
     def _bump(self, name: str, frame: bytes = b"") -> None:
@@ -152,16 +229,34 @@ class ChaosSocket:
     def _send_frame(self, data: bytes) -> None:
         p = self._p
         with self._send_lock:
+            # targeting: an untargeted connection, or a frame whose
+            # header op is outside the BYTEPS_CHAOS_OPS filter, passes
+            # through WITHOUT consuming an RNG roll — the targeted
+            # schedule stays reproducible regardless of other traffic
+            if not self._targeted or (
+                p.ops and (len(data) < 2 or data[1] not in p.ops)
+            ):
+                self._sock.sendall(data)
+                return
             roll = self._rng.random()
             if roll < p.drop:
+                if not _budget_allows():
+                    self._sock.sendall(data)
+                    return
                 self._bump("chaos_drop", data)
                 return
             roll -= p.drop
             if roll < p.disconnect:
+                if not _budget_allows():
+                    self._sock.sendall(data)
+                    return
                 self._bump("chaos_disconnect", data)
                 self._die("disconnect")
             roll -= p.disconnect
             if roll < p.truncate:
+                if not _budget_allows():
+                    self._sock.sendall(data)
+                    return
                 self._bump("chaos_truncate", data)
                 k = self._rng.randrange(0, max(1, len(data)))
                 try:
@@ -171,13 +266,17 @@ class ChaosSocket:
                 self._die("truncated frame")
             roll -= p.truncate
             if roll < p.corrupt:
+                if not _budget_allows():
+                    self._sock.sendall(data)
+                    return
                 self._bump("chaos_corrupt", data)
                 mangled = bytearray(data)
                 if mangled:
                     mangled[0] ^= 0xFF  # flip the magic → framing rejects it
                 self._sock.sendall(bytes(mangled))
                 return
-            if p.delay > 0 and self._rng.random() < p.delay:
+            if (p.delay > 0 and self._rng.random() < p.delay
+                    and _budget_allows()):
                 self._bump("chaos_delay", data)
                 time.sleep(self._rng.random() * p.delay_ms / 1e3)
             self._sock.sendall(data)
@@ -227,15 +326,22 @@ class ChaosSocket:
 
 class ChaosListener:
     """Accept wrapper: accepted connections get the chaos treatment, so
-    server→worker frames (acks, pull responses) are faulted too."""
+    server→worker frames (acks, pull responses) are faulted too.
+    ``port`` is the bound listen port — with BYTEPS_CHAOS_TARGET_PORT
+    set, only the one server bound there faults its response lanes."""
 
-    def __init__(self, inner, params: ChaosParams) -> None:
+    def __init__(self, inner, params: ChaosParams, port: int = 0) -> None:
         self._inner = inner
         self._params = params
+        self._port = port
 
     def accept(self):
         conn, addr = self._inner.accept()
-        return ChaosSocket(conn, self._params, _next_conn_index()), addr
+        return (
+            ChaosSocket(conn, self._params, _next_conn_index(),
+                        peer_port=self._port),
+            addr,
+        )
 
     def shutdown(self, how: int = socket.SHUT_RDWR) -> None:
         try:
@@ -267,12 +373,17 @@ def make_chaos_van(inner):
 
         def listen(self, host: str):
             lsock, phost, port = self.inner.listen(host)
-            return ChaosListener(lsock, self.params), CHAOS_PREFIX + phost, port
+            return (
+                ChaosListener(lsock, self.params, port=port),
+                CHAOS_PREFIX + phost,
+                port,
+            )
 
         def connect(self, host: str, port: int, timeout: float = 30.0):
             if host.startswith(CHAOS_PREFIX):
                 host = host[len(CHAOS_PREFIX):]
             sock = self.inner.connect(host, port, timeout=timeout)
-            return ChaosSocket(sock, self.params, _next_conn_index())
+            return ChaosSocket(sock, self.params, _next_conn_index(),
+                               peer_port=port)
 
     return ChaosVan()

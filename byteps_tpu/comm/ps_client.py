@@ -467,6 +467,22 @@ class PSClient:
         # (zero per-retry churn); the cap bounds the footprint.
         self._retry_threads: List[threading.Thread] = []
         self._retry_pool_cap = 4
+        # --- recovery plane (docs/robustness.md "healing flow") ---
+        # per-server heal serialization: concurrent give-ups against one
+        # server collapse into a single resync (the generation counter
+        # lets late arrivals ride a heal that completed while they waited)
+        self._heal_meta_lock = threading.Lock()
+        self._heal_locks: Dict[str, threading.Lock] = {}
+        self._heal_gen: Dict[str, int] = {}
+        # init-idempotency tokens: per-key init sequence, salted per
+        # client instance so a restarted process (or a post-shutdown
+        # re-init) can never collide with a previous generation's
+        # completed-barrier record on the server
+        import random as _random
+
+        self._init_seq_lock = threading.Lock()
+        self._init_seqs: Dict[int, int] = {}
+        self._init_salt = _random.SystemRandom().getrandbits(16)
 
     # --- rendezvous ------------------------------------------------------
 
@@ -779,6 +795,22 @@ class PSClient:
         r = self.rank
         return r + 1 if r is not None and 0 <= r < 255 else 0
 
+    def _init_token(self, key: int) -> int:
+        """Init-idempotency token carried in the INIT frame's ``version``
+        field (docs/robustness.md): low 16 bits = this client's per-key
+        init sequence, high 16 bits = the membership epoch folded with a
+        per-client random salt.  Every RETRY of one logical init reuses
+        the same token, so a replayed INIT whose barrier already released
+        is acked from the server's completed-barrier record instead of
+        re-parked (the dropped-ack strand).  Epoch-scoping + the salt
+        make elastic rejoin and post-shutdown re-init mint FRESH tokens,
+        so a genuine new barrier always parks."""
+        with self._init_seq_lock:
+            seq = self._init_seqs.get(key, 0) + 1
+            self._init_seqs[key] = seq
+        high = (self._init_salt ^ (self.membership_epoch & 0xFFFF)) & 0xFFFF
+        return (high << 16) | (seq & 0xFFFF)
+
     def _ensure_scanner_locked(self) -> None:
         """Start (or wake) the shared deadline/timer scanner thread.
         Caller holds ``_outstanding_lock``."""
@@ -953,6 +985,7 @@ class PSClient:
         sink: Optional[memoryview] = None,
         abort_check: Optional[Callable[[], bool]] = None,
         precheck: Optional[Callable[[], bool]] = None,
+        heal: bool = True,
     ) -> None:
         """Send one async RPC with deadline + retry + revival.
 
@@ -977,6 +1010,14 @@ class PSClient:
         destination, and the caller's error path knows how to regroup
         (engine unfuse fallback), while blind resends would just burn the
         retry budget shipping mis-homed keys.
+
+        ``heal``: with retries exhausted, route ONCE through the in-place
+        resync heal (docs/robustness.md "healing flow") before surfacing
+        the error — the give-up may be one-sided (every frame to a LIVE
+        server lost) and a successful server resync + journal replay
+        earns the RPC one fresh attempt.  Fused frames pass ``False``:
+        their error path is the unfuse fallback, and the per-key unfused
+        RPCs it spawns carry their own heal.
         """
         from byteps_tpu.comm.retry import Backoff
 
@@ -998,10 +1039,34 @@ class PSClient:
                 return True
             return False
 
-        def fail() -> None:
+        def finish_fail() -> None:
             counters().bump("rpc_giveup", labels={"server": sid})
             if on_error is not None:
                 on_error()
+
+        def fail() -> None:
+            # retries exhausted: before surfacing the error, try the
+            # in-place heal ONCE — resync the server's authoritative
+            # ledger, replay journaled pushes it never absorbed, then
+            # re-attempt this RPC (docs/robustness.md "healing flow").
+            # Off this thread: the heal blocks in dials and recovery
+            # RPCs, and fail() can fire from a recv-loop drain.
+            if (heal and not state.get("healed") and not self._stop.is_set()
+                    and self.cfg.resync_deadline_s > 0):
+                state["healed"] = True
+
+                def heal_and_resend() -> None:
+                    if aborted_cleanup():
+                        return
+                    if self._heal_in_place(key, sid):
+                        state["attempt"] = 0
+                        send_attempt()
+                    else:
+                        finish_fail()
+
+                self._dispatch_retry(heal_and_resend)
+                return
+            finish_fail()
 
         def retry_later() -> None:
             if aborted_cleanup():
@@ -1064,6 +1129,192 @@ class PSClient:
                     retry_later()
 
         send_attempt()
+
+    # --- recovery plane: in-place heal via server-driven resync ----------
+    #
+    # docs/robustness.md "healing flow".  A worker that exhausted its RPC
+    # retries against a LIVE server (one-sided degradation: chaos drops,
+    # a flapping link, a deadline storm) used to have only the global
+    # re-init barrier — which waits for peers that never come, stranding
+    # the whole job.  Instead: ask the server for its authoritative
+    # per-key round/ledger state (Op.RESYNC_QUERY), replay exactly the
+    # journaled pushes it never absorbed, and resume in place.  Peers
+    # never block, no barrier, no scheduler involvement.
+
+    def resync_in_place(self, key: int) -> bool:
+        """Public entry to the heal state machine (engine / api layer):
+        resync ``key``'s owning server and replay whatever journaled
+        rounds it is missing.  True = the server's ledger now agrees
+        with this worker's emission history."""
+        try:
+            sid = str(self.server_for(key))
+        except (ValueError, ZeroDivisionError, IndexError):
+            return False
+        return self._heal_in_place(key, sid)
+
+    def _heal_in_place(self, key: int, sid: str) -> bool:
+        """One heal attempt, serialized per server: query → replay →
+        resume, bounded by ``BYTEPS_RESYNC_DEADLINE_S`` wall-clock.
+        Counters: ``resync_attempt`` / ``resync_replayed_rounds`` /
+        ``resync_giveup`` (flat + per-server labels); the attempt also
+        lands as a ``RESYNC`` span on the process timeline, and the wire
+        query carries its trace context so the server's ``resync`` child
+        span joins it on the merged Perfetto view."""
+        if (self.cfg.resync_deadline_s <= 0 or self._stop.is_set()
+                or not self._worker_flag()):
+            # anonymous workers (no rank identity) have no ledger slot on
+            # the server — there is nothing to resync against
+            return False
+        with self._heal_meta_lock:
+            lock = self._heal_locks.setdefault(sid, threading.Lock())
+            entry_gen = self._heal_gen.get(sid, 0)
+        trace = None
+        tracer = None
+        from byteps_tpu.core.tracing import (
+            get_process_tracer,
+            new_trace_id,
+            span_args,
+        )
+
+        tracer = get_process_tracer()
+        if tracer is not None and tracer.enabled and tracer.spans_enabled:
+            trace = (new_trace_id(), new_trace_id())
+        t0 = time.time()
+        with lock:
+            with self._heal_meta_lock:
+                if self._heal_gen.get(sid, 0) != entry_gen:
+                    # a concurrent give-up healed this server while we
+                    # waited for the lock — ride its work
+                    return True
+            counters().bump("resync_attempt", labels={"server": sid})
+            ok, replayed = False, 0
+            try:
+                ok, replayed = self._run_resync(key, sid, trace)
+            except Exception:  # noqa: BLE001 — a heal must never leak
+                ok = False
+            if ok:
+                with self._heal_meta_lock:
+                    self._heal_gen[sid] = entry_gen + 1
+            else:
+                counters().bump("resync_giveup", labels={"server": sid})
+        if trace is not None:
+            tracer.record_span(
+                "resync", "RESYNC", t0, time.time() - t0,
+                span_args(trace[0], trace[1], server=sid,
+                          replayed=replayed, healed=ok),
+            )
+        return ok
+
+    def _run_resync(self, route_key: int, sid: str, trace) -> tuple:
+        """The heal body → (ok, rounds_replayed).  Caller holds the
+        server's heal lock.
+
+        1. (Re-)dial the server; a server that cannot be dialed is DOWN,
+           not one-sided — that case belongs to eviction/rebuild, so the
+           heal fails fast instead of burning the budget.
+        2. Op.RESYNC_QUERY for every key this worker journals toward the
+           server (plus the triggering key): the reply's per-key ``seen``
+           is the newest version of OUR pushes its exactly-once ledger
+           absorbed.
+        3. Replay, oldest-first, exactly the journaled rounds above each
+           ``seen`` watermark through the NORMAL push path (ledger
+           dedupe, zombie fence, round publish all apply) — fused-pack
+           members replay as plain per-key pushes, which the server sums
+           identically.
+        """
+        from byteps_tpu.comm.journal import get_journal
+        from byteps_tpu.comm.retry import Backoff
+        from byteps_tpu.comm.transport import (
+            decode_resync_state,
+            encode_resync_query,
+        )
+
+        deadline_at = time.monotonic() + self.cfg.resync_deadline_s
+        j = get_journal()
+        wid = self._worker_flag()
+
+        def owned(k: int) -> bool:
+            try:
+                return str(self.server_for(k)) == sid
+            except (ValueError, ZeroDivisionError, IndexError):
+                return False
+
+        keys = sorted(
+            {route_key} | {k for k in (j.keys() if j else []) if owned(k)}
+        )
+        backoff = Backoff(base=max(0.01, self.cfg.rpc_backoff_s), cap=1.0)
+
+        def recovery_rpc(k: int, make_msg, errmsg: str):
+            """One blocking recovery RPC, re-dialed and re-sent within
+            the heal budget; None once the budget (or the server) dies."""
+            while True:
+                remaining = deadline_at - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
+                    return None
+                per_try = (
+                    min(remaining, max(0.2, self.cfg.rpc_deadline_s))
+                    if self.cfg.rpc_deadline_s > 0 else remaining
+                )
+                try:
+                    sc = self._conn_for(k, revive=True)
+                except (ConnectionError, OSError):
+                    return None  # server not dialable: not the one-sided case
+                try:
+                    return self._blocking_request(sc, make_msg, errmsg, per_try)
+                except ConnectionError:
+                    # frames still being lost (the chaos that caused the
+                    # give-up): back off and re-dial within the budget
+                    if self._stop.wait(min(
+                        backoff.next_delay(),
+                        max(0.0, deadline_at - time.monotonic()),
+                    )):
+                        return None
+
+        resp = recovery_rpc(
+            route_key,
+            lambda seq: Message(
+                Op.RESYNC_QUERY, key=route_key, seq=seq, flags=wid,
+                payload=encode_resync_query(wid, keys), trace=trace,
+            ),
+            "resync query failed",
+        )
+        if resp is None:
+            return False, 0
+        if resp.op != Op.RESYNC_STATE or resp.status != 0:
+            # the server doesn't speak the recovery plane (native C++
+            # engine rejects with nonzero status) — fall back to re-init
+            return False, 0
+        state = decode_resync_state(resp.payload)
+        replayed = 0
+        for k in keys:
+            info = state.get(k)
+            if info is None:
+                if j is not None and j.entries_after(k, 0):
+                    # we journaled pushes for a key the server no longer
+                    # holds: its store was lost (restart) — only the init
+                    # barrier can rebuild allocation, resync cannot
+                    return False, replayed
+                continue
+            entries = (
+                j.entries_after(k, int(info.get("seen", 0))) if j else []
+            )
+            for e in entries:
+                ack = recovery_rpc(
+                    k,
+                    lambda seq, _k=k, _e=e: Message(
+                        Op.PUSH, key=_k, seq=seq, cmd=_e.cmd,
+                        version=_e.version, flags=wid, payload=_e.payload,
+                        trace=trace,
+                    ),
+                    f"resync replay failed for key {k}",
+                )
+                if ack is None or ack.status != 0:
+                    return False, replayed
+                counters().bump(
+                    "resync_replayed_rounds", labels={"server": sid}
+                )
+                replayed += 1
+        return True, replayed
 
     def _blocking_request_retrying(
         self, key: int, make_msg, errmsg: str, use_deadline: bool = True
@@ -1296,9 +1547,17 @@ class PSClient:
         worker flag so a replayed init REPLACES this worker's barrier
         waiter instead of double-counting it (server.py).  ``trace``
         rides the optional trace-context header field; a retried init
-        keeps its span."""
+        keeps its span.
+
+        The ``version`` field carries the init-idempotency token
+        (:meth:`_init_token`), fixed across this init's retries: a retry
+        arriving AFTER the barrier released is acked from the server's
+        completed-barrier record instead of re-parked — without it, the
+        retrier's released peers never re-init the key and the short
+        barrier strands the retry until its budget dies."""
         import struct
 
+        token = self._init_token(key)
         self._blocking_request_retrying(
             key,
             lambda seq: Message(
@@ -1306,6 +1565,7 @@ class PSClient:
                 key=key,
                 seq=seq,
                 flags=self._worker_flag(),
+                version=token,
                 payload=struct.pack("!QI", num_elements, dtype_id),
                 trace=trace,
             ),
@@ -1421,6 +1681,9 @@ class PSClient:
             on_error=on_error,
             abort_check=abort_check,
             precheck=lambda: self.server_generation == gen0,
+            # no frame-level heal: the fused error path is the unfuse
+            # fallback, whose per-key RPCs each carry their own heal
+            heal=False,
         )
 
     def pull(

@@ -65,6 +65,14 @@ class Op(enum.IntEnum):
     PING = 20
     SHUTDOWN = 21
     QUERY = 22        # cluster liveness snapshot (heartbeat ages)
+    # recovery plane (docs/robustness.md "healing flow"): a worker that
+    # exhausted its RPC retries against a LIVE server asks that server
+    # for its authoritative per-key round/ledger state, replays only the
+    # journaled pushes the server never absorbed, and rejoins in place —
+    # no global re-init barrier, no peer participation.  Python server
+    # engine only (the C++ engine rejects these with a nonzero status).
+    RESYNC_QUERY = 23  # worker → server: {worker flag, keys of interest}
+    RESYNC_STATE = 24  # server → worker: per-key {store_version, seen, ...}
 
 
 class Message:
@@ -317,6 +325,59 @@ def decode_liveness(payload: bytes) -> dict:
 
     raw = json.loads(payload.decode())
     return {role: {int(r): age for r, age in d.items()} for role, d in raw.items()}
+
+
+# --- recovery-plane frames (Op.RESYNC_QUERY / Op.RESYNC_STATE) ------------
+#
+# JSON bodies, like the control plane: resync is a rare, human-debuggable
+# recovery RPC, not a data-plane hot path, and JSON keeps it greppable in
+# packet dumps.  Python server engine only (docs/robustness.md); the C++
+# engine answers these ops with a nonzero status and the worker's heal
+# path falls back to the global re-init barrier.
+#
+# Query body:  {"worker": <flags byte>, "keys": [<u64 key>, ...]}
+#              (empty "keys" = every key the server holds)
+# State body:  {"keys": {"<key>": {"store_version": v, "seen": s,
+#                                  "recv_count": c, "init": true}}}
+#              where "seen" is the newest version of THIS worker's pushes
+#              the server has absorbed into its exactly-once ledger.
+
+
+def encode_resync_query(worker_flag: int, keys) -> bytes:
+    """Body of an Op.RESYNC_QUERY frame."""
+    import json
+
+    return json.dumps(
+        {"worker": int(worker_flag), "keys": [int(k) for k in keys]}
+    ).encode()
+
+
+def decode_resync_query(payload: bytes) -> Tuple[int, list]:
+    """→ (worker_flag, [key, ...]); raises ValueError on a malformed body."""
+    import json
+
+    raw = json.loads(payload.decode())
+    if not isinstance(raw, dict):
+        raise ValueError("resync query body must be a JSON object")
+    return int(raw.get("worker", 0)), [int(k) for k in raw.get("keys", [])]
+
+
+def encode_resync_state(states: dict) -> bytes:
+    """Body of an Op.RESYNC_STATE reply; ``states`` maps int key →
+    {"store_version", "seen", "recv_count", "init"}."""
+    import json
+
+    return json.dumps({"keys": {str(k): v for k, v in states.items()}}).encode()
+
+
+def decode_resync_state(payload: bytes) -> dict:
+    """Inverse of :func:`encode_resync_state` → {int key: info dict}."""
+    import json
+
+    raw = json.loads(payload.decode())
+    if not isinstance(raw, dict) or not isinstance(raw.get("keys", {}), dict):
+        raise ValueError("resync state body must be a JSON object")
+    return {int(k): v for k, v in raw.get("keys", {}).items()}
 
 
 def close_socket(sock: Optional[socket.socket]) -> None:

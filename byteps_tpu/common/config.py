@@ -130,6 +130,20 @@ class Config:
     # times (exactly-once safe; api.py) before surfacing the error
     degraded_step_retries: int = 0  # BYTEPS_DEGRADED_STEP_RETRIES
 
+    # --- recovery plane (docs/robustness.md "healing flow") ---
+    # rounds of emitted push payloads retained per key by the worker-side
+    # round journal (comm/journal.py); a worker that exhausts its RPC
+    # retries against a LIVE server replays exactly the journaled rounds
+    # the server reports missing (Op.RESYNC_QUERY) and rejoins in place.
+    # 0 disables journaling (resync then heals only lost-ack give-ups).
+    journal_rounds: int = 2  # BYTEPS_JOURNAL_ROUNDS
+    # total byte cap across all journaled payloads; oldest rounds evicted
+    journal_bytes: int = 64 << 20  # BYTEPS_JOURNAL_BYTES
+    # wall-clock budget for one heal attempt (server resync query +
+    # journal replay); 0 disables the in-place heal entirely — give-ups
+    # surface DegradedError immediately, the pre-recovery behavior
+    resync_deadline_s: float = 5.0  # BYTEPS_RESYNC_DEADLINE_S
+
     # --- transport (ps-lite van lanes) ---
     # parallel TCP connections per server, partitions striped across them
     # by key — the implementable analogue of the reference's RDMA/UCX
@@ -237,6 +251,11 @@ class Config:
             ),
             degraded_step_retries=max(
                 0, _env_int("BYTEPS_DEGRADED_STEP_RETRIES", 0)
+            ),
+            journal_rounds=max(0, _env_int("BYTEPS_JOURNAL_ROUNDS", 2)),
+            journal_bytes=max(1, _env_int("BYTEPS_JOURNAL_BYTES", 64 << 20)),
+            resync_deadline_s=float(
+                os.environ.get("BYTEPS_RESYNC_DEADLINE_S", "5") or "5"
             ),
             tcp_streams=max(1, _env_int("BYTEPS_TCP_STREAMS", 1)),
             native_client=_env_bool("BYTEPS_NATIVE_CLIENT"),

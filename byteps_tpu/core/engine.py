@@ -328,6 +328,14 @@ class PipelineEngine:
             QueueType.COPYH2D: ScheduledQueue(QueueType.COPYH2D, discipline=disc),
         }
         self._fuser = _Fuser(self)
+        # recovery plane (docs/robustness.md "healing flow"): bounded
+        # journal of emitted push payloads, replayed by the PS client's
+        # resync heal when a live server reports rounds it never
+        # absorbed.  (Re)configured per engine so a previous generation's
+        # entries can never replay into this one's round numbering.
+        from byteps_tpu.comm.journal import configure_journal
+
+        self._journal = configure_journal(cfg.journal_rounds, cfg.journal_bytes)
         # small tasks submitted but not yet handed to the fusion buffer:
         # the idle-flush decision needs this because queue.pending() can't
         # see a task COPYD2H has popped but not finished staging
@@ -542,6 +550,12 @@ class PipelineEngine:
                 # uninitialized key and the server would drop the conn
                 if not ctx.partitions:
                     build_partitions(ctx)
+                if self._journal is not None:
+                    # the barrier below restarts this key's round
+                    # numbering: journaled payloads from the old
+                    # numbering must never replay into the new one
+                    for part in ctx.partitions:
+                        self._journal.clear_key(part.key)
                 for part in ctx.partitions:
                     if self._traced():
                         from byteps_tpu.core.tracing import (
@@ -909,6 +923,116 @@ class PipelineEngine:
             out = jax.device_put(out)
         get_state().handles.mark_done(job.handle, out)
 
+    # --- recovery plane (docs/robustness.md "healing flow") --------------
+
+    def heal_degraded(self, name: str, tensor: Any, average: bool):
+        """In-place recovery for a tensor whose last job failed degraded
+        while the cluster topology stayed put (one-sided degradation):
+        resync every owning server — replaying the journaled pushes they
+        never absorbed, which completes the abandoned round with the
+        ORIGINAL payloads — then pull the published round and hand the
+        caller the result it would have gotten fault-free.  Peers never
+        block and no re-init barrier runs; on success the tensor's
+        forced-re-init mark is cleared so its next submit continues the
+        version sequence in place.
+
+        Returns the aggregated (and averaged/reshaped) result, or None
+        when in-place heal is not possible — topology changed under the
+        job (the cluster-coherent re-init path owns that), compressed or
+        device-codec keys (their pull needs the codec pipeline), resync
+        refused (server restarted, journal gap, native engine), or the
+        healed round's pull timed out.  The caller then falls back to
+        the resubmit-with-re-init path, which is the pre-recovery
+        behavior."""
+        registry = get_registry()
+        if not registry.is_declared(name):
+            return None
+        ctx = registry.get(name)
+        gen = getattr(self.client, "server_generation", 0)
+        with self._init_lock:
+            if (name not in self._reinit_names or not ctx.initialized
+                    or ctx.engine_epoch != self._epoch
+                    or ctx.server_generation != gen or not ctx.partitions):
+                return None
+        if any(
+            p.key in self._compressors or p.key in self._device_codecs
+            for p in ctx.partitions
+        ):
+            return None
+        import jax
+
+        is_jax = isinstance(tensor, jax.Array)
+        np_dtype = (
+            np.dtype(tensor.dtype) if hasattr(tensor, "dtype")
+            else np.asarray(tensor).dtype
+        )
+        shape = np.shape(tensor)
+        total = sum(p.length for p in ctx.partitions)
+        if int(np.prod(shape, dtype=np.int64)) != total:
+            return None
+        dtype_id = int(to_datatype(np_dtype))
+        version = ctx.version
+        # 1. resync each owning server: the replay of journaled pushes is
+        # what completes the abandoned round server-side
+        route_keys: Dict[int, int] = {}
+        for p in ctx.partitions:
+            try:
+                route_keys.setdefault(self.client.server_for(p.key), p.key)
+            except (ValueError, ZeroDivisionError, IndexError):
+                return None
+        for key in route_keys.values():
+            if not self.client.resync_in_place(key):
+                return None
+        # 2. pull the (now completable) round into a fresh result buffer;
+        # the pull's own retry/heal machinery applies per attempt
+        result = np.empty(total, dtype=np_dtype)
+        timeout = max(
+            10.0,
+            self.cfg.resync_deadline_s
+            + (self.cfg.rpc_deadline_s or 1.0) * (self.cfg.rpc_retries + 1),
+        )
+        from byteps_tpu.comm.ps_client import _ZERO_COPIED
+
+        # issue every partition's pull first, then wait: one round-trip
+        # (and at worst one timeout) for the whole tensor, not P of them
+        pending = []
+        for p in ctx.partitions:
+            done = threading.Event()
+            box: dict = {}
+            sink = memoryview(result).cast("B")[
+                p.offset * np_dtype.itemsize
+                : (p.offset + p.length) * np_dtype.itemsize
+            ]
+
+            def on_pull(payload, _box=box, _done=done):
+                _box["payload"] = payload
+                _done.set()
+
+            self.client.pull(
+                p.key, version, on_pull, dtype_id=dtype_id, sink=sink,
+                on_error=lambda _done=done: _done.set(),
+            )
+            pending.append((p, done, box))
+        deadline = time.monotonic() + timeout
+        for p, done, box in pending:
+            if not done.wait(max(0.0, deadline - time.monotonic())) or (
+                "payload" not in box
+            ):
+                return None  # round still incomplete: fall back to re-init
+            payload = box["payload"]
+            if payload is not _ZERO_COPIED:
+                arr = np.frombuffer(payload, dtype=np_dtype)
+                result[p.offset : p.offset + p.length] = arr[: p.length]
+        with self._init_lock:
+            self._reinit_names.discard(name)
+        out = result
+        if average and np.issubdtype(np_dtype, np.floating):
+            out = out / self.client.num_workers
+        out = out.reshape(shape)
+        if is_jax:
+            out = jax.device_put(out)
+        return out
+
     def _copy_d2h_once(self, task: TensorTableEntry) -> None:
         """Per-partition device→host DMA (COPYD2H, core_loops.cc:378-443).
 
@@ -1028,6 +1152,12 @@ class PipelineEngine:
             self.telemetry.record(sum(len(p) for _, _, _, p in wire))
         counters().bump("fused_frames")
         counters().bump("fused_keys", len(members))
+        if self._journal is not None:
+            # each member journals individually: a resync replay re-sends
+            # them as plain per-key pushes, which the server sums through
+            # the same per-(worker, key) ledger a fused member uses
+            for key, cmd, version, payload in wire:
+                self._journal.record(key, version, cmd, payload, fused=True)
 
         # pack span: its own trace (members each belong to their jobs'
         # traces; their span ids ride the fused body's trailer so the
@@ -1139,6 +1269,13 @@ class PipelineEngine:
             rtype = RequestType.DEFAULT_PUSH_PULL
         if self.telemetry is not None:
             self.telemetry.record(len(payload))
+        if self._journal is not None:
+            # recovery plane: journal the exact wire payload BEFORE the
+            # send, so a give-up on this very RPC can already replay it
+            self._journal.record(
+                task.key, task.version,
+                get_command_type(rtype, job.dtype_id), payload,
+            )
         self.client.push(
             task.key, payload, job.dtype_id, task.version,
             cb=lambda: self._proceed(task),

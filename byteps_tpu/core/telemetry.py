@@ -91,6 +91,17 @@ class RobustnessCounters:
     - ``conn_revive``          — a dead server connection was rebuilt
     - ``push_dedup``           — server suppressed a replayed push
     - ``degraded_jobs``        — engine jobs failed with DegradedError
+
+    Recovery plane (docs/robustness.md "healing flow"; labeled per
+    server rank like the rpc_* family):
+
+    - ``resync_attempt``        — in-place heals started after a give-up
+    - ``resync_replayed_rounds``— journaled push rounds replayed because
+      the server's exactly-once ledger never absorbed them
+    - ``resync_giveup``         — heals that failed; the caller fell
+      back to the global re-init path
+    - ``init_replay_ack``       — server acked a replayed INIT from its
+      completed-barrier record (dropped-ack idempotency token)
     - ``worker_evicted`` / ``server_evicted`` — evictions observed from
       the scheduler's membership broadcasts (cumulative)
     - ``chaos_drop`` / ``chaos_delay`` / ``chaos_disconnect`` /

@@ -831,10 +831,12 @@ class NativeServer {
   }
 
   void send_msg(const ConnPtr& conn, uint8_t op, uint32_t seq, uint64_t key,
-                uint32_t version, const uint8_t* payload, uint64_t len) {
+                uint32_t version, const uint8_t* payload, uint64_t len,
+                uint8_t status = 0) {
     Header h{};
     h.magic = kMagic;
     h.op = op;
+    h.status = status;
     h.seq = htonl(seq);
     h.key = htobe64(key);
     h.cmd = 0;
@@ -970,8 +972,25 @@ class NativeServer {
           queues_[thread_for(key, t.payload.size())]->put(std::move(t), prio);
           break;
         }
-        default:
+        default: {
+          // Unknown control op — e.g. the recovery plane's RESYNC_QUERY
+          // (transport.py Op 23), which is Python-engine-only.  The
+          // payload is already consumed, so the stream stays framed;
+          // reject CLEANLY with a nonzero status echoing the op + seq so
+          // the worker's heal path falls back to the re-init barrier
+          // instead of waiting out its deadline, and say so once per
+          // process (same pattern as the trace-context skip above).
+          static std::atomic<bool> warned{false};
+          if (!warned.exchange(true)) {
+            fprintf(stderr,
+                    "byteps-native: rejecting unknown op %d (the recovery "
+                    "plane's RESYNC frames need the Python server "
+                    "engine)\n",
+                    (int)h.op);
+          }
+          send_msg(conn, h.op, seq, key, 0, nullptr, 0, /*status=*/1);
           break;
+        }
       }
     }
   }

@@ -74,6 +74,7 @@ class _KeyState:
         "pending_pulls",
         "fused_waiters",
         "init_waiters",
+        "init_done",
         "push_seen",
         "dtype",
         "compressor_kwargs",
@@ -99,8 +100,21 @@ class _KeyState:
         # (version, _FusedReply, slot, wants_compressed) — filled at round
         # publish; a completed reply rides the same flush list as pulls
         self.fused_waiters: List[Tuple[int, "_FusedReply", int, bool]] = []
-        # (worker_flag, conn, send_lock, seq); worker_flag 0 = anonymous
-        self.init_waiters: List[Tuple[int, socket.socket, threading.Lock, int]] = []
+        # (worker_flag, conn, send_lock, seq, token); worker_flag 0 =
+        # anonymous, token 0 = tokenless (pre-recovery-plane client)
+        self.init_waiters: List[
+            Tuple[int, socket.socket, threading.Lock, int, int]
+        ] = []
+        # init-idempotency ledger (docs/robustness.md): worker_flag → the
+        # token (msg.version on INIT: epoch-scoped per-(key, worker) init
+        # sequence) whose barrier COMPLETED.  A replayed INIT — the
+        # worker's retry after its ack was dropped AFTER the barrier
+        # released — arrives with the SAME token and is acked from this
+        # record instead of re-parked; its peers, already released, would
+        # never re-init the key, so re-parking stranded the retrier until
+        # its budget died.  Elastic rejoin mints a different token (new
+        # epoch / new client salt), so a genuine new barrier still parks.
+        self.init_done: Dict[int, int] = {}
         # replay dedupe (docs/robustness.md): worker_flag → newest summed
         # push version.  Per (key, worker) versions are strictly
         # increasing (the engine's round gate), so a replayed push — the
@@ -476,6 +490,11 @@ class PSServer:
                 msg = recv_message(conn)
                 if msg.op in (Op.PUSH, Op.PULL, Op.INIT, Op.FUSED):
                     self._enqueue(msg, conn, send_lock)
+                elif msg.op == Op.RESYNC_QUERY:
+                    # recovery plane (docs/robustness.md): answered inline —
+                    # a read-mostly snapshot of the exactly-once ledger,
+                    # and the asking worker is stalled on it
+                    self._handle_resync(msg, conn, send_lock)
                 elif msg.op == Op.REGISTER_COMPRESSOR and msg.flags & 1:
                     # lr update for every EF chain (flag bit 0; payload =
                     # big-endian f64) — the wire replacement for the
@@ -600,29 +619,49 @@ class PSServer:
         n, dtype_id = struct.unpack("!QI", msg.payload)
         ks = self._key_state(msg.key)
         wid = msg.flags
+        token = msg.version
         with ks.lock:
             if ks.store is None:
                 dtype = to_numpy_dtype(DataType(dtype_id))
                 ks.dtype = dtype
                 ks.store = np.zeros(n, dtype=dtype)
                 ks.accum = np.zeros(n, dtype=dtype)
-            # keyed by worker identity: a REPLAYED init (retry after a lost
-            # ack / torn connection) replaces this worker's waiter entry —
-            # appending it again would double-count one worker and release
-            # the barrier short.  Anonymous inits (wid 0) keep appending.
-            entry = (wid, conn, send_lock, msg.seq)
-            if wid:
-                for i, w in enumerate(ks.init_waiters):
-                    if w[0] == wid:
-                        ks.init_waiters[i] = entry
-                        break
+            # init-idempotency (docs/robustness.md): a replayed INIT whose
+            # barrier already COMPLETED — the retry of a dropped ack after
+            # the barrier released — is acked from the completed-barrier
+            # record.  Parking it would strand the worker: its peers were
+            # released and will never re-init this key, so the barrier
+            # stays short until the retry budget dies.
+            if wid and token and ks.init_done.get(wid) == token:
+                from byteps_tpu.core.telemetry import counters
+
+                counters().bump("init_replay_ack")
+                replay_ack = True
+            else:
+                replay_ack = False
+                # keyed by worker identity: a REPLAYED init (retry after a
+                # lost ack / torn connection) replaces this worker's waiter
+                # entry — appending it again would double-count one worker
+                # and release the barrier short.  Anonymous inits (wid 0)
+                # keep appending.
+                entry = (wid, conn, send_lock, msg.seq, token)
+                if wid:
+                    for i, w in enumerate(ks.init_waiters):
+                        if w[0] == wid:
+                            ks.init_waiters[i] = entry
+                            break
+                    else:
+                        ks.init_waiters.append(entry)
                 else:
                     ks.init_waiters.append(entry)
-            else:
-                ks.init_waiters.append(entry)
-            waiters = self._complete_init_barrier_locked(ks)
-            if waiters is None:
-                return
+                waiters = self._complete_init_barrier_locked(ks)
+        if replay_ack:
+            send_message(
+                conn, Message(Op.INIT, key=msg.key, seq=msg.seq), send_lock
+            )
+            return
+        if waiters is None:
+            return
         self._release_init_waiters(msg.key, waiters)
 
     def _complete_init_barrier_locked(self, ks: "_KeyState"):
@@ -632,6 +671,14 @@ class PSServer:
         if not (0 < self.num_workers <= len(ks.init_waiters)):
             return None
         waiters, ks.init_waiters = ks.init_waiters, []
+        # record each waiter's init token: a retried INIT landing AFTER
+        # this release is acked from the record instead of re-parked
+        # (dropped-ack idempotency, see _handle_init).  The ledger is
+        # REPLACED, not merged — tokens from an older generation must not
+        # false-ack a new generation's genuine barrier.
+        ks.init_done = {
+            w[0]: w[4] for w in waiters if w[0] and w[4]
+        }
         # A completed init barrier (re-)establishes round numbering:
         # after an elastic resize/resume EVERY worker re-inits and
         # restarts versions at 1 (ReDeclareTensor semantics,
@@ -660,7 +707,7 @@ class PSServer:
 
     @staticmethod
     def _release_init_waiters(key: int, waiters) -> None:
-        for _wid, wconn, wlock, wseq in waiters:
+        for _wid, wconn, wlock, wseq, _token in waiters:
             try:
                 send_message(wconn, Message(Op.INIT, key=key, seq=wseq), wlock)
             except (ConnectionError, OSError):
@@ -1082,6 +1129,60 @@ class PSServer:
                 if ks.store is not None and 0 < n <= ks.recv_count:
                     flush = self._publish_round_locked(ks, ks.compressor is not None)
             self._flush_pulls(key, flush)
+
+    def _handle_resync(self, msg: Message, conn, send_lock) -> None:
+        """Op.RESYNC_QUERY (docs/robustness.md "healing flow"): report the
+        authoritative per-key round/ledger state so a worker that
+        exhausted its retries can compute exactly which journaled pushes
+        this server never absorbed — ``seen`` is the newest version of
+        THAT worker's pushes in the exactly-once ledger, so the worker
+        replays only versions above it and pulls what it missed.  Pure
+        read; the replayed pushes themselves go through the normal PUSH
+        path (ledger dedupe, zombie fence, round publish) unchanged."""
+        import struct as _struct
+
+        from byteps_tpu.comm.transport import (
+            decode_resync_query,
+            encode_resync_state,
+        )
+
+        t0 = time.time()
+        try:
+            wid, keys = decode_resync_query(msg.payload)
+        except (ValueError, UnicodeDecodeError, _struct.error):
+            # malformed recovery frame: drop the connection, same policy
+            # as a malformed data-plane request (the worker's heal path
+            # sees the death and retries or falls back)
+            close_socket(conn)
+            return
+        if not keys:
+            with self._keys_lock:
+                keys = list(self._keys)
+        out = {}
+        for key in keys:
+            with self._keys_lock:
+                ks = self._keys.get(key)
+            if ks is None:
+                continue
+            with ks.lock:
+                if ks.store is None:
+                    continue
+                out[key] = {
+                    "store_version": ks.store_version,
+                    "seen": ks.push_seen.get(wid, 0) if wid else 0,
+                    "recv_count": ks.recv_count,
+                    "init": True,
+                }
+        send_message(
+            conn,
+            Message(Op.RESYNC_STATE, key=msg.key, seq=msg.seq,
+                    payload=encode_resync_state(out)),
+            send_lock,
+        )
+        # the heal's server-side half joins the worker's resync span on
+        # the merged Perfetto timeline (docs/observability.md)
+        self._child_span(msg.trace, msg.key, "resync", t0,
+                         time.time() - t0, keys=len(out))
 
     def _handle_pull(self, msg: Message, conn, send_lock,
                      t_enq: Optional[float] = None) -> None:

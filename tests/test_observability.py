@@ -574,6 +574,18 @@ class TestMetricsCatalog:
         finally:
             sys.path.remove(os.path.join(REPO, "tools"))
 
+    def test_env_catalog_complete(self):
+        """tools/check_env_doc.py: every BYTEPS_* env knob the code
+        reads must be documented in docs/env.md — same rot guard, for
+        the configuration surface."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_env_doc
+
+            assert check_env_doc.main(["--repo", REPO]) == 0
+        finally:
+            sys.path.remove(os.path.join(REPO, "tools"))
+
 
 @pytest.fixture
 def observed_cluster(monkeypatch, tmp_path):

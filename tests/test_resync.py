@@ -1,0 +1,686 @@
+"""Recovery plane (docs/robustness.md "healing flow"): round journal +
+server-driven resync + init-idempotency token.
+
+Layers under test:
+
+- wire codecs for the Op.RESYNC_QUERY / Op.RESYNC_STATE frames;
+- the bounded round journal (depth / byte-cap eviction, generation clear);
+- wire-level bitwise exactness of journal replay (fused AND unfused): a
+  round completed by replaying journaled payloads publishes exactly what
+  the fault-free run would, and a second replay dedupes;
+- the dropped-init-ACK 2-worker strand (ROADMAP): a retried INIT whose
+  barrier already released is acked from the completed-barrier record;
+- end-to-end in-place heal: a deterministic chaos schedule
+  (BYTEPS_CHAOS_OPS + BYTEPS_CHAOS_FAULT_BUDGET) kills exactly one
+  push's retry budget — the step heals via resync instead of failing;
+- the api-layer fallback (engine.heal_degraded) when the client-level
+  heal is unavailable;
+- native-engine interop: the C++ server rejects RESYNC frames with a
+  nonzero status and the stream stays framed;
+- the acceptance demo: 2 worker subprocesses + 1 server, the victim's
+  retry budget killed on cue — it heals in place, its peer never
+  blocks, and every pulled tensor is bitwise the fault-free one.
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.types import DataType, RequestType, get_command_type
+from byteps_tpu.comm.journal import RoundJournal
+from byteps_tpu.comm.transport import (
+    Message,
+    Op,
+    close_socket,
+    connect,
+    decode_resync_query,
+    decode_resync_state,
+    encode_fused_push,
+    encode_resync_query,
+    encode_resync_state,
+    recv_message,
+    send_message,
+)
+from byteps_tpu.core.telemetry import counters
+from byteps_tpu.server.server import PSServer
+
+CMD_F32 = get_command_type(RequestType.DEFAULT_PUSH_PULL, int(DataType.FLOAT32))
+
+
+class TestResyncWire:
+    def test_query_roundtrip(self):
+        wid, keys = decode_resync_query(encode_resync_query(3, [7, 9, 1 << 40]))
+        assert wid == 3
+        assert keys == [7, 9, 1 << 40]
+
+    def test_query_empty_keys_means_all(self):
+        wid, keys = decode_resync_query(encode_resync_query(1, []))
+        assert wid == 1 and keys == []
+
+    def test_state_roundtrip(self):
+        states = {
+            5: {"store_version": 4, "seen": 3, "recv_count": 1, "init": True},
+            (1 << 33): {"store_version": 0, "seen": 0, "recv_count": 0,
+                        "init": True},
+        }
+        out = decode_resync_state(encode_resync_state(states))
+        assert out == states  # int keys restored through the JSON hop
+
+    def test_malformed_bodies_raise(self):
+        with pytest.raises(ValueError):
+            decode_resync_query(b"[1, 2, 3]")
+        with pytest.raises((ValueError, AttributeError)):
+            decode_resync_state(b'{"keys": [1]}')
+
+
+class TestRoundJournal:
+    def test_depth_bound_per_key(self):
+        j = RoundJournal(max_rounds=2, max_bytes=1 << 20)
+        for v in (1, 2, 3):
+            j.record(key=9, version=v, cmd=CMD_F32, payload=bytes([v]) * 8)
+        entries = j.entries_after(9, 0)
+        assert [e.version for e in entries] == [2, 3]  # round 1 evicted
+        assert j.evicted == 1
+
+    def test_byte_cap_evicts_globally_oldest(self):
+        j = RoundJournal(max_rounds=8, max_bytes=100)
+        j.record(1, 1, CMD_F32, b"a" * 60)
+        j.record(2, 1, CMD_F32, b"b" * 60)  # key 1's round must go
+        assert j.entries_after(1, 0) == []
+        assert [e.version for e in j.entries_after(2, 0)] == [1]
+        assert j.stats()["bytes"] == 60
+
+    def test_replace_same_round_keeps_one_entry(self):
+        j = RoundJournal(max_rounds=4, max_bytes=1 << 20)
+        j.record(3, 1, CMD_F32, b"old-bytes")
+        j.record(3, 1, CMD_F32, b"new", fused=True)  # unfuse fallback re-emit
+        entries = j.entries_after(3, 0)
+        assert len(entries) == 1 and entries[0].payload == b"new"
+        assert j.stats()["bytes"] == 3
+
+    def test_watermark_filters_absorbed_rounds(self):
+        j = RoundJournal(max_rounds=4, max_bytes=1 << 20)
+        for v in (1, 2, 3):
+            j.record(5, v, CMD_F32, b"x")
+        assert [e.version for e in j.entries_after(5, 2)] == [3]
+        assert j.entries_after(5, 3) == []
+
+    def test_clear_key_drops_generation(self):
+        j = RoundJournal(max_rounds=4, max_bytes=1 << 20)
+        j.record(5, 1, CMD_F32, b"x" * 10)
+        j.record(6, 1, CMD_F32, b"y" * 10)
+        j.clear_key(5)
+        assert j.entries_after(5, 0) == []
+        assert j.keys() == [6]
+        assert j.stats()["bytes"] == 10
+
+
+def _wire_server(num_workers: int) -> PSServer:
+    srv = PSServer(Config(num_worker=num_workers, num_server=1))
+    srv.start(register=False)
+    return srv
+
+
+def _init_key(socks_flags, key: int, n: int, tokens=None):
+    """Run the init barrier for ``key`` across fake workers given as
+    [(sock, worker_flag), ...]; returns after every ack."""
+    payload = struct.pack("!QI", n, int(DataType.FLOAT32))
+    for i, (sock, flag) in enumerate(socks_flags):
+        token = tokens[i] if tokens else 0
+        send_message(sock, Message(Op.INIT, key=key, seq=100 + i, flags=flag,
+                                   version=token, payload=payload))
+    for sock, _ in socks_flags:
+        msg = recv_message(sock)
+        assert msg.op == Op.INIT
+
+
+class TestReplayBitwise:
+    """Wire-level journal replay: completing a round from the journal
+    publishes bitwise what the fault-free run would have."""
+
+    def test_unfused_replay_completes_round_bitwise(self):
+        srv = _wire_server(num_workers=2)
+        KEY, N = 11, 64
+        g1 = np.arange(N, dtype=np.float32)
+        g2 = np.full(N, 0.5, dtype=np.float32)
+        try:
+            w1 = connect(srv.host, srv.port)
+            w2 = connect(srv.host, srv.port)
+            for s in (w1, w2):
+                s.settimeout(15)
+            _init_key([(w1, 1), (w2, 2)], KEY, N)
+            # worker 2 journals its round-1 push but the frame is "lost"
+            # (never sent).  Worker 1 pushes normally and pulls — parked.
+            journal = RoundJournal(max_rounds=2, max_bytes=1 << 20)
+            journal.record(KEY, 1, CMD_F32, g2.tobytes())
+            send_message(w1, Message(Op.PUSH, key=KEY, seq=1, flags=1,
+                                     cmd=CMD_F32, version=1,
+                                     payload=g1.tobytes()))
+            assert recv_message(w1).op == Op.PUSH
+            send_message(w1, Message(Op.PULL, key=KEY, seq=2, cmd=CMD_F32,
+                                     version=1))
+            # worker 2 heals: query → server reports seen=0 → replay
+            send_message(w2, Message(Op.RESYNC_QUERY, key=KEY, seq=3, flags=2,
+                                     payload=encode_resync_query(2, [KEY])))
+            resp = recv_message(w2)
+            assert resp.op == Op.RESYNC_STATE and resp.status == 0
+            state = decode_resync_state(resp.payload)
+            assert state[KEY]["seen"] == 0       # our push never absorbed
+            assert state[KEY]["store_version"] == 0  # round incomplete
+            entries = journal.entries_after(KEY, state[KEY]["seen"])
+            assert [e.version for e in entries] == [1]
+            for e in entries:
+                send_message(w2, Message(Op.PUSH, key=KEY, seq=4, flags=2,
+                                         cmd=e.cmd, version=e.version,
+                                         payload=e.payload))
+                assert recv_message(w2).op == Op.PUSH
+            # the round published: worker 1's parked pull answers with
+            # EXACTLY the fault-free sum, and worker 2 can pull it too
+            reply = recv_message(w1)
+            assert reply.op == Op.PULL
+            np.testing.assert_array_equal(
+                np.frombuffer(reply.payload, dtype=np.float32), g1 + g2
+            )
+            # replaying AGAIN dedupes (exactly-once): the sum must not move
+            send_message(w2, Message(Op.PUSH, key=KEY, seq=5, flags=2,
+                                     cmd=CMD_F32, version=1,
+                                     payload=g2.tobytes()))
+            assert recv_message(w2).op == Op.PUSH
+            send_message(w2, Message(Op.PULL, key=KEY, seq=6, cmd=CMD_F32,
+                                     version=1))
+            reply = recv_message(w2)
+            np.testing.assert_array_equal(
+                np.frombuffer(reply.payload, dtype=np.float32), g1 + g2
+            )
+            close_socket(w1)
+            close_socket(w2)
+        finally:
+            srv.stop()
+
+    def test_fused_members_replay_unfused_bitwise(self):
+        """A lost FUSED frame heals by replaying its journaled members as
+        plain per-key pushes — the server sums both paths identically."""
+        srv = _wire_server(num_workers=2)
+        KEY_A, KEY_B, N = 21, 22, 32
+        a1 = np.arange(N, dtype=np.float32)
+        b1 = np.full(N, 2.0, dtype=np.float32)
+        a2 = np.full(N, -1.5, dtype=np.float32)
+        b2 = np.arange(N, dtype=np.float32) * 3
+        try:
+            w1 = connect(srv.host, srv.port)
+            w2 = connect(srv.host, srv.port)
+            for s in (w1, w2):
+                s.settimeout(15)
+            for key in (KEY_A, KEY_B):
+                _init_key([(w1, 1), (w2, 2)], key, N)
+            # worker 2's fused pack (A2+B2) is "lost"; only its journal
+            # survives — members recorded individually, fused=True
+            journal = RoundJournal(max_rounds=2, max_bytes=1 << 20)
+            journal.record(KEY_A, 1, CMD_F32, a2.tobytes(), fused=True)
+            journal.record(KEY_B, 1, CMD_F32, b2.tobytes(), fused=True)
+            # worker 1 ships ITS round as a fused frame that arrives fine
+            frame = encode_fused_push([
+                (KEY_A, CMD_F32, 1, a1.tobytes()),
+                (KEY_B, CMD_F32, 1, b1.tobytes()),
+            ])
+            send_message(w1, Message(Op.FUSED, key=KEY_A, seq=1, flags=1,
+                                     cmd=2, payload=frame))
+            # worker 2 heals: one query covers both keys on this server
+            send_message(w2, Message(
+                Op.RESYNC_QUERY, key=KEY_A, seq=2, flags=2,
+                payload=encode_resync_query(2, [KEY_A, KEY_B]),
+            ))
+            resp = recv_message(w2)
+            assert resp.op == Op.RESYNC_STATE
+            state = decode_resync_state(resp.payload)
+            seq = 10
+            for key in (KEY_A, KEY_B):
+                assert state[key]["seen"] == 0
+                for e in journal.entries_after(key, 0):
+                    assert e.fused
+                    send_message(w2, Message(Op.PUSH, key=key, seq=seq,
+                                             flags=2, cmd=e.cmd,
+                                             version=e.version,
+                                             payload=e.payload))
+                    assert recv_message(w2).op == Op.PUSH
+                    seq += 1
+            # both rounds published → worker 1's ONE fused reply carries
+            # bitwise the fault-free sums
+            from byteps_tpu.comm.transport import decode_fused_reply
+
+            msg = recv_message(w1)
+            assert msg.op == Op.FUSED
+            sums = {KEY_A: a1 + a2, KEY_B: b1 + b2}
+            for key, _ver, payload in decode_fused_reply(msg.payload):
+                np.testing.assert_array_equal(
+                    np.frombuffer(payload, dtype=np.float32), sums[key]
+                )
+            close_socket(w1)
+            close_socket(w2)
+        finally:
+            srv.stop()
+
+
+class TestInitReplayAck:
+    """The dropped-init-ACK 2-worker strand (ROADMAP): a retried INIT
+    whose barrier already released must be acked from the
+    completed-barrier record, not re-parked."""
+
+    def test_post_release_replay_acks_immediately(self):
+        srv = _wire_server(num_workers=2)
+        KEY, N = 31, 16
+        TOK1, TOK2 = 0xA0001, 0xB0001
+        base = counters().get("init_replay_ack")
+        try:
+            w1 = connect(srv.host, srv.port)
+            w2 = connect(srv.host, srv.port)
+            for s in (w1, w2):
+                s.settimeout(15)
+            _init_key([(w1, 1), (w2, 2)], KEY, N, tokens=[TOK1, TOK2])
+            # worker 1 "lost" its ack: it retries the SAME init (same
+            # token).  Pre-fix this re-parked as a waiter and — with
+            # worker 2 long released — waited forever.
+            send_message(w1, Message(
+                Op.INIT, key=KEY, seq=7, flags=1, version=TOK1,
+                payload=struct.pack("!QI", N, int(DataType.FLOAT32)),
+            ))
+            ack = recv_message(w1)  # would raise timeout if parked
+            assert ack.op == Op.INIT and ack.seq == 7
+            assert counters().get("init_replay_ack") == base + 1
+            # the replay-ack must NOT have reset round state: a normal
+            # round still completes across both workers, bitwise
+            g1 = np.arange(N, dtype=np.float32)
+            g2 = np.full(N, 4.0, dtype=np.float32)
+            send_message(w1, Message(Op.PUSH, key=KEY, seq=8, flags=1,
+                                     cmd=CMD_F32, version=1,
+                                     payload=g1.tobytes()))
+            send_message(w2, Message(Op.PUSH, key=KEY, seq=9, flags=2,
+                                     cmd=CMD_F32, version=1,
+                                     payload=g2.tobytes()))
+            assert recv_message(w1).op == Op.PUSH
+            assert recv_message(w2).op == Op.PUSH
+            send_message(w1, Message(Op.PULL, key=KEY, seq=10, cmd=CMD_F32,
+                                     version=1))
+            reply = recv_message(w1)
+            np.testing.assert_array_equal(
+                np.frombuffer(reply.payload, dtype=np.float32), g1 + g2
+            )
+            close_socket(w1)
+            close_socket(w2)
+        finally:
+            srv.stop()
+
+    def test_fresh_token_still_parks(self):
+        """A DIFFERENT token (new epoch / restarted client) is a genuine
+        new barrier: it must park, not false-ack from the old record."""
+        srv = _wire_server(num_workers=2)
+        KEY, N = 41, 8
+        try:
+            w1 = connect(srv.host, srv.port)
+            w2 = connect(srv.host, srv.port)
+            for s in (w1, w2):
+                s.settimeout(15)
+            _init_key([(w1, 1), (w2, 2)], KEY, N, tokens=[0xC0001, 0xD0001])
+            # worker 1 re-inits with a FRESH token (elastic rejoin shape)
+            send_message(w1, Message(
+                Op.INIT, key=KEY, seq=20, flags=1, version=0xC0002,
+                payload=struct.pack("!QI", N, int(DataType.FLOAT32)),
+            ))
+            w1.settimeout(1.0)
+            with pytest.raises((TimeoutError, socket.timeout, OSError)):
+                recv_message(w1)  # parked: barrier waits for worker 2
+            # worker 2's matching re-init releases the new barrier
+            w1.settimeout(15)
+            send_message(w2, Message(
+                Op.INIT, key=KEY, seq=21, flags=2, version=0xD0002,
+                payload=struct.pack("!QI", N, int(DataType.FLOAT32)),
+            ))
+            assert recv_message(w1).op == Op.INIT
+            assert recv_message(w2).op == Op.INIT
+            close_socket(w1)
+            close_socket(w2)
+        finally:
+            srv.stop()
+
+
+def _reset_chaos_budget():
+    from byteps_tpu.comm.chaos import reset_fault_budget
+
+    reset_fault_budget()
+
+
+class TestHealInPlace:
+    """End-to-end: a deterministic one-sided schedule (every PUSH frame
+    dropped until the fault budget spends) exhausts the retry budget —
+    and the step completes anyway, healed via resync + journal replay,
+    with no DegradedError and no re-init barrier."""
+
+    def _cluster_env(self, monkeypatch, sched_port):
+        for k, v in {
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched_port),
+            "DMLC_NUM_WORKER": "1",
+            "DMLC_NUM_SERVER": "1",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.2",
+            "BYTEPS_RPC_DEADLINE_S": "0.3",
+            "BYTEPS_RPC_RETRIES": "2",
+            "BYTEPS_RPC_BACKOFF_S": "0.05",
+            "BYTEPS_INIT_DEADLINE_S": "1.0",
+            "BYTEPS_CONNECT_RETRY_S": "0.2",
+        }.items():
+            monkeypatch.setenv(k, v)
+
+    def test_one_sided_giveup_heals_in_place(self, monkeypatch):
+        from byteps_tpu.comm.rendezvous import Scheduler
+
+        monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
+        monkeypatch.setenv("BYTEPS_CHAOS_SEED", "5")
+        monkeypatch.setenv("BYTEPS_CHAOS_DROP", "1.0")
+        monkeypatch.setenv("BYTEPS_CHAOS_OPS", str(int(Op.PUSH)))
+        # budget = first attempt + BYTEPS_RPC_RETRIES retries: exactly
+        # the one push's budget dies, then the wire is clean — so the
+        # heal (query op 23, replay push post-budget) must succeed
+        monkeypatch.setenv("BYTEPS_CHAOS_FAULT_BUDGET", "3")
+        counters().reset()
+        _reset_chaos_budget()
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        self._cluster_env(monkeypatch, sched.port)
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            rng = np.random.default_rng(0)
+            for step in range(3):
+                x = rng.standard_normal(129).astype(np.float32)
+                out = bps.push_pull(x, name="resync.heal", average=False)
+                # 1 worker ⇒ identity; a double-summed replay returns 2x
+                np.testing.assert_array_equal(np.asarray(out), x)
+            snap = bps.get_robustness_counters()
+            assert snap.get("chaos_drop", 0) == 3, snap
+            assert snap.get("resync_attempt", 0) == 1, snap
+            # the dropped push was never absorbed: exactly one journaled
+            # round replayed, and the re-issued original push deduped
+            assert snap.get("resync_replayed_rounds", 0) == 1, snap
+            assert snap.get("push_dedup", 0) >= 1, snap
+            assert snap.get("resync_giveup", 0) == 0, snap
+            # the whole point: the step never failed, nothing re-inited
+            assert snap.get("rpc_giveup", 0) == 0, snap
+            assert snap.get("degraded_jobs", 0) == 0, snap
+        finally:
+            bps.shutdown()
+            srv.stop()
+            sched.stop()
+            _reset_chaos_budget()
+
+    def test_api_fallback_heals_when_client_heal_fails(self, monkeypatch):
+        """Layer 2: with the client-level heal knocked out, DegradedError
+        surfaces and push_pull's degraded-retry wrapper routes through
+        engine.heal_degraded — resync + replay + explicit pull — instead
+        of the re-init resubmit."""
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.comm.rendezvous import Scheduler
+
+        monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
+        monkeypatch.setenv("BYTEPS_CHAOS_SEED", "5")
+        monkeypatch.setenv("BYTEPS_CHAOS_DROP", "1.0")
+        monkeypatch.setenv("BYTEPS_CHAOS_OPS", str(int(Op.PUSH)))
+        monkeypatch.setenv("BYTEPS_CHAOS_FAULT_BUDGET", "3")
+        monkeypatch.setenv("BYTEPS_DEGRADED_STEP_RETRIES", "2")
+        counters().reset()
+        _reset_chaos_budget()
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        self._cluster_env(monkeypatch, sched.port)
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        # first _heal_in_place call (the client-level heal) fails without
+        # touching the wire; later calls (engine.heal_degraded's
+        # resync_in_place) run for real
+        real_heal = PSClient._heal_in_place
+        calls = {"n": 0}
+
+        def flaky_heal(self, key, sid):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return False
+            return real_heal(self, key, sid)
+
+        monkeypatch.setattr(PSClient, "_heal_in_place", flaky_heal)
+
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            x = np.arange(200, dtype=np.float32)
+            out = bps.push_pull(x, name="resync.fallback", average=False)
+            np.testing.assert_array_equal(np.asarray(out), x)
+            snap = bps.get_robustness_counters()
+            assert calls["n"] >= 2, calls  # both layers exercised
+            assert snap.get("rpc_giveup", 0) == 1, snap   # layer 1 failed
+            assert snap.get("degraded_jobs", 0) == 1, snap
+            assert snap.get("resync_replayed_rounds", 0) == 1, snap
+            # in-place: the next submit continues the version sequence
+            # (no forced re-init pending)
+            from byteps_tpu.core.state import get_state
+
+            assert "resync.fallback" not in get_state().engine._reinit_names
+            out2 = bps.push_pull(x + 1, name="resync.fallback", average=False)
+            np.testing.assert_array_equal(np.asarray(out2), x + 1)
+        finally:
+            bps.shutdown()
+            srv.stop()
+            sched.stop()
+            _reset_chaos_budget()
+
+    def test_resync_frames_are_chaos_injectable(self, monkeypatch):
+        """BYTEPS_CHAOS_OPS can name the RESYNC ops themselves: the first
+        query frame is dropped, and the heal's in-budget re-dial loop
+        still lands it — the recovery plane survives its own faults."""
+        from byteps_tpu.comm.rendezvous import Scheduler
+
+        monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
+        monkeypatch.setenv("BYTEPS_CHAOS_SEED", "5")
+        monkeypatch.setenv("BYTEPS_CHAOS_DROP", "1.0")
+        monkeypatch.setenv(
+            "BYTEPS_CHAOS_OPS",
+            f"{int(Op.PUSH)},{int(Op.RESYNC_QUERY)}",
+        )
+        # 3 pushes + the heal's FIRST resync query die; its retry passes
+        monkeypatch.setenv("BYTEPS_CHAOS_FAULT_BUDGET", "4")
+        counters().reset()
+        _reset_chaos_budget()
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        self._cluster_env(monkeypatch, sched.port)
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            x = np.full(64, 2.5, dtype=np.float32)
+            out = bps.push_pull(x, name="resync.chaos", average=False)
+            np.testing.assert_array_equal(np.asarray(out), x)
+            snap = bps.get_robustness_counters()
+            assert snap.get("chaos_drop", 0) == 4, snap
+            assert snap.get("resync_attempt", 0) == 1, snap
+            assert snap.get("resync_giveup", 0) == 0, snap
+            assert snap.get("degraded_jobs", 0) == 0, snap
+        finally:
+            bps.shutdown()
+            srv.stop()
+            sched.stop()
+            _reset_chaos_budget()
+
+
+def _have_native() -> bool:
+    from byteps_tpu.native import get_lib
+
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "bps_native_server_start_unix")
+
+
+@pytest.mark.skipif(not _have_native(), reason="native lib not built")
+class TestNativeResyncInterop:
+    """Old-decoder interop: the C++ engine must reject RESYNC frames
+    CLEANLY — nonzero status echoing op+seq (log-once), stream stays
+    framed — so a healing worker falls back instead of hanging."""
+
+    def test_native_server_rejects_resync_and_stays_framed(self, monkeypatch):
+        from byteps_tpu.server.server import NativePSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "uds")
+        srv = NativePSServer(Config(num_worker=1, num_server=1))
+        try:
+            sock = connect(srv.host, srv.port)
+            send_message(sock, Message(
+                Op.RESYNC_QUERY, key=3, seq=1, flags=1,
+                payload=encode_resync_query(1, [3]),
+            ))
+            resp = recv_message(sock)
+            assert resp.op == Op.RESYNC_QUERY and resp.seq == 1
+            assert resp.status != 0  # rejected, not swallowed
+            # the stream never desynced: a normal round still works
+            x = np.arange(8, dtype=np.float32)
+            send_message(sock, Message(
+                Op.INIT, key=3, seq=2, flags=1,
+                payload=struct.pack("!QI", 8, int(DataType.FLOAT32)),
+            ))
+            assert recv_message(sock).op == Op.INIT
+            send_message(sock, Message(Op.PUSH, key=3, seq=3, flags=1,
+                                       cmd=CMD_F32, version=1,
+                                       payload=x.tobytes()))
+            assert recv_message(sock).op == Op.PUSH
+            send_message(sock, Message(Op.PULL, key=3, seq=4, cmd=CMD_F32,
+                                       version=1))
+            reply = recv_message(sock)
+            np.testing.assert_array_equal(
+                np.frombuffer(reply.payload, dtype=np.float32), x
+            )
+            close_socket(sock)
+        finally:
+            srv.stop()
+
+
+_DEMO_WORKER = r"""
+import json, os, sys
+import numpy as np
+import byteps_tpu as bps
+
+bps.init()
+rank = bps.rank()
+N = 64
+for step in range(3):
+    g = (np.arange(N, dtype=np.float32) + step) * (rank + 1)
+    out = np.asarray(bps.push_pull(g, name="demo.g", average=False))
+    base = np.arange(N, dtype=np.float32) + step
+    np.testing.assert_array_equal(out, base * 1 + base * 2)
+print("COUNTERS=" + json.dumps(bps.get_robustness_counters()))
+print("DEMO_OK rank=%d" % rank)
+"""
+
+
+class TestTwoWorkerDemo:
+    """The acceptance demo (mirrors docs/robustness.md): 2 workers + 1
+    server under a seeded schedule that kills ONE worker's push retry
+    budget mid-run.  The victim heals in place via resync; its peer
+    never blocks or re-inits; every pulled tensor on BOTH workers is
+    bitwise identical to the fault-free run."""
+
+    def test_victim_heals_in_place_peer_never_blocks(self, monkeypatch):
+        from byteps_tpu.comm.rendezvous import Scheduler
+
+        # parent (scheduler + server): chaos van selected but ZERO fault
+        # probabilities — response lanes stay clean; each worker
+        # subprocess brings its own fault env
+        monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
+        monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.2")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        base_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "1",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.5",
+        }
+        victim_env = {
+            **base_env,
+            "DMLC_WORKER_ID": "0",
+            "BYTEPS_NODE_UID": "demo-victim",
+            # deterministic one-sided kill: exactly the first 3 PUSH
+            # frames (attempt + 2 retries) die, then the wire is clean
+            "BYTEPS_CHAOS_SEED": "9",
+            "BYTEPS_CHAOS_DROP": "1.0",
+            "BYTEPS_CHAOS_OPS": str(int(Op.PUSH)),
+            "BYTEPS_CHAOS_FAULT_BUDGET": "3",
+            "BYTEPS_RPC_DEADLINE_S": "0.3",
+            "BYTEPS_RPC_RETRIES": "2",
+            "BYTEPS_RPC_BACKOFF_S": "0.05",
+        }
+        peer_env = {
+            **base_env,
+            "DMLC_WORKER_ID": "1",
+            "BYTEPS_NODE_UID": "demo-peer",
+        }
+        try:
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, "-c", _DEMO_WORKER],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True,
+                )
+                for env in (victim_env, peer_env)
+            ]
+            outs = []
+            deadline = time.monotonic() + 120
+            for p in procs:
+                try:
+                    out, _ = p.communicate(
+                        timeout=max(5.0, deadline - time.monotonic())
+                    )
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    out, _ = p.communicate()
+                    pytest.fail(f"demo worker hung:\n{out}")
+                outs.append(out)
+            for p, out in zip(procs, outs):
+                assert p.returncode == 0, f"worker failed:\n{out}"
+                assert "DEMO_OK" in out, out
+            victim_out = outs[0]
+            snap = json.loads(
+                victim_out.split("COUNTERS=", 1)[1].splitlines()[0]
+            )
+            # the victim really exhausted its budget and healed in place
+            assert snap.get("chaos_drop", 0) == 3, snap
+            assert snap.get("resync_attempt", 0) >= 1, snap
+            assert snap.get("resync_giveup", 0) == 0, snap
+            assert snap.get("degraded_jobs", 0) == 0, snap
+        finally:
+            srv.stop()
+            sched.stop()

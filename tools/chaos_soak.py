@@ -53,23 +53,42 @@ def run_soak(
     corrupt: float = 0.0,
     crash_at: int = -1,
     dim: int = 1024,
+    one_sided: bool = False,
 ) -> dict:
     """Run the soak in-process; returns a result dict (raises on any
     invariant violation).  Env mutations are process-wide — run via the
-    CLI (fresh process) unless the caller owns the environment."""
+    CLI (fresh process) unless the caller owns the environment.
+
+    ``one_sided``: instead of spraying faults everywhere, target seeded
+    drops at the single connection between the worker and the server
+    that owns the soak tensor's key (BYTEPS_CHAOS_TARGET_PORT, plus
+    BYTEPS_CHAOS_OPS set to the PUSH/PULL op codes), with a retry
+    budget small enough to
+    exhaust — so the run exercises the in-place heal end-to-end: give-up
+    → Op.RESYNC_QUERY → journal replay → rejoin, no re-init barrier
+    (docs/robustness.md "healing flow").  Asserts the heal actually
+    fired (``resync_attempt`` > 0)."""
+    if one_sided and servers < 2:
+        raise ValueError("--one-sided needs --servers >= 2 (one victim, "
+                         "one healthy control)")
     os.environ.update(
         {
             "BYTEPS_VAN": "chaos:tcp",
             "BYTEPS_CHAOS_SEED": str(seed),
-            "BYTEPS_CHAOS_DROP": str(drop),
-            "BYTEPS_CHAOS_DELAY": str(delay),
+            # one-sided mode arms the fault env only AFTER the fleet is
+            # up, so server-side response lanes snapshot zero params and
+            # the faults stay on the one worker→victim request lane
+            "BYTEPS_CHAOS_DROP": "0" if one_sided else str(drop),
+            "BYTEPS_CHAOS_DELAY": "0" if one_sided else str(delay),
             "BYTEPS_CHAOS_DELAY_MS": "10",
-            "BYTEPS_CHAOS_DISCONNECT": str(disconnect),
-            "BYTEPS_CHAOS_TRUNCATE": str(truncate),
-            "BYTEPS_CHAOS_CORRUPT": str(corrupt),
+            "BYTEPS_CHAOS_DISCONNECT": "0" if one_sided else str(disconnect),
+            "BYTEPS_CHAOS_TRUNCATE": "0" if one_sided else str(truncate),
+            "BYTEPS_CHAOS_CORRUPT": "0" if one_sided else str(corrupt),
             "BYTEPS_RPC_DEADLINE_S": "0.3",
             "BYTEPS_INIT_DEADLINE_S": "0.5",
-            "BYTEPS_RPC_RETRIES": "6",
+            # a small budget in one-sided mode so give-ups (and thus the
+            # heal path) actually happen instead of retries absorbing all
+            "BYTEPS_RPC_RETRIES": "2" if one_sided else "6",
             "BYTEPS_RPC_BACKOFF_S": "0.05",
             "BYTEPS_CONNECT_RETRY_S": "0.2",
             "BYTEPS_DEGRADED_STEP_RETRIES": "8",
@@ -94,6 +113,40 @@ def run_soak(
     fleet = [PSServer(Config.from_env()) for _ in range(servers)]
     for srv in fleet:
         threading.Thread(target=srv.start, daemon=True).start()
+
+    if one_sided:
+        import time as _time
+
+        from byteps_tpu.common.hashing import assign_server
+        from byteps_tpu.comm.chaos import reset_fault_budget
+        from byteps_tpu.comm.transport import Op
+
+        # aim at the server that OWNS the soak tensor's key (declared
+        # first ⇒ key 0) — faults on the other server's port would never
+        # touch the data path.  Ranks are assigned as REGISTERs arrive,
+        # but the address book (which sets fleet[i].rank) only ships once
+        # the WORKER also registers — so read the scheduler's live
+        # registration table directly.
+        deadline = _time.monotonic() + 10
+        while True:
+            with sched._lock:
+                nodes = list(sched._nodes["server"])
+            if len(nodes) >= servers:
+                break
+            if _time.monotonic() > deadline:
+                raise RuntimeError("servers never registered")
+            _time.sleep(0.05)
+        cfg0 = Config.from_env()
+        owner_rank = assign_server(
+            0, servers, fn=cfg0.key_hash_fn, coef=cfg0.built_in_hash_coef,
+            mixed_mode=cfg0.enable_mixed_mode,
+            mixed_bound=cfg0.mixed_mode_bound, num_workers=1,
+        )
+        victim_port = next(n.port for n in nodes if n.rank == owner_rank)
+        os.environ["BYTEPS_CHAOS_TARGET_PORT"] = str(victim_port)
+        os.environ["BYTEPS_CHAOS_OPS"] = f"{int(Op.PUSH)},{int(Op.PULL)}"
+        os.environ["BYTEPS_CHAOS_DROP"] = str(max(drop, 0.4))
+        reset_fault_budget()  # re-read BYTEPS_CHAOS_FAULT_BUDGET lazily
 
     import byteps_tpu as bps
 
@@ -123,10 +176,16 @@ def run_soak(
         sched.stop()
 
     assert loss1 < loss0, f"loss did not decrease: {loss0} -> {loss1}"
-    chaos_on = any((drop, delay, disconnect, truncate, corrupt))
+    chaos_on = one_sided or any((drop, delay, disconnect, truncate, corrupt))
     injected = sum(v for k, v in snap.items() if k.startswith("chaos_"))
     if chaos_on:
         assert injected > 0, f"no faults injected: {snap}"
+    if one_sided:
+        # the targeted drops must have exhausted at least one retry
+        # budget and routed through the in-place heal (no re-init)
+        assert snap.get("resync_attempt", 0) >= 1, (
+            f"one-sided schedule never reached the heal path: {snap}"
+        )
     if crash_at >= 0 and servers > 1:
         assert snap.get("server_evicted", 0) >= 1, f"no eviction seen: {snap}"
     return {
@@ -149,6 +208,10 @@ def main() -> int:
     ap.add_argument("--corrupt", type=float, default=0.005)
     ap.add_argument("--crash-at", type=int, default=-1,
                     help="step at which to hard-kill the last server")
+    ap.add_argument("--one-sided", action="store_true",
+                    help="target seeded drops at the single worker→owner-"
+                         "server connection so the in-place heal (resync "
+                         "+ journal replay) is exercised end-to-end")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="watchdog: the soak must finish within this")
     args = ap.parse_args()
@@ -164,6 +227,7 @@ def main() -> int:
                     drop=args.drop, delay=args.delay,
                     disconnect=args.disconnect, truncate=args.truncate,
                     corrupt=args.corrupt, crash_at=args.crash_at,
+                    one_sided=args.one_sided,
                 )
             )
         except BaseException as e:  # noqa: BLE001

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""CI guard: every ``BYTEPS_*`` knob the code reads must be in docs/env.md.
+
+The configuration surface is pure env vars (docs/env.md), anchored in
+``common/config.py`` but with readers spread across the package (vans,
+chaos, native autobuild, launcher NUMA planning).  Knobs rot the same way
+metric names do (tools/check_metrics_doc.py): a feature lands with its
+``os.environ.get("BYTEPS_...")`` and the table is forgotten.  This guard
+scans every env READ —
+
+    os.environ.get("BYTEPS_X") / os.environ["BYTEPS_X"] / os.getenv(...)
+    _env_int/_env_bool/_env_str/_env_float("BYTEPS_X", ...)
+
+— across ``byteps_tpu/`` (and ``tools/``, which document their knobs in
+the same catalog) and fails (exit 1) listing any name absent from
+docs/env.md, where a name counts as documented when it appears inside
+backticks.  Wired into tier-1 as
+``tests/test_observability.py::test_env_catalog_complete``.
+
+Usage: ``python tools/check_env_doc.py [--repo ROOT]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+#: an env READ whose first argument is a BYTEPS_* string literal
+_READ_RE = re.compile(
+    r"(?:environ\.get\(|environ\[|getenv\(|"
+    r"_env_int\(|_env_bool\(|_env_str\(|_env_float\()\s*"
+    r"[\"'](BYTEPS_[A-Z0-9_]+)[\"']"
+)
+
+def discover_read(repo: str) -> dict:
+    """{name: [file:line, ...]} for every BYTEPS_* env read in the
+    package (and tools/)."""
+    found: dict = {}
+    for sub in ("byteps_tpu", "tools"):
+        base = os.path.join(repo, sub)
+        for root, _dirs, files in os.walk(base):
+            if "__pycache__" in root:
+                continue
+            for fn in files:
+                # the guard's own docstring quotes the read patterns —
+                # scanning itself would demand a fake BYTEPS_X entry
+                if not fn.endswith(".py") or fn == "check_env_doc.py":
+                    continue
+                path = os.path.join(root, fn)
+                with open(path) as f:
+                    text = f.read()
+                for m in _READ_RE.finditer(text):
+                    name = m.group(1)
+                    line = text[: m.start()].count("\n") + 1
+                    rel = os.path.relpath(path, repo)
+                    found.setdefault(name, []).append(f"{rel}:{line}")
+    return found
+
+
+def documented_names(repo: str) -> set:
+    doc = os.path.join(repo, "docs", "env.md")
+    if not os.path.exists(doc):
+        return set()
+    with open(doc) as f:
+        text = f.read()
+    names = set()
+    for chunk in re.findall(r"`([^`]+)`", text):
+        names.update(re.findall(r"BYTEPS_[A-Z0-9_]+", chunk))
+    return names
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--repo",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    args = ap.parse_args(argv)
+    read = discover_read(args.repo)
+    docs = documented_names(args.repo)
+    if not docs:
+        print("docs/env.md missing or has no documented BYTEPS_* names",
+              file=sys.stderr)
+        return 1
+    missing = [(n, sites) for n, sites in sorted(read.items()) if n not in docs]
+    if missing:
+        print("env knobs read by the code but absent from docs/env.md:",
+              file=sys.stderr)
+        for name, sites in missing:
+            print(f"  {name}  ({'; '.join(sites[:3])})", file=sys.stderr)
+        return 1
+    print(f"env catalog OK: {len(read)} knob(s) read, "
+          f"{len(docs)} documented")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
