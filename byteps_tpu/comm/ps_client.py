@@ -1281,8 +1281,9 @@ class PSClient:
         if resp is None:
             return False, 0
         if resp.op != Op.RESYNC_STATE or resp.status != 0:
-            # the server doesn't speak the recovery plane (native C++
-            # engine rejects with nonzero status) — fall back to re-init
+            # the server doesn't speak the recovery plane (a pre-parity
+            # native binary rejects with nonzero status; current engines
+            # — Python AND C++ — both serve it) — fall back to re-init
             return False, 0
         state = decode_resync_state(resp.payload)
         replayed = 0

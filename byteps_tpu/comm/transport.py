@@ -69,8 +69,8 @@ class Op(enum.IntEnum):
     # exhausted its RPC retries against a LIVE server asks that server
     # for its authoritative per-key round/ledger state, replays only the
     # journaled pushes the server never absorbed, and rejoins in place —
-    # no global re-init barrier, no peer participation.  Python server
-    # engine only (the C++ engine rejects these with a nonzero status).
+    # no global re-init barrier, no peer participation.  Served by BOTH
+    # engines (the C++ server answers from its native ledger).
     RESYNC_QUERY = 23  # worker → server: {worker flag, keys of interest}
     RESYNC_STATE = 24  # server → worker: per-key {store_version, seen, ...}
 
@@ -331,9 +331,11 @@ def decode_liveness(payload: bytes) -> dict:
 #
 # JSON bodies, like the control plane: resync is a rare, human-debuggable
 # recovery RPC, not a data-plane hot path, and JSON keeps it greppable in
-# packet dumps.  Python server engine only (docs/robustness.md); the C++
-# engine answers these ops with a nonzero status and the worker's heal
-# path falls back to the global re-init barrier.
+# packet dumps.  Served by BOTH engines (docs/robustness.md): the C++
+# server answers from its own ledger with byte-compatible state bodies
+# (ps_server.cc encode_resync_state_bytes, pinned by the golden wire
+# fixtures); a PRE-parity native binary answers with a nonzero status
+# and the worker's heal path falls back to the global re-init barrier.
 #
 # Query body:  {"worker": <flags byte>, "keys": [<u64 key>, ...]}
 #              (empty "keys" = every key the server holds)

@@ -79,9 +79,10 @@ class Config:
     # server neighbors are packed into one multi-key Op.FUSED RPC instead
     # of per-key push+pull pairs — the hot path stops paying per-message
     # overhead for bias/layernorm-sized gradients.  0 disables fusion
-    # (every partition keeps its own RPC).  Requires the Python server
-    # engine (the C++ engine does not speak Op.FUSED yet), hence off by
-    # default.
+    # (every partition keeps its own RPC).  BOTH server engines speak
+    # Op.FUSED (the C++ data plane since the native-parity port); off by
+    # default purely because coalescing only pays on many-small-key
+    # workloads (docs/perf.md tuning note).
     fusion_threshold: int = 0  # BYTEPS_FUSION_THRESHOLD
     # fusion buffer capacity per destination server; a full buffer
     # flushes immediately

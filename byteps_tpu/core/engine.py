@@ -940,7 +940,7 @@ class PipelineEngine:
         when in-place heal is not possible — topology changed under the
         job (the cluster-coherent re-init path owns that), compressed or
         device-codec keys (their pull needs the codec pipeline), resync
-        refused (server restarted, journal gap, native engine), or the
+        refused (server restarted, journal gap, pre-parity binary), or the
         healed round's pull timed out.  The caller then falls back to
         the resubmit-with-re-init path, which is the pre-recovery
         behavior."""

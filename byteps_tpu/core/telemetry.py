@@ -136,6 +136,22 @@ class RobustnessCounters:
         self._counts: Dict[str, int] = {}
         # name → {label_key_tuple: count}; flat totals above INCLUDE these
         self._labeled: Dict[str, Dict[tuple, int]] = {}
+        # External counter providers (docs/observability.md): zero-arg
+        # callables returning {name: monotonic int}, merged into
+        # snapshot()/get() — how the GIL-free C++ engine's counters
+        # (native/__init__.py native_server_counters) reach the same
+        # scrape surface without the data plane ever calling into
+        # Python.  Each provider carries a baseline captured at reset()
+        # so test-style reset semantics hold even though the native
+        # counters themselves are never cleared.  Providers are invoked
+        # UNDER self._lock (they are microsecond ctypes reads and must
+        # not call back into this object — see register_provider), which
+        # makes snapshot/reset/absorb mutually exclusive: a scrape can
+        # never double-count a concurrently-absorbed provider.
+        self._providers: Dict[int, tuple] = {}  # id → (fn, baseline)
+        # totals folded in from absorbed (stopped) providers; cleared by
+        # reset() like the flat counters
+        self._frozen: Dict[str, int] = {}
 
     def bump(self, name: str, n: int = 1,
              labels: Optional[Dict[str, str]] = None,
@@ -158,13 +174,73 @@ class RobustnessCounters:
             if self._counts.get(name, 0) < value:
                 self._counts[name] = value
 
+    def register_provider(self, fn) -> None:
+        """Merge an external monotonic counter source (e.g. one native
+        C++ server instance) into this store's snapshots.  ``fn`` must
+        be fast (it runs under this store's lock — a microsecond ctypes
+        read, not I/O), non-reentrant (it may not call back into
+        counters()), and tolerate being called after its source stopped
+        (return {})."""
+        with self._lock:
+            self._providers[id(fn)] = (fn, {})
+
+    def unregister_provider(self, fn) -> None:
+        with self._lock:
+            self._providers.pop(id(fn), None)
+
+    def absorb_provider(self, fn) -> None:
+        """Fold a provider's final values (above its reset baseline)
+        into the frozen-totals dict and unregister it — called before
+        the provider's source is torn down so totals survive a server
+        stop().  Runs entirely under the lock, so a concurrent scrape
+        sees the totals through EITHER the live provider OR the frozen
+        dict, never both (no double-count), and the registry does not
+        grow with stopped servers."""
+        with self._lock:
+            entry = self._providers.pop(id(fn), None)
+            if entry is None:
+                return
+            fn_live, base = entry
+            try:
+                vals = fn_live() or {}
+            except Exception:  # noqa: BLE001
+                vals = {}
+            for name, v in vals.items():
+                d = int(v) - base.get(name, 0)
+                if d > 0:
+                    self._frozen[name] = self._frozen.get(name, 0) + d
+
+    def _provider_totals_locked(self) -> Dict[str, int]:
+        """Frozen totals + every live provider's counters above its
+        reset baseline.  Caller holds the lock (providers are contract-
+        bound to be microsecond reads, see register_provider)."""
+        total = dict(self._frozen)
+        for fn, base in self._providers.values():
+            try:
+                vals = fn() or {}
+            except Exception:  # noqa: BLE001 — a dead provider can't break scrape
+                continue
+            for name, v in vals.items():
+                d = int(v) - base.get(name, 0)
+                if d > 0:
+                    total[name] = total.get(name, 0) + d
+        return total
+
     def get(self, name: str) -> int:
         with self._lock:
-            return self._counts.get(name, 0)
+            ext = (
+                self._provider_totals_locked()
+                if self._providers or self._frozen else {}
+            )
+            return self._counts.get(name, 0) + ext.get(name, 0)
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._counts)
+            out = dict(self._counts)
+            if self._providers or self._frozen:
+                for name, v in self._provider_totals_locked().items():
+                    out[name] = out.get(name, 0) + v
+            return out
 
     def snapshot_labeled(self) -> Dict[str, Dict[tuple, int]]:
         """{name: {((label, value), ...): count}} for the labeled slice."""
@@ -175,6 +251,14 @@ class RobustnessCounters:
         with self._lock:
             self._counts.clear()
             self._labeled.clear()
+            self._frozen.clear()
+            # re-baseline live providers so their post-reset deltas start
+            # at zero (the native counters themselves are never cleared)
+            for key, (fn, _base) in list(self._providers.items()):
+                try:
+                    self._providers[key] = (fn, dict(fn() or {}))
+                except Exception:  # noqa: BLE001
+                    self._providers[key] = (fn, {})
 
 
 # Default latency buckets (seconds): 100µs → ~algo 100s, exponential —

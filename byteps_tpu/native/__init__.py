@@ -107,6 +107,27 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             c.c_char_p, c.c_int32, c.c_int32, c.c_int32,
         ]
         lib.bps_native_server_start_unix.restype = c.c_int32
+    # protocol-parity surface (FUSED/ledger/RESYNC port): observability
+    # counters, the zombie-fence feed, and the golden wire-codec shims
+    if hasattr(lib, "bps_native_server_counters"):
+        lib.bps_native_server_counters.argtypes = [
+            c.c_int32, c.POINTER(c.c_uint64), c.c_int32,
+        ]
+        lib.bps_native_server_counters.restype = c.c_int32
+        lib.bps_native_server_set_live_workers.argtypes = [
+            c.c_int32, c.POINTER(c.c_uint8), c.c_int32,
+        ]
+        lib.bps_native_server_set_live_workers.restype = None
+        lib.bps_wire_golden.argtypes = [c.c_void_p, c.c_uint64]
+        lib.bps_wire_golden.restype = c.c_int64
+        lib.bps_wire_fused_echo.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_void_p, c.c_uint64,
+        ]
+        lib.bps_wire_fused_echo.restype = c.c_int64
+        lib.bps_wire_resync_echo.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_void_p, c.c_uint64,
+        ]
+        lib.bps_wire_resync_echo.restype = c.c_int64
     # native worker client data plane (ps_client.cc) — may be absent in a
     # stale .so; the pure-Python client covers every van without it
     if hasattr(lib, "bpsc_create"):
@@ -146,11 +167,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None  # corrupt/partial .so → pure-Python fallbacks
-    if not hasattr(lib, "bpsc_create") and autobuild:
-        # stale library from before the newest entry points (currently the
-        # native worker client): rebuild, then load via a temp COPY —
-        # dlopen dedups by path/inode, so reloading the original path can
-        # hand back the old mapping
+    if not hasattr(lib, "bps_native_server_counters") and autobuild:
+        # stale library from before the newest entry points (currently
+        # the native-parity surface: counters/fence/golden shims):
+        # rebuild, then load via a temp COPY — dlopen dedups by
+        # path/inode, so reloading the original path can hand back the
+        # old mapping
         _try_build()
         try:
             import shutil
@@ -162,7 +184,7 @@ def _load() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy(_LIB_PATH, tmp.name)
             fresh = ctypes.CDLL(tmp.name)
-            if hasattr(fresh, "bpsc_create"):
+            if hasattr(fresh, "bps_native_server_counters"):
                 lib = fresh
         except OSError:
             pass
@@ -175,6 +197,39 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 
 HAVE_NATIVE = _load() is not None
+
+#: ``bps_native_server_counters`` index order (ps_server.cc
+#: ``NativeCounter`` — change both together).  Distinct ``native_``-
+#: prefixed names: in-process test clusters share one counter registry
+#: between worker and server roles, and the worker side already bumps
+#: ``wire_rpc``/``fused_frames``/``push_dedup`` — colliding names would
+#: double-count (docs/observability.md).
+NATIVE_COUNTER_NAMES = (
+    "native_wire_rpc",
+    "native_fused_frames",
+    "native_fused_keys",
+    "native_push_dedup",
+    "native_init_replay_ack",
+    "native_resync_query",
+    "native_zombie_reject",
+)
+
+
+def native_server_counters(server_id: int) -> dict:
+    """One native server instance's observability counters as
+    ``{name: int}``; empty once the instance is stopped (or the lib
+    predates the getter) — the ``get_robustness_counters()`` merge path
+    (see :meth:`RobustnessCounters.register_provider`)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "bps_native_server_counters"):
+        return {}
+    out = (ctypes.c_uint64 * len(NATIVE_COUNTER_NAMES))()
+    n = lib.bps_native_server_counters(
+        server_id, out, len(NATIVE_COUNTER_NAMES)
+    )
+    if n <= 0:
+        return {}
+    return {NATIVE_COUNTER_NAMES[i]: int(out[i]) for i in range(n)}
 
 
 def _ptr(a: np.ndarray):

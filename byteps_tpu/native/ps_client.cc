@@ -274,6 +274,17 @@ struct NativeClient {
       Header h;
       if (!cli_recv_exact(lane->fd, &h, sizeof(h))) break;
       if (h.magic != kMagic) break;  // framing desync: drop the conn
+      // Optional trace context (transport.py TRACE_FLAG, status bit 7):
+      // a tracing server appends 16 bytes after the header.  Consume the
+      // block and clear the bit so the stream stays framed and Python
+      // sees a clean status — the same optional-on-decode guarantee the
+      // Python client's recv_header_ex gives (the native client stamps
+      // no spans; ROADMAP keeps that as follow-up).
+      if (h.status & bps_wire::kTraceFlag) {
+        uint8_t trace_ctx[16];
+        if (!cli_recv_exact(lane->fd, trace_ctx, sizeof(trace_ctx))) break;
+        h.status &= static_cast<uint8_t>(~bps_wire::kTraceFlag);
+      }
       Completion m{};
       m.op = h.op;
       m.status = h.status;
@@ -393,16 +404,14 @@ int32_t bpsc_send(int64_t h, int32_t op, uint32_t seq, uint64_t key,
   auto c = cli_for(h);
   if (!c) return -1;
   ClientLane* lane = c->lanes[key % c->lanes.size()].get();
+  // the shared wire.h codec — one header encoder for client, server,
+  // and the golden-fixture shim (Op.FUSED / RESYNC frames ride this
+  // same path: the native client is payload-agnostic, so the fused
+  // pack and recovery-plane routing in comm/ps_client.py work over
+  // either client implementation)
   Header hd;
-  hd.magic = kMagic;
-  hd.op = (uint8_t)op;
-  hd.status = 0;
-  hd.flags = (uint8_t)flags;
-  hd.seq = htonl(seq);
-  hd.key = htobe64(key);
-  hd.cmd = htonl(cmd);
-  hd.version = htonl(version);
-  hd.length = htobe64(len);
+  bps_wire::pack_header(&hd, (uint8_t)op, 0, (uint8_t)flags, seq, key, cmd,
+                        version, len);
   // scatter-gather send: header + payload leave through one writev with
   // zero payload memcpys (transport.py sendmsg parity)
   iovec iov[2] = {{&hd, sizeof(hd)}, {const_cast<void*>(payload), len}};

@@ -28,6 +28,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cctype>
 #include <chrono>
 #include <cmath>
 #include <condition_variable>
@@ -38,6 +39,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <tuple>
@@ -89,8 +91,28 @@ using bps_wire::kInit;
 using bps_wire::kPush;
 using bps_wire::kPull;
 using bps_wire::kRegisterCompressor;
+using bps_wire::kFused;
 using bps_wire::kPing;
 using bps_wire::kShutdown;
+using bps_wire::kResyncQuery;
+using bps_wire::kResyncState;
+using bps_wire::kTraceFlag;
+using bps_wire::pack_header;
+
+// Per-instance observability counters, exported through
+// bps_native_server_counters in THIS index order (the Python side maps
+// them to the native_* names in native/__init__.py — change both
+// together; docs/observability.md catalog).
+enum NativeCounter {
+  kCtrWireRpc = 0,    // data-plane frames handled (push / pull / fused)
+  kCtrFusedFrames,    // multi-key Op.FUSED frames unpacked
+  kCtrFusedKeys,      // member sub-pushes those frames carried
+  kCtrPushDedup,      // replays suppressed by the exactly-once ledger
+  kCtrInitReplayAck,  // INITs acked from the completed-barrier record
+  kCtrResyncQuery,    // Op.RESYNC_QUERY frames answered from the ledger
+  kCtrZombieReject,   // pushes rejected by the live-rank fence
+  kCtrCount,
+};
 
 int dtype_size(int32_t dt) {
   switch (dt) {
@@ -583,6 +605,192 @@ struct PendingPull {
   std::vector<uint8_t> rs_req;
 };
 
+// ---------------------------------------------------------------------------
+// fused / resync wire codecs — byte-compatible with transport.py
+// (encode/decode_fused_*, encode/decode_resync_*); the golden-fixture
+// shim (bps_wire_golden) goes through these same functions so the two
+// implementations cannot drift silently.
+// ---------------------------------------------------------------------------
+
+// one member of an Op.FUSED request body (a VIEW into the frame bytes)
+struct FusedMember {
+  uint64_t key = 0;
+  uint32_t cmd = 0;
+  uint32_t version = 0;
+  const uint8_t* payload = nullptr;
+  uint64_t len = 0;
+};
+
+// Request body: u32 count, count × [u64 key, u32 cmd, u32 version,
+// u64 length, length bytes], network order.  An optional member-span
+// trailer (count × u64, distributed tracing) is ignored — the
+// pre-observability decoder contract transport.py documents.
+bool parse_fused_push(const uint8_t* body, uint64_t size,
+                      std::vector<FusedMember>* out) {
+  if (size < 4) return false;
+  uint32_t count_be;
+  std::memcpy(&count_be, body, 4);
+  const uint32_t count = ntohl(count_be);
+  // empty frame is malformed; so is a count the body cannot possibly
+  // hold (bound BEFORE reserve — a hostile count must not drive an
+  // allocation)
+  if (count == 0 || (uint64_t)count * 24 + 4 > size) return false;
+  uint64_t off = 4;
+  out->reserve(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    if (off + 24 > size) return false;
+    FusedMember m;
+    uint64_t key_be, len_be;
+    uint32_t cmd_be, ver_be;
+    std::memcpy(&key_be, body + off, 8);
+    std::memcpy(&cmd_be, body + off + 8, 4);
+    std::memcpy(&ver_be, body + off + 12, 4);
+    std::memcpy(&len_be, body + off + 16, 8);
+    off += 24;
+    m.key = be64toh(key_be);
+    m.cmd = ntohl(cmd_be);
+    m.version = ntohl(ver_be);
+    m.len = be64toh(len_be);
+    if (m.len > size - off) return false;  // fused frame truncated
+    m.payload = body + off;
+    off += m.len;
+    out->push_back(m);
+  }
+  return true;
+}
+
+// Reply body: u32 count, count × [u64 key, u32 version, u64 length,
+// length bytes] — inverse is transport.decode_fused_reply.
+std::vector<uint8_t> encode_fused_reply_bytes(
+    const std::vector<uint64_t>& keys, const std::vector<uint32_t>& versions,
+    const std::vector<std::vector<uint8_t>>& slots) {
+  uint64_t total = 4;
+  for (const auto& s : slots) total += 20 + s.size();
+  std::vector<uint8_t> out(total);
+  uint8_t* p = out.data();
+  uint32_t count_be = htonl((uint32_t)keys.size());
+  std::memcpy(p, &count_be, 4);
+  p += 4;
+  for (size_t i = 0; i < keys.size(); ++i) {
+    uint64_t key_be = htobe64(keys[i]);
+    uint32_t ver_be = htonl(versions[i]);
+    uint64_t len_be = htobe64((uint64_t)slots[i].size());
+    std::memcpy(p, &key_be, 8);
+    std::memcpy(p + 8, &ver_be, 4);
+    std::memcpy(p + 12, &len_be, 8);
+    p += 20;
+    if (!slots[i].empty()) {
+      std::memcpy(p, slots[i].data(), slots[i].size());
+      p += slots[i].size();
+    }
+  }
+  return out;
+}
+
+// Op.RESYNC_QUERY body: {"worker": <flags byte>, "keys": [<u64>, ...]}.
+// Minimal parse of exactly the shape transport.encode_resync_query emits
+// (the recovery plane's JSON stays human-greppable); anything that is
+// not a JSON object fails → the caller drops the connection, mirroring
+// the Python server's malformed-recovery-frame policy.
+bool parse_resync_query(const uint8_t* body, uint64_t size, uint32_t* wid,
+                        std::vector<uint64_t>* keys) {
+  std::string s((const char*)body, size);
+  size_t i = 0;
+  while (i < s.size() && isspace((unsigned char)s[i])) ++i;
+  if (i >= s.size() || s[i] != '{') return false;
+  *wid = 0;
+  size_t wp = s.find("\"worker\"");
+  if (wp != std::string::npos) {
+    size_t c = s.find(':', wp);
+    if (c == std::string::npos) return false;
+    *wid = (uint32_t)strtoul(s.c_str() + c + 1, nullptr, 10);
+  }
+  size_t kp = s.find("\"keys\"");
+  if (kp == std::string::npos) return true;  // absent = every key we hold
+  size_t lb = s.find('[', kp);
+  if (lb == std::string::npos) return false;
+  size_t rb = s.find(']', lb);
+  if (rb == std::string::npos) return false;
+  const char* p = s.c_str() + lb + 1;
+  const char* end = s.c_str() + rb;
+  while (p < end) {
+    while (p < end && !isdigit((unsigned char)*p)) ++p;
+    if (p >= end) break;
+    char* q = nullptr;
+    keys->push_back(strtoull(p, &q, 10));
+    p = q;
+  }
+  return true;
+}
+
+// Op.RESYNC_STATE body — byte-identical to transport.encode_resync_state
+// (json.dumps default separators, field order store_version / seen /
+// recv_count / init) so the two servers' replies cannot drift.
+std::string encode_resync_state_bytes(
+    const std::vector<std::tuple<uint64_t, uint32_t, uint32_t, int>>& states) {
+  std::string out = "{\"keys\": {";
+  char buf[160];
+  bool first = true;
+  for (const auto& [key, sv, seen, rc] : states) {
+    if (!first) out += ", ";
+    first = false;
+    snprintf(buf, sizeof buf,
+             "\"%llu\": {\"store_version\": %u, \"seen\": %u, "
+             "\"recv_count\": %d, \"init\": true}",
+             (unsigned long long)key, sv, seen, rc);
+    out += buf;
+  }
+  out += "}}";
+  return out;
+}
+
+// Accumulator for one Op.FUSED frame's multi-key response (the C++ twin
+// of server.py's _FusedReply): sub-keys' rounds complete independently —
+// possibly on different engine threads — each fills its slot, and the
+// LAST fill (exactly one, lock-guarded) makes the frame sendable as ONE
+// reply so the worker's single seq/deadline/retry state resolves
+// atomically for every member.
+struct FusedReply {
+  ConnPtr conn;
+  uint32_t seq = 0;
+  uint64_t route_key = 0;
+  std::vector<uint64_t> keys;
+  std::vector<uint32_t> versions;
+  std::vector<std::vector<uint8_t>> slots;
+  std::vector<uint8_t> filled;
+  size_t remaining = 0;
+  std::mutex mu;
+
+  // True exactly once — when this fill completed the frame (the caller
+  // then sends the reply).  Duplicate publish race: first fill wins.
+  bool fill(size_t slot, std::vector<uint8_t>&& payload, uint32_t version) {
+    std::lock_guard<std::mutex> g(mu);
+    if (filled[slot]) return false;
+    filled[slot] = 1;
+    slots[slot] = std::move(payload);
+    versions[slot] = version;
+    return --remaining == 0;
+  }
+};
+using FusedReplyPtr = std::shared_ptr<FusedReply>;
+
+// a fused pull-half parked on a key until its round publishes
+struct FusedWaiter {
+  uint32_t version;
+  FusedReplyPtr reply;
+  size_t slot;
+  bool compressed;
+};
+
+// one parked init-barrier waiter (wid 0 = anonymous, token 0 = tokenless
+// pre-recovery-plane client)
+struct InitWaiter {
+  uint8_t wid = 0;
+  ConnPtr conn;
+  uint32_t seq = 0;
+  uint32_t token = 0;
+};
+
 // RS wire header: !II (nrows, row_len), then nrows big-endian u32 indices
 // [+ nrows*row_len native-order f32 values on pushes]
 static bool rs_parse_header(const std::vector<uint8_t>& p, uint32_t* nrows,
@@ -606,6 +814,7 @@ static bool rs_parse_header(const std::vector<uint8_t>& p, uint32_t* nrows,
 
 struct EngineTask {
   uint8_t op = 0;
+  uint8_t flags = 0;  // worker identity (rank+1) for the replay ledger
   ConnPtr conn;
   uint32_t seq = 0;
   uint64_t key = 0;
@@ -663,7 +872,22 @@ struct KeyState {
   int recv_count = 0;
   uint32_t store_version = 0;
   std::vector<PendingPull> pending;
-  std::vector<std::pair<ConnPtr, uint32_t>> init_waiters;  // (conn, seq)
+  std::vector<InitWaiter> init_waiters;
+  // fused pull-halves parked until their round publishes (server.py
+  // fused_waiters parity)
+  std::vector<FusedWaiter> fused_waiters;
+  // replay-dedupe ledger (docs/robustness.md): worker flag → newest
+  // SUMMED push version.  Per-(key, worker) versions are strictly
+  // increasing (engine round gate), so a replayed push arrives with
+  // version <= the record and is acked WITHOUT re-summing — retried
+  // summation stays exactly-once.  Anonymous pushes (flag 0) never
+  // dedupe, same as the Python engine.
+  std::map<uint8_t, uint32_t> push_seen;
+  // init-idempotency ledger: worker flag → the token whose barrier
+  // COMPLETED.  A replayed INIT (retry of a dropped post-release ack)
+  // carries the SAME token and is acked from this record instead of
+  // re-parked; a fresh token (elastic rejoin) still parks.
+  std::map<uint8_t, uint32_t> init_done;
   std::unique_ptr<Codec> codec;
   std::vector<uint8_t> pull_payload;
 };
@@ -672,26 +896,62 @@ class NativeServer {
  public:
   void set_num_workers(int n) {
     num_workers_.store(n);
-    if (async_ || n <= 0) return;
-    // elastic scale-down: a round that already holds >= n pushes will
-    // never see the departed workers' contributions — publish it now and
-    // flush its buffered pulls (mirrors the Python server)
+    if (n <= 0) return;
     std::vector<std::pair<uint64_t, KeyState*>> all;
     {
       std::lock_guard<std::mutex> g(keys_mu_);
       for (auto& [k, ks] : keys_) all.emplace_back(k, ks.get());
     }
+    // an init barrier that is now full releases immediately: survivors
+    // blocked in the init RPC must not wait forever for an evicted
+    // worker's INIT (mirrors the Python server's update_num_workers)
+    for (auto& [key, ks] : all) {
+      std::vector<InitWaiter> waiters;
+      {
+        std::lock_guard<std::mutex> g(ks->mu);
+        if ((int)ks->init_waiters.size() >= n)
+          complete_init_barrier_locked(*ks, &waiters);
+      }
+      for (auto& w : waiters) send_msg(w.conn, kInit, w.seq, key, 0, nullptr, 0);
+    }
+    if (async_) return;
+    // elastic scale-down: a round that already holds >= n pushes will
+    // never see the departed workers' contributions — publish it now and
+    // flush its buffered pulls (mirrors the Python server)
     for (auto& [key, ks] : all) {
       std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>
           flush;
+      std::vector<FusedReplyPtr> fused_done;
       {
         std::lock_guard<std::mutex> g(ks->mu);
         if (ks->store.empty() || ks->recv_count < n) continue;
-        publish_round_locked(*ks, &flush);
+        publish_round_locked(*ks, &flush, &fused_done);
       }
       for (auto& [pconn, pseq, data, ver] : flush)
         send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
+      for (auto& fr : fused_done) send_fused_reply(fr);
     }
+  }
+
+  // zombie fence (docs/robustness.md): adopt the scheduler book's live
+  // worker-flag set; n < 0 disables the fence (book without ranks).
+  void set_live_workers(const uint8_t* flags, int32_t n) {
+    std::lock_guard<std::mutex> g(live_mu_);
+    live_.clear();
+    if (n < 0) {
+      fence_on_ = false;
+      return;
+    }
+    fence_on_ = true;
+    for (int32_t i = 0; i < n; ++i) live_.insert(flags[i]);
+  }
+
+  // copy this instance's counters (NativeCounter order) into out
+  int32_t read_counters(uint64_t* out, int32_t cap) const {
+    int32_t n = std::min<int32_t>(cap, kCtrCount);
+    for (int32_t i = 0; i < n; ++i)
+      out[i] = ctr_[i].load(std::memory_order_relaxed);
+    return n;
   }
 
   int start(int port, int num_workers, bool enable_async) {
@@ -833,15 +1093,8 @@ class NativeServer {
   void send_msg(const ConnPtr& conn, uint8_t op, uint32_t seq, uint64_t key,
                 uint32_t version, const uint8_t* payload, uint64_t len,
                 uint8_t status = 0) {
-    Header h{};
-    h.magic = kMagic;
-    h.op = op;
-    h.status = status;
-    h.seq = htonl(seq);
-    h.key = htobe64(key);
-    h.cmd = 0;
-    h.version = htonl(version);
-    h.length = htobe64(len);
+    Header h;
+    pack_header(&h, op, status, /*flags=*/0, seq, key, /*cmd=*/0, version, len);
     // per-connection write mutex lives IN the Conn, so concurrent engine
     // threads serialize against each other for exactly this stream
     std::lock_guard<std::mutex> g(conn->write_mu);
@@ -879,9 +1132,12 @@ class NativeServer {
       if (!queues_[tid]->pop(&t, 200)) continue;
       bool ok = true;
       if (t.op == kPush)
-        ok = handle_push(t.conn, t.seq, t.key, t.cmd, t.version, t.payload);
+        ok = handle_push(t.conn, t.seq, t.key, t.cmd, t.version, t.flags,
+                         t.payload);
       else if (t.op == kPull)
         ok = handle_pull(t.conn, t.seq, t.key, t.cmd, t.version, t.payload);
+      else if (t.op == kFused)
+        ok = handle_fused(t.conn, t.seq, t.key, t.flags, t.payload);
       if (!ok) {
         // malformed request → drop the connection: wake() unblocks the
         // serve thread's recv; the transport closes with its last holder
@@ -944,24 +1200,37 @@ class NativeServer {
           send_msg(conn, kShutdown, seq, 0, 0, nullptr, 0);
           return;
         case kInit:
-          if (!handle_init(conn, seq, key, payload)) return;  // malformed → drop conn
+          // flags = worker identity, version = the init-idempotency
+          // token (docs/robustness.md); malformed → drop conn
+          if (!handle_init(conn, seq, key, h.flags, version, payload)) return;
           break;
         case kRegisterCompressor:
           handle_register(conn, seq, key, h.flags, payload);
           break;
+        case kResyncQuery:
+          // recovery plane: answered inline — a read-mostly snapshot of
+          // the exactly-once ledger, and the asking worker is stalled on
+          // it (mirrors the Python server's serve-thread handling)
+          if (!handle_resync(conn, seq, key, payload)) return;
+          break;
         case kPush:
-        case kPull: {
+        case kPull:
+        case kFused: {
           // data plane rides the engine queues; the anti-starvation prio
           // is the key's accumulated push count (queue.h:49-97), snapshot
-          // at enqueue like the reference's cached priority
+          // at enqueue like the reference's cached priority.  A fused
+          // frame routes — and prioritizes — by its first member's key
+          // (the outer header key), same as the Python client sends it.
+          ctr_[kCtrWireRpc].fetch_add(1, std::memory_order_relaxed);
           uint64_t prio;
           {
             std::lock_guard<std::mutex> g(tid_mu_);
-            if (h.op == kPush) pushed_total_[key]++;
+            if (h.op != kPull) pushed_total_[key]++;
             prio = pushed_total_[key];
           }
           EngineTask t;
           t.op = h.op;
+          t.flags = h.flags;
           t.conn = conn;
           t.seq = seq;
           t.key = key;
@@ -973,19 +1242,17 @@ class NativeServer {
           break;
         }
         default: {
-          // Unknown control op — e.g. the recovery plane's RESYNC_QUERY
-          // (transport.py Op 23), which is Python-engine-only.  The
+          // Unknown control op (a NEWER protocol than this engine).  The
           // payload is already consumed, so the stream stays framed;
           // reject CLEANLY with a nonzero status echoing the op + seq so
-          // the worker's heal path falls back to the re-init barrier
-          // instead of waiting out its deadline, and say so once per
-          // process (same pattern as the trace-context skip above).
+          // the caller fails fast instead of waiting out its deadline,
+          // and say so once per process (same pattern as the
+          // trace-context skip above).
           static std::atomic<bool> warned{false};
           if (!warned.exchange(true)) {
             fprintf(stderr,
-                    "byteps-native: rejecting unknown op %d (the recovery "
-                    "plane's RESYNC frames need the Python server "
-                    "engine)\n",
+                    "byteps-native: rejecting unknown op %d (newer protocol "
+                    "than this engine speaks)\n",
                     (int)h.op);
           }
           send_msg(conn, h.op, seq, key, 0, nullptr, 0, /*status=*/1);
@@ -995,7 +1262,38 @@ class NativeServer {
     }
   }
 
+  // Completed init barrier: consume the waiters and reset the round
+  // state (server.py _complete_init_barrier_locked parity).  A completed
+  // barrier (re-)establishes round numbering — after an elastic
+  // resize/resume every worker re-inits and restarts versions at 1
+  // (ReDeclareTensor semantics); store CONTENTS are preserved (async
+  // parameter store across resume).  Caller holds ks.mu.
+  void complete_init_barrier_locked(KeyState& ks,
+                                    std::vector<InitWaiter>* waiters) {
+    waiters->swap(ks.init_waiters);
+    // record each waiter's init token: a retried INIT landing AFTER this
+    // release is acked from the record instead of re-parked (dropped-ack
+    // idempotency).  REPLACED, not merged — an older generation's tokens
+    // must not false-ack a new generation's genuine barrier.
+    ks.init_done.clear();
+    for (auto& w : *waiters)
+      if (w.wid && w.token) ks.init_done[w.wid] = w.token;
+    ks.store_version = 0;
+    ks.recv_count = 0;
+    ks.pending.clear();
+    // parked fused pull-halves are from the abandoned generation too —
+    // their frames' round numbering no longer matches (dropped; the
+    // worker's retry/deadline path owns them)
+    ks.fused_waiters.clear();
+    // the new generation restarts versions at 1, so the replay ledger
+    // from the previous generation must not mark its first-round pushes
+    // as duplicates
+    ks.push_seen.clear();
+    ks.pull_payload.clear();  // stale round cache must not be served
+  }
+
   bool handle_init(const ConnPtr& conn, uint32_t seq, uint64_t key,
+                   uint8_t wid, uint32_t token,
                    const std::vector<uint8_t>& payload) {
     // malformed init must not silently strand the barrier: drop the
     // connection so the worker sees EOF instead of hanging forever
@@ -1007,7 +1305,8 @@ class NativeServer {
     n = be64toh(n);
     dt = ntohl(dt);
     auto& ks = key_state(key);
-    std::vector<std::pair<ConnPtr, uint32_t>> waiters;
+    std::vector<InitWaiter> waiters;
+    bool replay_ack = false;
     {
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) {
@@ -1017,21 +1316,43 @@ class NativeServer {
         ks.store.assign(bytes, 0);
         ks.accum.assign(bytes, 0);
       }
-      ks.init_waiters.emplace_back(conn, seq);
-      if ((int)ks.init_waiters.size() >= num_workers_.load()) {
-        waiters.swap(ks.init_waiters);
-        // completed init barrier (re-)establishes round numbering: after
-        // an elastic resize/resume every worker re-inits and restarts
-        // versions at 1 (ReDeclareTensor semantics); store contents are
-        // preserved (async parameter store across resume)
-        ks.store_version = 0;
-        ks.recv_count = 0;
-        ks.pending.clear();
-        ks.pull_payload.clear();  // stale round cache must not be served
+      // init-idempotency (docs/robustness.md): a replayed INIT whose
+      // barrier already COMPLETED — the retry of an ack dropped after
+      // the release — is acked from the completed-barrier record.
+      // Parking it would strand the worker: its released peers never
+      // re-init this key, so the short barrier outlives the retry
+      // budget.  A fresh token (elastic rejoin, restarted client) still
+      // parks: genuine new barriers are unaffected.
+      auto it = ks.init_done.find(wid);
+      if (wid && token && it != ks.init_done.end() && it->second == token) {
+        ctr_[kCtrInitReplayAck].fetch_add(1, std::memory_order_relaxed);
+        replay_ack = true;
+      } else {
+        // keyed by worker identity: a REPLAYED init (retry after a lost
+        // ack / torn connection) REPLACES this worker's waiter entry —
+        // appending again would double-count one worker and release the
+        // barrier short.  Anonymous inits (wid 0) keep appending.
+        InitWaiter w{wid, conn, seq, token};
+        bool replaced = false;
+        if (wid) {
+          for (auto& e : ks.init_waiters)
+            if (e.wid == wid) {
+              e = std::move(w);
+              replaced = true;
+              break;
+            }
+        }
+        if (!replaced) ks.init_waiters.push_back(std::move(w));
+        int workers = num_workers_.load();
+        if (workers > 0 && (int)ks.init_waiters.size() >= workers)
+          complete_init_barrier_locked(ks, &waiters);
       }
     }
-    for (auto& [wconn, wseq] : waiters)
-      send_msg(wconn, kInit, wseq, key, 0, nullptr, 0);
+    if (replay_ack) {
+      send_msg(conn, kInit, seq, key, 0, nullptr, 0);
+      return true;
+    }
+    for (auto& w : waiters) send_msg(w.conn, kInit, w.seq, key, 0, nullptr, 0);
     return true;
   }
 
@@ -1071,68 +1392,120 @@ class NativeServer {
     send_msg(conn, kRegisterCompressor, seq, key, 0, nullptr, 0);
   }
 
+  // Zombie fence (docs/robustness.md): true when the scheduler's latest
+  // book lists live ranks and this worker flag is NOT among them — a
+  // stalled-but-alive worker must not pollute rounds sized for the
+  // shrunken membership; it learns of its expulsion through the dropped
+  // connection.
+  bool fenced(uint8_t wid) {
+    if (!wid) return false;
+    std::lock_guard<std::mutex> g(live_mu_);
+    if (!fence_on_ || live_.count(wid)) return false;
+    ctr_[kCtrZombieReject].fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+
+  // replay-dedupe check (caller holds ks.mu): true when this (worker,
+  // version) was already summed — ack it, don't re-sum
+  bool is_replayed_push_locked(KeyState& ks, uint8_t wid, uint32_t version) {
+    if (!wid || version == 0) return false;
+    auto it = ks.push_seen.find(wid);
+    if (it != ks.push_seen.end() && version <= it->second) {
+      ctr_[kCtrPushDedup].fetch_add(1, std::memory_order_relaxed);
+      return true;
+    }
+    return false;
+  }
+
+  // One (sub-)push's summation under ks.mu — shared by the plain PUSH
+  // and FUSED member paths so both stay behaviorally identical
+  // (server.py _sum_push_locked parity).  The replay-ledger entry is
+  // recorded only AFTER the summation succeeded: a sum that fails must
+  // leave the retry eligible.  Returns false on a malformed payload
+  // (caller drops the connection).
+  bool sum_push_locked(
+      KeyState& ks, uint8_t wid, uint32_t version, const uint8_t* payload,
+      uint64_t len, bool compressed,
+      std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>,
+                             uint32_t>>* flush,
+      std::vector<FusedReplyPtr>* fused_done) {
+    // malformed compressed payload → drop conn (mirrors malformed-init)
+    if (compressed && !ks.codec->wire_ok((int64_t)len)) return false;
+    float* accf = (float*)ks.accum.data();
+    // clamp to the allocated buffer: a payload larger than the declared
+    // size (client skew) must never write out of bounds
+    const int64_t max_elems = (int64_t)ks.store.size() / dtype_size(ks.dtype);
+    const int64_t n_elems =
+        std::min<int64_t>((int64_t)len / dtype_size(ks.dtype), max_elems);
+    if (async_) {
+      if (compressed)
+        ks.codec->sum_into(payload, (int64_t)len, (float*)ks.store.data());
+      else
+        bps_sum(ks.store.data(), payload, n_elems, ks.dtype);
+      ks.store_version++;
+    } else {
+      if (compressed) {
+        if (ks.recv_count == 0) {
+          std::memset(ks.accum.data(), 0, ks.accum.size());
+          ks.codec->decompress(payload, (int64_t)len, accf);
+        } else {
+          ks.codec->sum_into(payload, (int64_t)len, accf);
+        }
+      } else if (ks.recv_count == 0) {
+        std::memcpy(ks.accum.data(), payload,
+                    std::min<size_t>(len, ks.accum.size()));
+      } else {
+        bps_sum(ks.accum.data(), payload, n_elems, ks.dtype);
+      }
+      ks.recv_count++;
+    }
+    if (wid && version > 0) ks.push_seen[wid] = version;
+    if (!async_ && ks.recv_count >= num_workers_.load())
+      publish_round_locked(ks, flush, fused_done);
+    return true;
+  }
+
   bool handle_push(const ConnPtr& conn, uint32_t seq, uint64_t key, uint32_t cmd,
-                   uint32_t version, const std::vector<uint8_t>& payload) {
+                   uint32_t version, uint8_t flags,
+                   const std::vector<uint8_t>& payload) {
+    if (fenced(flags)) return false;  // evicted worker → drop conn
     int32_t rtype, dtype;
     decode_cantor(cmd, &rtype, &dtype);
     auto& ks = key_state(key);
     std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>> flush;
+    std::vector<FusedReplyPtr> fused_done;
     if (rtype == 1) {  // kRowSparsePushPull: scatter-sum rows
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) return false;
-      if (!handle_push_rowsparse_locked(ks, payload, &flush)) return false;
+      if (!is_replayed_push_locked(ks, flags, version) &&
+          !handle_push_rowsparse_locked(ks, flags, version, payload, &flush,
+                                        &fused_done))
+        return false;
     } else {
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) return false;  // push before init → drop conn
       bool compressed = (rtype == 2) && ks.codec != nullptr;
-      // malformed compressed payload → drop conn (mirrors malformed-init)
-      if (compressed && !ks.codec->wire_ok((int64_t)payload.size()))
+      if (!is_replayed_push_locked(ks, flags, version) &&
+          !sum_push_locked(ks, flags, version, payload.data(), payload.size(),
+                           compressed, &flush, &fused_done))
         return false;
-      float* accf = (float*)ks.accum.data();
-      // clamp to the allocated buffer: a payload larger than the declared
-      // size (client skew) must never write out of bounds
-      const int64_t max_elems =
-          (int64_t)ks.store.size() / dtype_size(ks.dtype);
-      const int64_t n_elems = std::min<int64_t>(
-          (int64_t)payload.size() / dtype_size(ks.dtype), max_elems);
-      if (async_) {
-        if (compressed)
-          ks.codec->sum_into(payload.data(), (int64_t)payload.size(),
-                             (float*)ks.store.data());
-        else
-          bps_sum(ks.store.data(), payload.data(), n_elems, ks.dtype);
-        ks.store_version++;
-      } else {
-        if (compressed) {
-          if (ks.recv_count == 0) {
-            std::memset(ks.accum.data(), 0, ks.accum.size());
-            ks.codec->decompress(payload.data(), (int64_t)payload.size(), accf);
-          } else {
-            ks.codec->sum_into(payload.data(), (int64_t)payload.size(), accf);
-          }
-        } else if (ks.recv_count == 0) {
-          std::memcpy(ks.accum.data(), payload.data(),
-                      std::min(payload.size(), ks.accum.size()));
-        } else {
-          bps_sum(ks.accum.data(), payload.data(), n_elems, ks.dtype);
-        }
-        ks.recv_count++;
-        if (ks.recv_count >= num_workers_.load())
-          publish_round_locked(ks, &flush);
-      }
     }
     send_msg(conn, kPush, seq, key, version, nullptr, 0);
     for (auto& [pconn, pseq, data, ver] : flush)
       send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
+    for (auto& fr : fused_done) send_fused_reply(fr);
     return true;
   }
 
   // ALL_RECV: publish the round and collect serviceable buffered pulls
-  // (server.cc:348-375).  Caller holds ks.mu.
+  // (server.cc:348-375) plus fused pull-halves whose fill COMPLETED
+  // their frame (appended to *fused_done for the caller to send after
+  // unlocking).  Caller holds ks.mu.
   void publish_round_locked(
       KeyState& ks,
       std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>*
-          flush) {
+          flush,
+      std::vector<FusedReplyPtr>* fused_done) {
     ks.store.swap(ks.accum);
     ks.store_version++;
     ks.recv_count = 0;
@@ -1159,15 +1532,143 @@ class NativeServer {
       }
     }
     ks.pending.swap(still);
+    // fused pull-halves parked on this key: fill their reply slots; a
+    // fill that COMPLETES its frame queues the whole reply for send
+    std::vector<FusedWaiter> still_fused;
+    for (auto& w : ks.fused_waiters) {
+      if (w.version <= ks.store_version) {
+        if (w.reply->fill(w.slot, wire_payload_locked(ks, w.compressed),
+                          ks.store_version))
+          fused_done->push_back(w.reply);
+      } else {
+        still_fused.push_back(std::move(w));
+      }
+    }
+    ks.fused_waiters.swap(still_fused);
+  }
+
+  // ship one completed fused frame as a single multi-key reply; the
+  // per-connection write mutex inside send_msg serializes against
+  // concurrent engine threads on the same stream
+  void send_fused_reply(const FusedReplyPtr& r) {
+    std::vector<uint8_t> body =
+        encode_fused_reply_bytes(r->keys, r->versions, r->slots);
+    send_msg(r->conn, kFused, r->seq, r->route_key, 0, body.data(),
+             body.size());
+  }
+
+  // Op.FUSED (docs/perf.md): unpack one multi-key fused frame, run every
+  // sub-push through the per-(worker, key) exactly-once ledger, and
+  // answer with ONE multi-key reply once every member's round is
+  // published (server.py _handle_fused parity).  Frame-level retry
+  // safety falls out per key: members summed before a mid-frame error
+  // are ledger-recorded, so a retransmitted frame re-sums nothing whose
+  // original landed.
+  bool handle_fused(const ConnPtr& conn, uint32_t seq, uint64_t route_key,
+                    uint8_t flags, const std::vector<uint8_t>& payload) {
+    if (fenced(flags)) return false;  // evicted worker → drop conn
+    std::vector<FusedMember> members;
+    if (!parse_fused_push(payload.data(), payload.size(), &members))
+      return false;  // malformed/empty fused frame → drop conn
+    ctr_[kCtrFusedFrames].fetch_add(1, std::memory_order_relaxed);
+    ctr_[kCtrFusedKeys].fetch_add(members.size(), std::memory_order_relaxed);
+    auto reply = std::make_shared<FusedReply>();
+    reply->conn = conn;
+    reply->seq = seq;
+    reply->route_key = route_key;
+    reply->keys.reserve(members.size());
+    for (auto& m : members) reply->keys.push_back(m.key);
+    reply->versions.assign(members.size(), 0);
+    reply->slots.resize(members.size());
+    reply->filled.assign(members.size(), 0);
+    reply->remaining = members.size();
+    bool completed = false;
+    for (size_t slot = 0; slot < members.size(); ++slot) {
+      auto& m = members[slot];
+      int32_t rtype, dtype;
+      decode_cantor(m.cmd, &rtype, &dtype);
+      if (rtype == 1) return false;  // row-sparse members cannot fuse
+      auto& ks = key_state(m.key);
+      std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>,
+                             uint32_t>> flush;
+      std::vector<FusedReplyPtr> fused_done;
+      {
+        std::lock_guard<std::mutex> g(ks.mu);
+        if (ks.store.empty()) return false;  // member before init → drop
+        bool compressed = (rtype == 2) && ks.codec != nullptr;
+        if (!is_replayed_push_locked(ks, flags, m.version) &&
+            !sum_push_locked(ks, flags, m.version, m.payload, m.len,
+                             compressed, &flush, &fused_done))
+          return false;
+        // this member's pull half: answered now if its round is
+        // published (async mode always is), else parked on the key
+        if (async_ || m.version <= ks.store_version) {
+          if (reply->fill(slot, wire_payload_locked(ks, compressed),
+                          ks.store_version))
+            completed = true;
+        } else {
+          ks.fused_waiters.push_back({m.version, reply, slot, compressed});
+        }
+      }
+      for (auto& [pconn, pseq, data, ver] : flush)
+        send_msg(pconn, kPull, pseq, m.key, ver, data.data(), data.size());
+      for (auto& fr : fused_done) send_fused_reply(fr);
+    }
+    if (completed) send_fused_reply(reply);
+    return true;
+  }
+
+  // Op.RESYNC_QUERY (docs/robustness.md "healing flow"): report the
+  // authoritative per-key round/ledger state so a worker that exhausted
+  // its retries can replay exactly the journaled pushes this server
+  // never absorbed.  Pure read, answered inline on the serve thread
+  // (the asking worker is stalled on it); the replayed pushes go
+  // through the normal PUSH path — ledger dedupe, fence, publish all
+  // apply unchanged.
+  bool handle_resync(const ConnPtr& conn, uint32_t seq, uint64_t route_key,
+                     const std::vector<uint8_t>& payload) {
+    uint32_t wid = 0;
+    std::vector<uint64_t> keys;
+    if (!parse_resync_query(payload.data(), payload.size(), &wid, &keys))
+      return false;  // malformed recovery frame → drop conn (Python parity)
+    ctr_[kCtrResyncQuery].fetch_add(1, std::memory_order_relaxed);
+    if (keys.empty()) {
+      std::lock_guard<std::mutex> g(keys_mu_);
+      for (auto& [k, ks] : keys_) keys.push_back(k);
+    }
+    std::vector<std::tuple<uint64_t, uint32_t, uint32_t, int>> states;
+    for (uint64_t k : keys) {
+      KeyState* ks = nullptr;
+      {
+        std::lock_guard<std::mutex> g(keys_mu_);
+        auto it = keys_.find(k);
+        if (it != keys_.end()) ks = it->second.get();
+      }
+      if (ks == nullptr) continue;
+      std::lock_guard<std::mutex> g(ks->mu);
+      if (ks->store.empty()) continue;
+      uint32_t seen = 0;
+      if (wid) {
+        auto it = ks->push_seen.find((uint8_t)wid);
+        if (it != ks->push_seen.end()) seen = it->second;
+      }
+      states.emplace_back(k, ks->store_version, seen, ks->recv_count);
+    }
+    std::string body = encode_resync_state_bytes(states);
+    send_msg(conn, kResyncState, seq, route_key, 0,
+             (const uint8_t*)body.data(), body.size());
+    return true;
   }
 
   // scatter-sum one worker's (indices, values) rows into the round
   // accumulator (sparse COPY_FIRST zeroes untouched rows); caller holds
   // ks.mu.  f32 only — the worker engine enforces the dtype.
   bool handle_push_rowsparse_locked(
-      KeyState& ks, const std::vector<uint8_t>& payload,
+      KeyState& ks, uint8_t wid, uint32_t version,
+      const std::vector<uint8_t>& payload,
       std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>*
-          flush) {
+          flush,
+      std::vector<FusedReplyPtr>* fused_done) {
     uint32_t nrows, row_len;
     if (!rs_parse_header(payload, &nrows, &row_len)) return false;
     if (dtype_size(ks.dtype) != 4) return false;
@@ -1197,10 +1698,13 @@ class NativeServer {
     }
     if (async_) {
       ks.store_version++;
+      if (wid && version > 0) ks.push_seen[wid] = version;
       return true;
     }
     ks.recv_count++;
-    if (ks.recv_count >= num_workers_.load()) publish_round_locked(ks, flush);
+    if (wid && version > 0) ks.push_seen[wid] = version;
+    if (ks.recv_count >= num_workers_.load())
+      publish_round_locked(ks, flush, fused_done);
     return true;
   }
 
@@ -1288,6 +1792,14 @@ class NativeServer {
   std::map<uint64_t, uint64_t> pushed_total_;
   // EF residual lr (workers broadcast optimizer lr; default 1.0)
   std::atomic<float> ef_lr_{1.0f};
+  // zombie fence: live worker flags from the scheduler's latest book
+  // (fence_on_ false = no book with ranks seen → fence off)
+  std::mutex live_mu_;
+  bool fence_on_ = false;
+  std::set<uint8_t> live_;
+  // observability counters (NativeCounter order; read via
+  // bps_native_server_counters so GIL-free runs aren't metrics-blind)
+  std::atomic<uint64_t> ctr_[kCtrCount] = {};
 };
 
 // several server instances may coexist in one process (multi-server
@@ -1343,6 +1855,137 @@ void bps_native_server_set_num_workers(int32_t port, int32_t n) {
   }
   auto it = g_servers.find(port);
   if (it != g_servers.end()) it->second->set_num_workers(n);
+}
+
+// Copy one instance's observability counters into out (NativeCounter
+// index order — native/__init__.py maps them to the native_* names).
+// Returns the number of slots filled, or -1 for an unknown instance.
+int32_t bps_native_server_counters(int32_t port, uint64_t* out, int32_t cap) {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  auto it = g_servers.find(port);
+  if (it == g_servers.end()) return -1;
+  return it->second->read_counters(out, cap);
+}
+
+// Refresh an instance's zombie fence from the scheduler book's live
+// worker-flag list; n < 0 disables the fence (book without ranks).
+void bps_native_server_set_live_workers(int32_t port, const uint8_t* flags,
+                                        int32_t n) {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  auto it = g_servers.find(port);
+  if (it != g_servers.end()) it->second->set_live_workers(flags, n);
+}
+
+// ---------------------------------------------------------------------------
+// golden wire-frame shims (tests/test_wire_golden.py): the C++ side of
+// the byte-exact cross-language fixtures.  These go through the SAME
+// pack_header / encode_fused_reply_bytes / encode_resync_state_bytes /
+// parse_* code paths the live engine uses, so transport.py and the C++
+// codec cannot drift silently.
+// ---------------------------------------------------------------------------
+
+// Emit the fixed fixture frames (layout documented in the test, which
+// builds the identical bytes via transport.py).  Returns bytes written,
+// or -(needed) when cap is too small.
+int64_t bps_wire_golden(uint8_t* out, uint64_t cap) {
+  std::vector<uint8_t> buf;
+  auto put_header = [&](uint8_t op, uint8_t status, uint8_t flags,
+                        uint32_t seq, uint64_t key, uint32_t cmd,
+                        uint32_t version, uint64_t len) {
+    Header h;
+    pack_header(&h, op, status, flags, seq, key, cmd, version, len);
+    const uint8_t* p = (const uint8_t*)&h;
+    buf.insert(buf.end(), p, p + sizeof(h));
+  };
+  auto put_bytes = [&](const void* p, size_t n) {
+    buf.insert(buf.end(), (const uint8_t*)p, (const uint8_t*)p + n);
+  };
+  // A: plain PUSH (no trace): payload bytes 0..7
+  uint8_t payload_a[8] = {0, 1, 2, 3, 4, 5, 6, 7};
+  put_header(kPush, 0, 1, 7, 42, 6, 3, sizeof(payload_a));
+  put_bytes(payload_a, sizeof(payload_a));
+  // B: the same PUSH with the 16-byte trace-context block
+  put_header(kPush, kTraceFlag, 1, 7, 42, 6, 3, sizeof(payload_a));
+  uint8_t trace[16];
+  bps_wire::pack_trace(trace, 0x1122334455667788ull, 0x99AABBCCDDEEFF00ull);
+  put_bytes(trace, sizeof(trace));
+  put_bytes(payload_a, sizeof(payload_a));
+  // C: PULL request (empty payload)
+  put_header(kPull, 0, 0, 8, 42, 6, 3, 0);
+  // D: INIT carrying an idempotency token in version (payload !QI)
+  uint8_t init_payload[12];
+  uint64_t n_be = htobe64(32);
+  uint32_t dt_be = htonl(0);
+  std::memcpy(init_payload, &n_be, 8);
+  std::memcpy(init_payload + 8, &dt_be, 4);
+  put_header(kInit, 0, 2, 9, 43, 0, 0xA0001, sizeof(init_payload));
+  put_bytes(init_payload, sizeof(init_payload));
+  // E: FUSED reply frame through the live reply encoder
+  std::vector<uint64_t> keys = {101, 202};
+  std::vector<uint32_t> versions = {1, 2};
+  std::vector<std::vector<uint8_t>> slots = {{'w', 'x', 'y', 'z'}, {}};
+  std::vector<uint8_t> fused = encode_fused_reply_bytes(keys, versions, slots);
+  put_header(kFused, 0, 0, 10, 101, 0, 0, fused.size());
+  put_bytes(fused.data(), fused.size());
+  // F: RESYNC_STATE frame through the live state encoder
+  std::string state = encode_resync_state_bytes(
+      {{5, 4, 3, 1}, {9, 0, 0, 0}});
+  put_header(kResyncState, 0, 0, 11, 5, 0, 0, state.size());
+  put_bytes(state.data(), state.size());
+  if (buf.size() > cap) return -(int64_t)buf.size();
+  std::memcpy(out, buf.data(), buf.size());
+  return (int64_t)buf.size();
+}
+
+// Parse a fused-push body with the live decoder and re-encode it
+// canonically (count + members, NO span trailer).  The Python test
+// feeds transport.encode_fused_push output — with and without the
+// trailer — and asserts the echo equals the trailer-less encoding:
+// parse parity including the trailer-ignoring contract.  Returns bytes
+// written, -1 on a parse failure, or -(needed) when cap is too small.
+int64_t bps_wire_fused_echo(const uint8_t* in, uint64_t len, uint8_t* out,
+                            uint64_t cap) {
+  std::vector<FusedMember> members;
+  if (!parse_fused_push(in, len, &members)) return -1;
+  uint64_t total = 4;
+  for (auto& m : members) total += 24 + m.len;
+  if (total > cap) return -(int64_t)total;
+  uint8_t* p = out;
+  uint32_t count_be = htonl((uint32_t)members.size());
+  std::memcpy(p, &count_be, 4);
+  p += 4;
+  for (auto& m : members) {
+    uint64_t key_be = htobe64(m.key), len_be = htobe64(m.len);
+    uint32_t cmd_be = htonl(m.cmd), ver_be = htonl(m.version);
+    std::memcpy(p, &key_be, 8);
+    std::memcpy(p + 8, &cmd_be, 4);
+    std::memcpy(p + 12, &ver_be, 4);
+    std::memcpy(p + 16, &len_be, 8);
+    p += 24;
+    if (m.len) {
+      std::memcpy(p, m.payload, m.len);
+      p += m.len;
+    }
+  }
+  return (int64_t)(p - out);
+}
+
+// Parse a resync-query body with the live parser and echo it as
+// "<worker>|<key>,<key>,..." text.  Returns bytes written, -1 on a
+// parse failure, or -(needed) when cap is too small.
+int64_t bps_wire_resync_echo(const uint8_t* in, uint64_t len, uint8_t* out,
+                             uint64_t cap) {
+  uint32_t wid = 0;
+  std::vector<uint64_t> keys;
+  if (!parse_resync_query(in, len, &wid, &keys)) return -1;
+  std::string s = std::to_string(wid) + "|";
+  for (size_t i = 0; i < keys.size(); ++i) {
+    if (i) s += ",";
+    s += std::to_string(keys[i]);
+  }
+  if (s.size() > cap) return -(int64_t)s.size();
+  std::memcpy(out, s.data(), s.size());
+  return (int64_t)s.size();
 }
 
 // stop one instance by port, or all when port < 0

@@ -1,15 +1,25 @@
 // Shared wire definitions for the framed PS protocol — single source of
 // truth for the C++ server (ps_server.cc) and worker client
 // (ps_client.cc).  Must stay byte-compatible with the Python framing in
-// byteps_tpu/comm/transport.py: 32-byte big-endian header + raw payload.
+// byteps_tpu/comm/transport.py: 32-byte big-endian header + raw payload,
+// with an optional 16-byte (trace_id, span_id) block between header and
+// payload when the status byte carries kTraceFlag.
 #ifndef BYTEPS_TPU_NATIVE_WIRE_H_
 #define BYTEPS_TPU_NATIVE_WIRE_H_
 
+#include <arpa/inet.h>
+#include <endian.h>
+
 #include <cstdint>
+#include <cstring>
 
 namespace bps_wire {
 
 constexpr uint8_t kMagic = 0xB5;
+
+//: status-byte bit: a 16-byte (u64 trace_id + u64 span_id) block follows
+//: the header, BEFORE the payload (transport.py TRACE_FLAG)
+constexpr uint8_t kTraceFlag = 0x80;
 
 // transport.py Op enum (data-plane subset the native code speaks)
 enum Opcode : uint8_t {
@@ -17,8 +27,12 @@ enum Opcode : uint8_t {
   kPush = 11,
   kPull = 12,
   kRegisterCompressor = 13,
+  kFused = 14,   // multi-key fused push+pull frame (docs/perf.md)
   kPing = 20,
   kShutdown = 21,
+  // recovery plane (docs/robustness.md "healing flow")
+  kResyncQuery = 23,
+  kResyncState = 24,
 };
 
 #pragma pack(push, 1)
@@ -32,6 +46,31 @@ struct Header {
 };
 #pragma pack(pop)
 static_assert(sizeof(Header) == 32, "wire header must be 32 bytes");
+
+// The ONE header encoder both native halves (and the golden-fixture
+// shim) go through — a byte-order bug can no longer live in only the
+// client or only the server.
+inline void pack_header(Header* h, uint8_t op, uint8_t status, uint8_t flags,
+                        uint32_t seq, uint64_t key, uint32_t cmd,
+                        uint32_t version, uint64_t length) {
+  h->magic = kMagic;
+  h->op = op;
+  h->status = status;
+  h->flags = flags;
+  h->seq = htonl(seq);
+  h->key = htobe64(key);
+  h->cmd = htonl(cmd);
+  h->version = htonl(version);
+  h->length = htobe64(length);
+}
+
+// Optional trace-context block (appended after the header when status
+// carries kTraceFlag; `length` still counts only the payload).
+inline void pack_trace(uint8_t out[16], uint64_t trace_id, uint64_t span_id) {
+  uint64_t t = htobe64(trace_id), s = htobe64(span_id);
+  std::memcpy(out, &t, 8);
+  std::memcpy(out + 8, &s, 8);
+}
 
 }  // namespace bps_wire
 

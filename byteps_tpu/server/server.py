@@ -1243,10 +1243,19 @@ class NativePSServer:
                 "NativePSServer responses bypass the shaper (half-shaped link)"
             )
         van = _os.environ.get("BYTEPS_VAN", "tcp")
+        # chaos:<inner> composes with the native engine: the engine
+        # listens on the INNER van and the published address carries the
+        # chaos+ prefix, so dialing workers wrap their side in the fault
+        # layer (comm/chaos.py).  Injection is client-side only — the
+        # C++ response direction stays clean, same one-sidedness the
+        # 2-worker demo uses deliberately (docs/robustness.md).
+        chaos = van.startswith("chaos:")
+        if chaos:
+            van = van[len("chaos:"):]
         if van not in ("tcp", "uds", "shm"):
             raise RuntimeError(
                 f"BYTEPS_VAN={van!r} unknown; native engine speaks "
-                "tcp | uds | shm"
+                "tcp | uds | shm (or chaos:<those>)"
             )
         from byteps_tpu.native import get_lib
 
@@ -1293,14 +1302,35 @@ class NativePSServer:
             self.port = 0
         if self._id < 0:
             raise RuntimeError("bps_native_server_start failed")
+        if chaos:
+            from byteps_tpu.comm.van import CHAOS_PREFIX
+
+            self.host = CHAOS_PREFIX + self.host
         self.rank: Optional[int] = None
         self.num_workers = cfg.num_worker
+        self._live_worker_flags: Optional[set] = None
         self._stop = threading.Event()
         self._sched_conn: Optional[socket.socket] = None
         self._metrics_http = None
         from byteps_tpu.common.config import resolve_node_uid
 
         self.node_uid = resolve_node_uid()
+        # merge the engine's counters into the process scrape surface
+        # (get_robustness_counters / Prometheus families / heartbeat
+        # deltas) so GIL-free runs aren't metrics-blind
+        from byteps_tpu.core.telemetry import counters
+        from byteps_tpu.native import native_server_counters
+
+        sid = self._id
+        self._counters_provider = lambda: native_server_counters(sid)
+        counters().register_provider(self._counters_provider)
+
+    def native_counters(self) -> dict:
+        """This instance's engine-side counters (``native_*`` names) —
+        also merged into :func:`byteps_tpu.get_robustness_counters`."""
+        from byteps_tpu.native import native_server_counters
+
+        return native_server_counters(self._id)
 
     def update_num_workers(self, n: int) -> None:
         """Adopt a resized worker population in the C++ engine (the beat
@@ -1308,10 +1338,21 @@ class NativePSServer:
         self.num_workers = n
         self._lib.bps_native_server_set_num_workers(self._id, n)
 
-    # shared control-loop surface with PSServer (the register helper is
-    # borrowed unbound); the C++ engine has no zombie fence yet, so the
-    # adopted set is informational only
-    _adopt_worker_ranks = PSServer._adopt_worker_ranks
+    def _adopt_worker_ranks(self, book: dict) -> None:
+        """Refresh the zombie fence from a scheduler book, mirrored into
+        the C++ engine (per-push live-rank checks run natively).  Books
+        without a rank list disable the fence, as on the Python server."""
+        PSServer._adopt_worker_ranks(self, book)  # type: ignore[arg-type]
+        import ctypes as _ct
+
+        flags = self._live_worker_flags
+        if flags is None:
+            self._lib.bps_native_server_set_live_workers(self._id, None, -1)
+            return
+        arr = (_ct.c_uint8 * max(1, len(flags)))(*sorted(flags))
+        self._lib.bps_native_server_set_live_workers(
+            self._id, arr, len(flags)
+        )
 
     def start(self, register: bool = True) -> None:
         # scrape surface even with the C++ data plane: the process-global
@@ -1333,6 +1374,12 @@ class NativePSServer:
         if self._metrics_http is not None:
             self._metrics_http.close()
             self._metrics_http = None
+        # freeze the engine's final counter values BEFORE the instance
+        # id disappears, so post-stop snapshots keep everything the
+        # GIL-free plane counted (and a racing scrape can't double-count)
+        from byteps_tpu.core.telemetry import counters
+
+        counters().absorb_provider(self._counters_provider)
         self._lib.bps_native_server_stop(self._id)
         close_socket(self._sched_conn)
 

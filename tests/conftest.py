@@ -85,6 +85,40 @@ def pytest_runtest_protocol(item, nextitem):
         signal.signal(signal.SIGALRM, prev)
 
 
+# --- server-engine selection helpers (native-parity suites) --------------
+#
+# Shared by test_fusion.py / test_resync.py so every suite gates on the
+# SAME symbol: bps_native_server_counters is the newest parity entry
+# point, so a stale pre-parity .so (no compiler to rebuild it) SKIPS the
+# native lanes instead of failing them against an engine that cannot
+# serve FUSED/RESYNC.
+
+
+def have_native_parity_server() -> bool:
+    from byteps_tpu.native import get_lib
+
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "bps_native_server_counters")
+
+
+def require_engine(engine: str) -> None:
+    if engine == "native" and not have_native_parity_server():
+        pytest.skip("native lib (with parity surface) not built")
+
+
+def make_ps_server(engine: str, cfg):
+    """One PS server of the requested engine — the GIL-free C++ data
+    plane speaks the full fused/ledger/resync protocol since the
+    native-parity port, so suites parametrize over both."""
+    if engine == "native":
+        from byteps_tpu.server.server import NativePSServer
+
+        return NativePSServer(cfg)
+    from byteps_tpu.server.server import PSServer
+
+    return PSServer(cfg)
+
+
 @pytest.fixture(autouse=True)
 def _clean_runtime():
     """Reset global runtime state between tests."""

@@ -44,6 +44,9 @@ from byteps_tpu.core.telemetry import counters
 from byteps_tpu.server.server import PSServer
 
 
+from conftest import make_ps_server, require_engine
+
+
 class TestFusedWire:
     def test_push_frame_roundtrip(self):
         members = [
@@ -109,11 +112,17 @@ class TestFusionScheduling:
         assert len(group.context.members) == 2
 
 
-@pytest.fixture
-def fusion_cluster(monkeypatch):
-    """1 worker / 2 servers, fusion enabled (threshold 16KB)."""
+@pytest.fixture(params=["python", "native"])
+def fusion_cluster(request, monkeypatch):
+    """1 worker / 2 servers, fusion enabled (threshold 16KB), over BOTH
+    server engines — the ``native`` param id keeps the conftest
+    native-hang guards armed for those runs."""
+    engine = request.param
+    require_engine(engine)
     monkeypatch.setenv("BYTEPS_FUSION_THRESHOLD", "16384")
     monkeypatch.setenv("BYTEPS_FUSION_CYCLE_MS", "2")
+    if engine == "native":
+        monkeypatch.setenv("BYTEPS_SERVER_NATIVE", "1")
     sched = Scheduler(num_workers=1, num_servers=2, host="127.0.0.1")
     sched.start()
     monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
@@ -121,10 +130,10 @@ def fusion_cluster(monkeypatch):
     monkeypatch.setenv("DMLC_NUM_WORKER", "1")
     monkeypatch.setenv("DMLC_NUM_SERVER", "2")
     monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
-    servers = [PSServer(Config.from_env()) for _ in range(2)]
+    servers = [make_ps_server(engine, Config.from_env()) for _ in range(2)]
     for srv in servers:
         threading.Thread(target=srv.start, daemon=True).start()
-    yield {"scheduler": sched, "servers": servers}
+    yield {"scheduler": sched, "servers": servers, "engine": engine}
     for srv in servers:
         srv.stop()
     sched.stop()
@@ -164,6 +173,14 @@ class TestFusionCluster:
         # 48 unfused keys would cost 96 wire RPCs; fused frames collapse
         # the round trips at least 2×
         assert snap.get("wire_rpc", 0) <= 48, snap
+        if fusion_cluster["engine"] == "native":
+            # the frames really were served by the C++ engine, and its
+            # counters reach the shared scrape surface.  >= not ==: the
+            # server counts every frame UNPACK, so a benign deadline
+            # retransmit (members then deduped) inflates it past the
+            # worker-side pack count
+            assert snap.get("native_fused_frames", 0) >= 1, snap
+            assert snap.get("native_fused_keys", 0) >= 48, snap
         bps.shutdown()
 
     def test_mixed_small_and_large(self, fusion_cluster, monkeypatch):
@@ -236,14 +253,24 @@ class TestFusedFallback:
 
 
 class TestFusedReplayDedupe:
-    def test_resent_fused_frame_never_double_sums(self):
+    @pytest.mark.parametrize("engine", ["python", "native"])
+    def test_resent_fused_frame_never_double_sums(self, engine):
         """Wire-level exactly-once: worker 1 sends a fused frame TWICE
         (the retry case — e.g. its reply was dropped); worker 2 completes
         the rounds with plain pushes.  Every reply must carry the sum of
-        exactly one contribution per worker per key."""
+        exactly one contribution per worker per key — over BOTH server
+        engines (the per-(worker, key) ledger is ported to the C++ data
+        plane)."""
+        require_engine(engine)
         cfg = Config(num_worker=2, num_server=1)
-        srv = PSServer(cfg)
-        srv.start(register=False)
+        if engine == "native":
+            from byteps_tpu.server.server import NativePSServer
+
+            srv = NativePSServer(cfg)  # data plane live on construction
+            base_dedupe = counters().get("native_push_dedup")
+        else:
+            srv = PSServer(cfg)
+            srv.start(register=False)
         KEY_A, KEY_B = 101, 202
         N = 64
         cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
@@ -296,6 +323,12 @@ class TestFusedReplayDedupe:
                     # bitwise equality — a double-summed replay would
                     # show 2×worker-1's contribution
                     np.testing.assert_array_equal(got, sums[key])
+            if engine == "native":
+                # the retried frame's members were suppressed by the C++
+                # engine's ledger (acceptance: native dedupe-hit > 0)
+                assert (
+                    counters().get("native_push_dedup") - base_dedupe >= 2
+                )
             from byteps_tpu.comm.transport import close_socket
 
             close_socket(w1)
@@ -305,12 +338,17 @@ class TestFusedReplayDedupe:
 
 
 class TestFusionChaos:
-    def test_fused_frames_bitwise_exact_under_chaos(self, monkeypatch):
+    @pytest.mark.parametrize("engine", ["python", "native"])
+    def test_fused_frames_bitwise_exact_under_chaos(self, engine, monkeypatch):
         """The acceptance schedule with fusion ON: chaos:tcp, fixed seed,
         5% frame drops — dropped fused frames and dropped fused replies
         are healed by the single per-frame deadline/retry state, and the
         ledger keeps every member key exactly-once (sums stay bitwise
-        equal to the inputs; a double-sum would return 2x)."""
+        equal to the inputs; a double-sum would return 2x).  Runs over
+        BOTH server engines: under ``native`` the chaos layer wraps the
+        worker side of each connection (the C++ listener stays clean —
+        the same one-sidedness the 2-worker demo uses)."""
+        require_engine(engine)
         monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
         monkeypatch.setenv("BYTEPS_CHAOS_SEED", "4242")
         monkeypatch.setenv("BYTEPS_CHAOS_DROP", "0.05")
@@ -332,7 +370,7 @@ class TestFusionChaos:
         monkeypatch.setenv("DMLC_NUM_SERVER", "2")
         monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
         monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.2")
-        servers = [PSServer(Config.from_env()) for _ in range(2)]
+        servers = [make_ps_server(engine, Config.from_env()) for _ in range(2)]
         for srv in servers:
             threading.Thread(target=srv.start, daemon=True).start()
 
@@ -372,6 +410,8 @@ class TestFusionChaos:
             assert snap.get("chaos_drop", 0) > 0, f"no drops injected: {snap}"
             assert snap.get("rpc_retry", 0) > 0, f"no retries observed: {snap}"
             assert snap.get("fused_frames", 0) > 0, f"nothing fused: {snap}"
+            if engine == "native":
+                assert snap.get("native_fused_frames", 0) > 0, snap
         finally:
             bps.shutdown()
             for srv in servers:

@@ -1,0 +1,96 @@
+"""Tier-1 staleness guard for the native library (ISSUE 5 satellite).
+
+``libbyteps_tpu.so`` is a build artifact; the parity tests (fused
+ledger / resync / golden wire fixtures) exercise the C++ code THROUGH
+it, so a stale binary — older than any ``native/*.cc`` / ``wire.h`` —
+could masquerade as a passing port.  This guard rebuilds when any
+source is newer than the binary (skipped cleanly when no compiler is
+available) and asserts the loaded surface exposes the newest entry
+points, which catches the mtime-lies case (checkouts that flatten
+timestamps) too.
+
+Named ``test_native_build`` so the conftest native-hang guards arm for
+it like every other native-lane test.
+"""
+
+import ctypes
+import glob
+import os
+import shutil
+import subprocess
+import tempfile
+
+import pytest
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "byteps_tpu", "native",
+)
+_SO = os.path.join(_NATIVE_DIR, "libbyteps_tpu.so")
+
+#: the newest extern "C" surface — extend when the ABI grows, so an old
+#: binary can never satisfy this guard
+_REQUIRED_SYMBOLS = (
+    "bps_native_server_start",
+    "bps_native_server_start_unix",
+    "bps_native_server_counters",
+    "bps_native_server_set_live_workers",
+    "bps_wire_golden",
+    "bps_wire_fused_echo",
+    "bps_wire_resync_echo",
+    "bpsc_create",
+    "bpsc_drain",
+)
+
+
+def _sources():
+    return sorted(
+        glob.glob(os.path.join(_NATIVE_DIR, "*.cc"))
+        + [os.path.join(_NATIVE_DIR, "wire.h")]
+    )
+
+
+def _have_compiler() -> bool:
+    cxx = os.environ.get("CXX", "g++").split()[0]
+    return shutil.which(cxx) is not None
+
+
+def test_native_so_not_stale():
+    srcs = _sources()
+    assert srcs, "native sources missing"
+    newest_src = max(os.path.getmtime(p) for p in srcs)
+    stale = not os.path.exists(_SO) or os.path.getmtime(_SO) < newest_src
+    if stale:
+        if not _have_compiler():
+            pytest.skip("native lib stale but no C++ compiler available")
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "-s"],
+            check=True, capture_output=True, timeout=300,
+        )
+    assert os.path.exists(_SO), "native build produced no library"
+    assert os.path.getmtime(_SO) >= newest_src, (
+        "libbyteps_tpu.so is older than the native sources — the parity "
+        "tests would exercise a stale binary"
+    )
+
+
+def test_native_so_exposes_parity_surface():
+    if not os.path.exists(_SO):
+        pytest.skip("native lib not built (no compiler)")
+    # load a temp COPY: dlopen dedups by path/inode, and the process may
+    # already hold a pre-rebuild mapping of the canonical path
+    tmp = tempfile.NamedTemporaryFile(
+        suffix=".so", prefix="libbyteps_tpu_guard_", delete=False
+    )
+    tmp.close()
+    try:
+        shutil.copy(_SO, tmp.name)
+        lib = ctypes.CDLL(tmp.name)
+        missing = [s for s in _REQUIRED_SYMBOLS if not hasattr(lib, s)]
+        assert not missing, (
+            f"stale libbyteps_tpu.so: missing {missing} — run "
+            "`make -C byteps_tpu/native` (or let the autobuild run with "
+            "a compiler present)"
+        )
+    finally:
+        os.unlink(tmp.name)

@@ -380,9 +380,16 @@ class TestHealInPlace:
         }.items():
             monkeypatch.setenv(k, v)
 
-    def test_one_sided_giveup_heals_in_place(self, monkeypatch):
+    @pytest.mark.parametrize("engine", ["python", "native"])
+    def test_one_sided_giveup_heals_in_place(self, engine, monkeypatch):
+        """Runs over BOTH server engines: the C++ data plane answers
+        Op.RESYNC_QUERY from its own exactly-once ledger since the
+        native-parity port — a give-up against a live native server
+        heals in place with no re-init barrier, exactly like the Python
+        engine (the ``native`` param id arms the conftest hang guards)."""
         from byteps_tpu.comm.rendezvous import Scheduler
 
+        require_engine(engine)
         monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
         monkeypatch.setenv("BYTEPS_CHAOS_SEED", "5")
         monkeypatch.setenv("BYTEPS_CHAOS_DROP", "1.0")
@@ -396,7 +403,7 @@ class TestHealInPlace:
         sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
         sched.start()
         self._cluster_env(monkeypatch, sched.port)
-        srv = PSServer(Config.from_env())
+        srv = make_ps_server(engine, Config.from_env())
         threading.Thread(target=srv.start, daemon=True).start()
 
         import byteps_tpu as bps
@@ -415,7 +422,11 @@ class TestHealInPlace:
             # the dropped push was never absorbed: exactly one journaled
             # round replayed, and the re-issued original push deduped
             assert snap.get("resync_replayed_rounds", 0) == 1, snap
-            assert snap.get("push_dedup", 0) >= 1, snap
+            dedupe = "native_push_dedup" if engine == "native" else "push_dedup"
+            assert snap.get(dedupe, 0) >= 1, snap
+            if engine == "native":
+                # the query really was served by the C++ ledger
+                assert snap.get("native_resync_query", 0) >= 1, snap
             assert snap.get("resync_giveup", 0) == 0, snap
             # the whole point: the step never failed, nothing re-inited
             assert snap.get("rpc_giveup", 0) == 0, snap
@@ -529,33 +540,154 @@ class TestHealInPlace:
             _reset_chaos_budget()
 
 
-def _have_native() -> bool:
-    from byteps_tpu.native import get_lib
+from conftest import have_native_parity_server, make_ps_server, require_engine
 
-    lib = get_lib()
-    return lib is not None and hasattr(lib, "bps_native_server_start_unix")
+
+def _have_native() -> bool:
+    # gate on the PARITY surface, not the pre-parity start symbol: a
+    # stale .so (no compiler to rebuild) must SKIP the native lanes, not
+    # fail them against an engine that cannot serve FUSED/RESYNC
+    return have_native_parity_server()
 
 
 @pytest.mark.skipif(not _have_native(), reason="native lib not built")
 class TestNativeResyncInterop:
-    """Old-decoder interop: the C++ engine must reject RESYNC frames
-    CLEANLY — nonzero status echoing op+seq (log-once), stream stays
-    framed — so a healing worker falls back instead of hanging."""
+    """Native-parity port (replaces the old clean-rejection interop):
+    the C++ engine answers RESYNC frames from its own exactly-once
+    ledger, acks a replayed post-release INIT from its token record, and
+    still rejects genuinely unknown ops cleanly (stream stays framed)."""
 
-    def test_native_server_rejects_resync_and_stays_framed(self, monkeypatch):
+    def test_native_server_answers_resync_from_ledger(self, monkeypatch):
+        """Wire-level heal against the C++ engine, mirroring the Python
+        TestReplayBitwise flow: a worker whose round-1 push was 'lost'
+        queries the ledger, sees seen=0, replays from its journal, and
+        the peer's parked pull answers with the fault-free sum."""
+        from byteps_tpu.server.server import NativePSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "uds")
+        srv = NativePSServer(Config(num_worker=2, num_server=1))
+        KEY, N = 11, 64
+        g1 = np.arange(N, dtype=np.float32)
+        g2 = np.full(N, 0.5, dtype=np.float32)
+        try:
+            w1 = connect(srv.host, srv.port)
+            w2 = connect(srv.host, srv.port)
+            for s in (w1, w2):
+                s.settimeout(15)
+            _init_key([(w1, 1), (w2, 2)], KEY, N)
+            journal = RoundJournal(max_rounds=2, max_bytes=1 << 20)
+            journal.record(KEY, 1, CMD_F32, g2.tobytes())
+            send_message(w1, Message(Op.PUSH, key=KEY, seq=1, flags=1,
+                                     cmd=CMD_F32, version=1,
+                                     payload=g1.tobytes()))
+            assert recv_message(w1).op == Op.PUSH
+            send_message(w1, Message(Op.PULL, key=KEY, seq=2, cmd=CMD_F32,
+                                     version=1))
+            # worker 2 heals: query → the C++ ledger reports seen=0
+            send_message(w2, Message(Op.RESYNC_QUERY, key=KEY, seq=3, flags=2,
+                                     payload=encode_resync_query(2, [KEY])))
+            resp = recv_message(w2)
+            assert resp.op == Op.RESYNC_STATE and resp.status == 0
+            state = decode_resync_state(resp.payload)
+            assert state[KEY]["seen"] == 0
+            assert state[KEY]["store_version"] == 0
+            for e in journal.entries_after(KEY, state[KEY]["seen"]):
+                send_message(w2, Message(Op.PUSH, key=KEY, seq=4, flags=2,
+                                         cmd=e.cmd, version=e.version,
+                                         payload=e.payload))
+                assert recv_message(w2).op == Op.PUSH
+            # the round published: worker 1's parked pull answers with
+            # EXACTLY the fault-free sum
+            reply = recv_message(w1)
+            assert reply.op == Op.PULL
+            np.testing.assert_array_equal(
+                np.frombuffer(reply.payload, dtype=np.float32), g1 + g2
+            )
+            # replaying AGAIN dedupes (exactly-once): the sum cannot move
+            send_message(w2, Message(Op.PUSH, key=KEY, seq=5, flags=2,
+                                     cmd=CMD_F32, version=1,
+                                     payload=g2.tobytes()))
+            assert recv_message(w2).op == Op.PUSH
+            send_message(w2, Message(Op.PULL, key=KEY, seq=6, cmd=CMD_F32,
+                                     version=1))
+            np.testing.assert_array_equal(
+                np.frombuffer(recv_message(w2).payload, dtype=np.float32),
+                g1 + g2,
+            )
+            assert srv.native_counters().get("native_push_dedup", 0) >= 1
+            assert srv.native_counters().get("native_resync_query", 0) == 1
+            close_socket(w1)
+            close_socket(w2)
+        finally:
+            srv.stop()
+
+    def test_native_post_release_init_replay_acked(self, monkeypatch):
+        """A replayed INIT (same token) after the barrier released is
+        acked from the C++ token record — the dropped-ack strand is
+        fixed for BYTEPS_SERVER_NATIVE=1 runs too.  A FRESH token still
+        parks (genuine new barrier)."""
+        import socket as _socket
+
+        from byteps_tpu.server.server import NativePSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "uds")
+        srv = NativePSServer(Config(num_worker=2, num_server=1))
+        KEY, N = 31, 16
+        TOK1, TOK2 = 0xA0001, 0xB0001
+        try:
+            w1 = connect(srv.host, srv.port)
+            w2 = connect(srv.host, srv.port)
+            for s in (w1, w2):
+                s.settimeout(15)
+            _init_key([(w1, 1), (w2, 2)], KEY, N, tokens=[TOK1, TOK2])
+            # worker 1 "lost" its ack: the SAME-token retry must be acked
+            # immediately (pre-port the native engine re-parked it)
+            send_message(w1, Message(
+                Op.INIT, key=KEY, seq=7, flags=1, version=TOK1,
+                payload=struct.pack("!QI", N, int(DataType.FLOAT32)),
+            ))
+            ack = recv_message(w1)
+            assert ack.op == Op.INIT and ack.seq == 7
+            assert srv.native_counters().get("native_init_replay_ack") == 1
+            # a FRESH token is a genuine new barrier: it parks
+            send_message(w1, Message(
+                Op.INIT, key=KEY, seq=8, flags=1, version=TOK1 + 1,
+                payload=struct.pack("!QI", N, int(DataType.FLOAT32)),
+            ))
+            w1.settimeout(1.0)
+            with pytest.raises((TimeoutError, _socket.timeout, OSError)):
+                recv_message(w1)
+            w1.settimeout(15)
+            send_message(w2, Message(
+                Op.INIT, key=KEY, seq=9, flags=2, version=TOK2 + 1,
+                payload=struct.pack("!QI", N, int(DataType.FLOAT32)),
+            ))
+            assert recv_message(w1).op == Op.INIT
+            assert recv_message(w2).op == Op.INIT
+            close_socket(w1)
+            close_socket(w2)
+        finally:
+            srv.stop()
+
+    def test_native_unknown_op_rejected_cleanly(self, monkeypatch):
+        """Ops NEWER than the engine speaks still get the clean nonzero-
+        status rejection (op+seq echoed, stream stays framed) — the
+        forward-compat contract the old RESYNC rejection exercised."""
+        from byteps_tpu.comm.transport import HEADER_FMT, _recv_exact
         from byteps_tpu.server.server import NativePSServer
 
         monkeypatch.setenv("BYTEPS_VAN", "uds")
         srv = NativePSServer(Config(num_worker=1, num_server=1))
         try:
             sock = connect(srv.host, srv.port)
-            send_message(sock, Message(
-                Op.RESYNC_QUERY, key=3, seq=1, flags=1,
-                payload=encode_resync_query(1, [3]),
-            ))
-            resp = recv_message(sock)
-            assert resp.op == Op.RESYNC_QUERY and resp.seq == 1
-            assert resp.status != 0  # rejected, not swallowed
+            sock.settimeout(15)
+            send_message(sock, Message(99, key=3, seq=1, payload=b"future"))
+            hdr = _recv_exact(sock, struct.calcsize(HEADER_FMT))
+            _magic, op, status, _f, seq, _k, _c, _v, length = struct.unpack(
+                HEADER_FMT, hdr
+            )
+            assert (op, seq, length) == (99, 1, 0)
+            assert status != 0  # rejected, not swallowed
             # the stream never desynced: a normal round still works
             x = np.arange(8, dtype=np.float32)
             send_message(sock, Message(
@@ -603,12 +735,17 @@ class TestTwoWorkerDemo:
     never blocks or re-inits; every pulled tensor on BOTH workers is
     bitwise identical to the fault-free run."""
 
-    def test_victim_heals_in_place_peer_never_blocks(self, monkeypatch):
+    @pytest.mark.parametrize("engine", ["python", "native"])
+    def test_victim_heals_in_place_peer_never_blocks(self, engine,
+                                                     monkeypatch):
         from byteps_tpu.comm.rendezvous import Scheduler
 
+        require_engine(engine)
         # parent (scheduler + server): chaos van selected but ZERO fault
         # probabilities — response lanes stay clean; each worker
-        # subprocess brings its own fault env
+        # subprocess brings its own fault env.  Under ``native`` the
+        # victim heals against the LIVE C++ engine's ledger while its
+        # peer keeps pulling — the acceptance shape for the parity port.
         monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
         monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.2")
         monkeypatch.setenv("DMLC_NUM_WORKER", "2")
@@ -617,7 +754,7 @@ class TestTwoWorkerDemo:
         sched.start()
         monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
         monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
-        srv = PSServer(Config.from_env())
+        srv = make_ps_server(engine, Config.from_env())
         threading.Thread(target=srv.start, daemon=True).start()
 
         base_env = {

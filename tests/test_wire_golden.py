@@ -1,0 +1,172 @@
+"""Golden wire-frame fixtures: byte-exact encoded vectors for
+PUSH/PULL/INIT/FUSED/RESYNC — with and without the 16-byte trace block —
+asserted against BOTH the Python framing (comm/transport.py) and the C++
+codec (native/wire.h pack_header + the ps_server.cc fused/resync
+encoders/decoders, via the bps_wire_* shims), so the two implementations
+can never drift silently.
+
+Three anchors per fixture set:
+
+- transport.py builds the frames;
+- the C++ shim builds the same frames through the LIVE engine code paths
+  (pack_header is the one header encoder ps_server.cc send_msg and
+  ps_client.cc bpsc_send go through);
+- a frozen hex digest pins both to the wire format as SHIPPED — a
+  same-bug-on-both-sides refactor still fails the test.
+"""
+
+import ctypes
+import hashlib
+import struct
+
+import pytest
+
+from byteps_tpu.comm.transport import (
+    Message,
+    Op,
+    decode_fused_push,
+    decode_resync_query,
+    encode_fused_push,
+    encode_fused_reply,
+    encode_resync_query,
+    encode_resync_state,
+)
+
+
+def _lib():
+    from byteps_tpu.native import get_lib
+
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bps_wire_golden"):
+        return None
+    return lib
+
+
+pytestmark = pytest.mark.skipif(
+    _lib() is None, reason="native lib (with wire shims) not built"
+)
+
+#: sha256 of the fixture byte stream as frozen at the native-parity port —
+#: pins BOTH codecs to the shipped wire format, not merely to each other
+GOLDEN_SHA256 = "29ef1635893fd36ae7520635c170429cca14e201d34710f955ed0fb6950de145"
+
+
+def python_golden_frames() -> bytes:
+    """The fixture stream, built by transport.py.  Mirrors the fixture
+    list in ps_server.cc bps_wire_golden — change both together (the
+    frozen digest will catch a one-sided edit)."""
+    out = b""
+    # A: plain PUSH, payload bytes 0..7
+    out += Message(Op.PUSH, key=42, payload=bytes(range(8)), seq=7, cmd=6,
+                   version=3, flags=1).encode()
+    # B: the same PUSH carrying trace context (status bit 7 + 16 bytes)
+    out += Message(Op.PUSH, key=42, payload=bytes(range(8)), seq=7, cmd=6,
+                   version=3, flags=1,
+                   trace=(0x1122334455667788, 0x99AABBCCDDEEFF00)).encode()
+    # C: PULL request (empty payload)
+    out += Message(Op.PULL, key=42, seq=8, cmd=6, version=3).encode()
+    # D: INIT carrying an idempotency token in ``version``
+    out += Message(Op.INIT, key=43, seq=9, flags=2, version=0xA0001,
+                   payload=struct.pack("!QI", 32, 0)).encode()
+    # E: a FUSED multi-key reply (one empty member payload)
+    fused = encode_fused_reply([(101, 1, b"wxyz"), (202, 2, b"")])
+    out += Message(Op.FUSED, key=101, seq=10, payload=fused).encode()
+    # F: a RESYNC_STATE ledger snapshot (two keys)
+    state = encode_resync_state({
+        5: {"store_version": 4, "seen": 3, "recv_count": 1, "init": True},
+        9: {"store_version": 0, "seen": 0, "recv_count": 0, "init": True},
+    })
+    out += Message(Op.RESYNC_STATE, key=5, seq=11, payload=state).encode()
+    return out
+
+
+def native_golden_frames() -> bytes:
+    lib = _lib()
+    buf = (ctypes.c_uint8 * 8192)()
+    n = lib.bps_wire_golden(buf, len(buf))
+    assert n > 0, f"bps_wire_golden failed: {n}"
+    return bytes(buf[:n])
+
+
+class TestGoldenFrames:
+    def test_native_codec_matches_python(self):
+        py = python_golden_frames()
+        cc = native_golden_frames()
+        assert py == cc, (
+            "C++ and Python wire encodings diverged "
+            f"(first diff at byte {next(i for i, (a, b) in enumerate(zip(py, cc)) if a != b) if py[:min(len(py), len(cc))] != cc[:min(len(py), len(cc))] else min(len(py), len(cc))})"
+        )
+
+    def test_frames_match_frozen_digest(self):
+        digest = hashlib.sha256(python_golden_frames()).hexdigest()
+        assert digest == GOLDEN_SHA256, (
+            "the wire format changed — if that is intentional, this is a "
+            "PROTOCOL revision: update GOLDEN_SHA256 and audit every "
+            "decoder (Python AND C++) for compatibility"
+        )
+
+
+def _fused_echo(body: bytes) -> bytes:
+    lib = _lib()
+    out = (ctypes.c_uint8 * (len(body) + 64))()
+    n = lib.bps_wire_fused_echo(body, len(body), out, len(out))
+    assert n >= 0, f"native fused decode failed: {n}"
+    return bytes(out[:n])
+
+
+class TestFusedDecodeParity:
+    MEMBERS = [
+        (101, 6, 1, b"abcd"),
+        (1 << 40, 0, 9, b""),
+        (202, 11, 2, bytes(range(64))),
+    ]
+
+    def test_native_decodes_python_frames(self):
+        body = encode_fused_push(self.MEMBERS)
+        assert _fused_echo(body) == body
+        assert decode_fused_push(body) == self.MEMBERS
+
+    def test_native_ignores_span_trailer(self):
+        """The optional member-span trailer (tracing) must be invisible
+        to the decoder — old-decoder compatibility, transport.py
+        contract."""
+        with_trailer = encode_fused_push(self.MEMBERS, span_ids=[7, 8, 9])
+        without = encode_fused_push(self.MEMBERS)
+        assert _fused_echo(with_trailer) == without
+
+    def test_native_rejects_truncated_frame(self):
+        lib = _lib()
+        body = encode_fused_push(self.MEMBERS)[:-3]
+        out = (ctypes.c_uint8 * 1024)()
+        assert lib.bps_wire_fused_echo(body, len(body), out, 1024) == -1
+
+    def test_native_rejects_empty_frame(self):
+        lib = _lib()
+        body = struct.pack("!I", 0)
+        out = (ctypes.c_uint8 * 16)()
+        assert lib.bps_wire_fused_echo(body, len(body), out, 16) == -1
+
+
+def _resync_echo(body: bytes):
+    lib = _lib()
+    out = (ctypes.c_uint8 * 4096)()
+    n = lib.bps_wire_resync_echo(body, len(body), out, len(out))
+    if n < 0:
+        return None
+    return bytes(out[:n]).decode()
+
+
+class TestResyncDecodeParity:
+    def test_native_parses_python_query(self):
+        body = encode_resync_query(3, [7, 9, 1 << 40])
+        assert _resync_echo(body) == f"3|7,9,{1 << 40}"
+        assert decode_resync_query(body) == (3, [7, 9, 1 << 40])
+
+    def test_native_parses_empty_keys_as_all(self):
+        assert _resync_echo(encode_resync_query(1, [])) == "1|"
+
+    def test_native_rejects_non_object_body(self):
+        # same malformed body the Python decoder raises ValueError on
+        with pytest.raises(ValueError):
+            decode_resync_query(b"[1, 2, 3]")
+        assert _resync_echo(b"[1, 2, 3]") is None

@@ -11,6 +11,7 @@ multi-key Op.FUSED frames.
     python tools/fusion_bench.py [--keys 512] [--bytes 4096] [--steps 10]
                                  [--threshold 16384] [--delay-ms 0.1]
                                  [--rate-mbps 0] [--chaos]
+                                 [--engine python|native]
                                  [--out FUSION_BENCH.json]
 
 Runs the SAME deterministic workload twice — BYTEPS_FUSION_THRESHOLD=0
@@ -20,8 +21,15 @@ and step-latency stats plus the fused/unfused ratios.  ``--chaos`` adds
 a third+fourth run under the deterministic chaos schedule (fixed seed,
 5% frame drops) and asserts bitwise equality there too.
 
+``--engine native`` runs the A/B against the GIL-free C++ server engine
+(BYTEPS_SERVER_NATIVE=1 — protocol-complete since the native-parity
+port, Op.FUSED included) and merges its rows under a top-level
+``"native"`` key of the SAME artifact, so FUSION_BENCH.json carries the
+Python/native A/B side by side.
+
 Acceptance (ISSUE 2): rpc_reduction ≥ 2× and speedup ≥ 1.3× on the
-default workload.
+default workload.  (ISSUE 5): the native-engine fused run matches the
+Python engine's wire-RPC reduction and is ≥ its fused throughput.
 """
 
 import argparse
@@ -50,13 +58,14 @@ def _reset_runtime() -> None:
 
 
 def run_mode(threshold: int, keys: int, nbytes: int, steps: int,
-             delay_ms: float, rate_mbps: float, chaos: bool) -> dict:
+             delay_ms: float, rate_mbps: float, chaos: bool,
+             engine: str = "python") -> dict:
     """One full cluster bring-up → timed steps → teardown; returns stats
     plus the final step's results for cross-mode bitwise comparison."""
     from byteps_tpu.common.config import Config
     from byteps_tpu.comm.rendezvous import Scheduler
     from byteps_tpu.core.telemetry import counters
-    from byteps_tpu.server.server import PSServer
+    from byteps_tpu.server.server import NativePSServer, PSServer
 
     os.environ["BYTEPS_FUSION_THRESHOLD"] = str(threshold)
     os.environ["BYTEPS_FUSION_CYCLE_MS"] = "2"
@@ -86,7 +95,17 @@ def run_mode(threshold: int, keys: int, nbytes: int, steps: int,
         "DMLC_NUM_SERVER": "1",
         "BYTEPS_FORCE_DISTRIBUTED": "1",
     })
-    srv = PSServer(Config.from_env())
+    if engine == "native":
+        # GIL-free C++ data plane (protocol-complete: Op.FUSED, the
+        # exactly-once ledger, RESYNC).  Note: with link shaping on
+        # (--delay-ms/--rate-mbps) the native engine's RESPONSE direction
+        # bypasses the shaper — the within-engine A/B stays fair, the
+        # cross-engine latency comparison carries that caveat.
+        os.environ["BYTEPS_SERVER_NATIVE"] = "1"
+        srv = NativePSServer(Config.from_env())
+    else:
+        os.environ.pop("BYTEPS_SERVER_NATIVE", None)
+        srv = PSServer(Config.from_env())
     threading.Thread(target=srv.start, daemon=True).start()
 
     import byteps_tpu as bps
@@ -125,6 +144,7 @@ def run_mode(threshold: int, keys: int, nbytes: int, steps: int,
         sched.stop()
     lat.sort()
     return {
+        "engine": engine,
         "threshold": threshold,
         "chaos": chaos,
         "steps": steps,
@@ -132,6 +152,9 @@ def run_mode(threshold: int, keys: int, nbytes: int, steps: int,
         "wire_rpcs_per_step": snap.get("wire_rpc", 0) / steps,
         "fused_frames": snap.get("fused_frames", 0),
         "fused_keys": snap.get("fused_keys", 0),
+        # C++-engine-side confirmation (0 under the Python engine): the
+        # frames were actually unpacked by the GIL-free data plane
+        "native_fused_frames": snap.get("native_fused_frames", 0),
         "rpc_retry": snap.get("rpc_retry", 0),
         "flush_full": snap.get("fusion_flush_full", 0),
         "flush_idle": snap.get("fusion_flush_idle", 0),
@@ -170,30 +193,37 @@ def main() -> None:
                     help="shaped-link bandwidth (0 = unlimited)")
     ap.add_argument("--chaos", action="store_true",
                     help="also compare under the deterministic chaos schedule")
+    ap.add_argument("--engine", choices=("python", "native"),
+                    default="python",
+                    help="server engine for the A/B (native = the "
+                         "GIL-free C++ data plane, BYTEPS_SERVER_NATIVE=1)")
     ap.add_argument("--out", default="FUSION_BENCH.json")
     args = ap.parse_args()
 
     modes = {}
     modes["unfused"] = run_mode(0, args.keys, args.bytes, args.steps,
-                                args.delay_ms, args.rate_mbps, False)
+                                args.delay_ms, args.rate_mbps, False,
+                                args.engine)
     modes["fused"] = run_mode(args.threshold, args.keys, args.bytes,
                               args.steps, args.delay_ms, args.rate_mbps,
-                              False)
+                              False, args.engine)
     report = {
         "workload": {
             "keys": args.keys, "bytes_per_key": args.bytes,
             "steps": args.steps, "threshold": args.threshold,
             "delay_ms": args.delay_ms, "rate_mbps": args.rate_mbps,
+            "engine": args.engine,
         },
         "clean": compare(modes["unfused"], modes["fused"]),
     }
     if args.chaos:
         modes["unfused_chaos"] = run_mode(0, args.keys, args.bytes,
                                           args.steps, args.delay_ms,
-                                          args.rate_mbps, True)
+                                          args.rate_mbps, True, args.engine)
         modes["fused_chaos"] = run_mode(args.threshold, args.keys,
                                         args.bytes, args.steps,
-                                        args.delay_ms, args.rate_mbps, True)
+                                        args.delay_ms, args.rate_mbps, True,
+                                        args.engine)
         report["chaos"] = compare(modes["unfused_chaos"],
                                   modes["fused_chaos"])
     for name, m in modes.items():
@@ -203,6 +233,58 @@ def main() -> None:
         "rpc_reduction_ge_2x": report["clean"]["rpc_reduction"] >= 2.0,
         "speedup_ge_1_3x": report["clean"]["speedup"] >= 1.3,
     }
+
+    # The artifact carries BOTH engines' A/B: a python-engine run owns
+    # the top level (preserving any existing "native" row), a
+    # native-engine run lands under "native" (preserving the top level)
+    # with a cross-engine comparison against the python rows.
+    existing = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+        except (ValueError, OSError):
+            existing = {}
+
+    def same_workload(a: dict, b: dict) -> bool:
+        """Cross-engine ratios are only meaningful on the SAME workload
+        — compare everything but the engine field."""
+        strip = lambda w: {k: v for k, v in (w or {}).items() if k != "engine"}
+        return strip(a) == strip(b)
+
+    if args.engine == "native":
+        merged = existing or {}
+        merged["native"] = report
+        if ("fused" in merged and "clean" in merged
+                and same_workload(merged.get("workload"),
+                                  report["workload"])):
+            py_fused = merged["fused"]
+            merged["native"]["vs_python"] = {
+                "rpc_reduction_matches": bool(
+                    report["clean"]["rpc_reduction"]
+                    >= 0.9 * merged["clean"]["rpc_reduction"]
+                ),
+                "fused_steps_per_s_ratio": (
+                    report["fused"]["steps_per_s"]
+                    / max(1e-9, py_fused["steps_per_s"])
+                ),
+                # with link shaping on, native responses bypass the
+                # shaper — the latency edge includes ~delay_ms per pull
+                "note": "native response direction is unshaped under "
+                        "--delay-ms/--rate-mbps",
+            }
+        report = merged
+    else:
+        if "native" in existing:
+            native = dict(existing["native"])
+            # the top-level python rows this block's vs_python cited are
+            # being replaced — keep the ratios only if this rerun used
+            # the identical workload, else they'd cite numbers no longer
+            # in the file
+            if not same_workload(native.get("workload"),
+                                 report["workload"]):
+                native.pop("vs_python", None)
+            report["native"] = native
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report, indent=2))
