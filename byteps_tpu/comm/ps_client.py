@@ -221,6 +221,21 @@ class _NativeServerConn:
                 f"native client connect failed: {host}:{port}"
             )
         self._h: Optional[int] = h
+        #: trace-context-aware send (None on a stale .so: trace context
+        #: is then silently dropped, the pre-parity behavior)
+        self._send2 = getattr(lib, "bpsc_send2", None)
+        # the lanes' per-attempt round-trip histogram
+        # (native_rpc_round_trip_seconds, measured send syscall →
+        # completion enqueue with no ctypes/drain batching in the
+        # number) merges into the process registry through the
+        # histogram-provider seam (docs/observability.md)
+        self._hist_provider = None
+        if self._send2 is not None:
+            from byteps_tpu.core.telemetry import metrics
+            from byteps_tpu.native import native_client_histograms
+
+            self._hist_provider = lambda: native_client_histograms(h)
+            metrics().register_hist_provider(self._hist_provider)
         # batched-delivery buffers (bpsc_drain): a record array + payload
         # arena reused across drains; the doorbell handler is serialized
         # by _drain_lock so concurrent lane doorbells can't share them
@@ -378,10 +393,19 @@ class _NativeServerConn:
             h = self._h
         if h is None:
             raise ConnectionError("native connection closed")
-        rc = self._lib.bpsc_send(
-            h, int(msg.op), msg.seq, msg.key, msg.cmd, msg.version,
-            msg.flags, ptr, n,
-        )
+        if msg.trace is not None and self._send2 is not None:
+            # the (trace_id, span_id) context rides the TRACE_FLAG wire
+            # block exactly as the Python transport emits it, so server
+            # child spans join worker spans over the native client too
+            rc = self._send2(
+                h, int(msg.op), msg.seq, msg.key, msg.cmd, msg.version,
+                msg.flags, ptr, n, msg.trace[0], msg.trace[1],
+            )
+        else:
+            rc = self._lib.bpsc_send(
+                h, int(msg.op), msg.seq, msg.key, msg.cmd, msg.version,
+                msg.flags, ptr, n,
+            )
         if rc != 0:
             raise ConnectionError("server connection lost (native send)")
 
@@ -391,6 +415,13 @@ class _NativeServerConn:
         return entry[0] if entry is not None else None
 
     def close_all(self) -> None:
+        if self._hist_provider is not None:
+            # fold the lanes' final latency totals into the registry
+            # WHILE the handle still resolves (bpsc_close erases it)
+            from byteps_tpu.core.telemetry import metrics
+
+            metrics().absorb_hist_provider(self._hist_provider)
+            self._hist_provider = None
         with self._lock:
             h, self._h = self._h, None
         if h is not None:

@@ -272,6 +272,13 @@ LATENCY_BUCKETS: Tuple[float, ...] = (
 #: pack-density buckets (member counts per fused frame)
 COUNT_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
+#: request-size buckets (bytes) — MUST match native/hist.h kSizeBounds
+#: (the native engine's per-key request-size histograms merge into the
+#: same family, and bucket-merge needs identical bounds)
+SIZE_BUCKETS: Tuple[float, ...] = (
+    64, 256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216,
+)
+
 
 class Histogram:
     """Fixed-bucket histogram with cheap percentile snapshots.
@@ -319,21 +326,9 @@ class Histogram:
 
     def percentile(self, q: float) -> float:
         """q in [0, 1]; 0.0 on an empty histogram."""
-        snap = self.snapshot()
-        total = snap["count"]
-        if total == 0:
-            return 0.0
-        rank = q * total
-        prev_le, prev_cum = 0.0, 0
-        for le, cum in snap["buckets"]:
-            if cum >= rank and cum > prev_cum:
-                if le == float("inf"):
-                    return self.bounds[-1] if self.bounds else prev_le
-                span = cum - prev_cum
-                frac = (rank - prev_cum) / span if span else 1.0
-                return prev_le + (le - prev_le) * min(1.0, max(0.0, frac))
-            prev_le, prev_cum = (0.0 if le == float("inf") else le), cum
-        return self.bounds[-1] if self.bounds else 0.0
+        with self._lock:
+            counts = list(self._counts)
+        return _state_percentile(self.bounds, counts, q)
 
     def merge_counts(self, bucket_counts: List[int], vsum: float,
                      count: int) -> None:
@@ -358,6 +353,30 @@ class Histogram:
             return list(self._counts), self._sum, self._count
 
 
+def _state_percentile(bounds, counts, q: float) -> float:
+    """Linear-interpolated percentile of a raw (bounds, per-bucket
+    counts) state — the ONE interpolation both Histogram.percentile and
+    the combined local+provider read path (MetricsRegistry._hist_states)
+    use, operating directly on the state so a scrape never builds
+    throwaway Histogram objects."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    prev_le, prev_cum, cum = 0.0, 0, 0
+    for i, c in enumerate(counts):
+        le = bounds[i] if i < len(bounds) else float("inf")
+        cum += int(c)
+        if cum >= rank and cum > prev_cum:
+            if le == float("inf"):
+                return bounds[-1] if bounds else prev_le
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span else 1.0
+            return prev_le + (le - prev_le) * min(1.0, max(0.0, frac))
+        prev_le, prev_cum = (0.0 if le == float("inf") else le), cum
+    return bounds[-1] if bounds else 0.0
+
+
 class MetricsRegistry:
     """Counters + gauges + histograms behind one scrape surface.
 
@@ -373,6 +392,11 @@ class MetricsRegistry:
         self.counters = counter_store if counter_store is not None else RobustnessCounters()
         self._lock = threading.Lock()
         self._hists: Dict[Tuple[str, tuple], Histogram] = {}
+        # Histogram providers (docs/observability.md) — the twin of the
+        # counter-provider seam in RobustnessCounters: zero-arg callables
+        # returning raw-bucket records, merged into every read surface.
+        # id → (fn, baseline captured at reset())
+        self._hist_providers: Dict[int, tuple] = {}
         self._gauges: Dict[str, float] = {}
         self._gauge_fns: Dict[str, Callable[[], float]] = {}
         # delta baseline for heartbeat piggyback.  Normally one consumer
@@ -410,11 +434,158 @@ class MetricsRegistry:
         with self._lock:
             self._gauge_fns[name] = fn
 
+    # --- histogram providers (native C++ engines) ------------------------
+
+    def register_hist_provider(self, fn) -> None:
+        """Merge an external histogram source into every read surface —
+        the histogram twin of ``RobustnessCounters.register_provider``
+        (docs/observability.md): how the GIL-free C++ engines' fixed-
+        bucket histograms (the ``native_*`` families) reach
+        ``get_metrics()``, the Prometheus exposition, and the heartbeat
+        cluster aggregate without the data plane ever calling into
+        Python.
+
+        ``fn`` is a zero-arg callable returning an iterable of records
+        ``{"name", "labels", "le", "b", "sum", "count"}`` where ``b``
+        holds RAW (non-cumulative) per-bucket counts INCLUDING the +Inf
+        slot (``len(b) == len(le) + 1``).  Bounds must match the Python
+        family's buckets for the merge to compose.  ``fn`` must be
+        cheap (a ctypes read + small JSON parse), and tolerate being
+        called after its source stopped (return []).  A baseline is
+        captured at :meth:`reset` so test-style reset semantics hold
+        even though native histograms are never cleared."""
+        with self._lock:
+            self._hist_providers[id(fn)] = (fn, {})
+
+    def unregister_hist_provider(self, fn) -> None:
+        with self._lock:
+            self._hist_providers.pop(id(fn), None)
+
+    def absorb_hist_provider(self, fn) -> None:
+        """Fold a provider's final values (above its reset baseline)
+        into local histograms and unregister it — called before the
+        provider's source is torn down (native server/client stop) so
+        totals survive.  The combined totals are unchanged by the fold,
+        so heartbeat deltas stay continuous across the absorb."""
+        with self._lock:
+            entry = self._hist_providers.pop(id(fn), None)
+        if entry is None:
+            return
+        fn_live, base = entry
+        try:
+            recs = list(fn_live() or [])
+        except Exception:  # noqa: BLE001 — a dead source has nothing to fold
+            recs = []
+        for key, st in self._hist_records_states(recs).items():
+            name, lkey = key
+            if not self._apply_baseline(st, base.get(key)):
+                continue
+            bounds, counts, vsum, count = st
+            h = self.histogram(name, labels=dict(lkey) or None, buckets=bounds)
+            if h.bounds == bounds:
+                h.merge_counts(counts, vsum, count)
+
+    @staticmethod
+    def _apply_baseline(st, base) -> bool:
+        """Subtract a :meth:`reset` baseline from a provider state
+        ``[bounds, counts, sum, count]`` in place (clamped at zero);
+        False when nothing remains above the baseline.  The ONE
+        subtraction the absorb and scrape paths share, so their
+        semantics can't diverge."""
+        if base is not None:
+            st[1] = [max(0, a - x) for a, x in zip(st[1], base[0])]
+            st[2] = max(0.0, st[2] - base[1])
+            st[3] = max(0, st[3] - base[2])
+        return st[3] > 0
+
+    @staticmethod
+    def _hist_records_states(recs) -> Dict[Tuple[str, tuple], list]:
+        """Provider records → {(name, label-key): [bounds, counts, sum,
+        count]}, malformed records dropped, duplicate (name, labels)
+        entries (several providers feeding one family) summed."""
+        out: Dict[Tuple[str, tuple], list] = {}
+        for rec in recs or ():
+            try:
+                name = str(rec["name"])
+                lkey = _label_key(rec.get("labels") or None)
+                bounds = tuple(float(b) for b in rec["le"])
+                counts = [int(c) for c in rec["b"]]
+                vsum = float(rec["sum"])
+                count = int(rec["count"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if len(counts) != len(bounds) + 1 or count < 0:
+                continue
+            cur = out.get((name, lkey))
+            if cur is None:
+                out[(name, lkey)] = [bounds, counts, vsum, count]
+            elif cur[0] == bounds:
+                cur[1] = [a + b for a, b in zip(cur[1], counts)]
+                cur[2] += vsum
+                cur[3] += count
+        return out
+
+    def _hist_states(self) -> Dict[Tuple[str, tuple], list]:
+        """(name, label-key) → [bounds, raw_counts, sum, count] across
+        local histograms AND live providers (above their reset
+        baselines) — the ONE combined read path snapshot(), the
+        Prometheus render, and the heartbeat delta all share, so every
+        surface reports the same totals.  Providers are invoked OUTSIDE
+        the registry lock (they parse JSON off a ctypes read)."""
+        with self._lock:
+            hists = dict(self._hists)
+            providers = list(self._hist_providers.values())
+        out: Dict[Tuple[str, tuple], list] = {}
+        for (name, lkey), h in hists.items():
+            counts, vsum, count = h.raw_state()
+            out[(name, lkey)] = [h.bounds, counts, vsum, count]
+        for fn, base in providers:
+            try:
+                recs = list(fn() or [])
+            except Exception:  # noqa: BLE001 — a dead provider can't break scrape
+                continue
+            for key, st in self._hist_records_states(recs).items():
+                if not self._apply_baseline(st, base.get(key)):
+                    continue
+                bounds, counts, vsum, count = st
+                cur = out.get(key)
+                if cur is None:
+                    out[key] = [bounds, counts, vsum, count]
+                elif tuple(cur[0]) == bounds:
+                    cur[1] = [a + x for a, x in zip(cur[1], counts)]
+                    cur[2] += vsum
+                    cur[3] += count
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self._hists.clear()
             self._gauges.clear()
             self._gauge_fns.clear()
+            providers = list(self._hist_providers.items())
+        # re-baseline live histogram providers so their post-reset
+        # deltas start at zero (native histograms are never cleared).
+        # fn() parses JSON off a ctypes read — call it OUTSIDE the
+        # registry lock (same rule as _hist_states) so a slow native
+        # read can't stall every observe/scrape in the process.
+        rebased = []
+        for key, (fn, _base) in providers:
+            try:
+                base = {
+                    k: (st[1], st[2], st[3])
+                    for k, st in self._hist_records_states(
+                        list(fn() or [])
+                    ).items()
+                }
+            except Exception:  # noqa: BLE001
+                base = {}
+            rebased.append((key, fn, base))
+        with self._lock:
+            for key, fn, base in rebased:
+                # a provider absorbed/unregistered while unlocked must
+                # not be resurrected
+                if key in self._hist_providers:
+                    self._hist_providers[key] = (fn, base)
         with self._delta_lock:
             self._requeued.clear()
             self._shipped_counts.clear()
@@ -427,9 +598,10 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """Full structured snapshot: counters (flat + labeled), gauges,
         histogram percentiles — the in-process observability surface
-        (``bps.get_metrics()``)."""
+        (``bps.get_metrics()``).  Histograms are the COMBINED view:
+        local observations plus live histogram providers (the native
+        C++ engines' ``native_*`` families)."""
         with self._lock:
-            hists = dict(self._hists)
             gauges = dict(self._gauges)
             gauge_fns = dict(self._gauge_fns)
         out = {
@@ -446,14 +618,14 @@ class MetricsRegistry:
                 out["gauges"][name] = float(fn())
             except Exception:  # noqa: BLE001 — a broken gauge can't break scrape
                 continue
-        for (name, lkey), h in hists.items():
-            snap = h.snapshot()
+        for (name, lkey), st in self._hist_states().items():
+            bounds, counts, vsum, count = st
             out["histograms"][name + _render_labels(lkey)] = {
-                "count": snap["count"],
-                "sum": snap["sum"],
-                "p50": h.percentile(0.50),
-                "p90": h.percentile(0.90),
-                "p99": h.percentile(0.99),
+                "count": count,
+                "sum": vsum,
+                "p50": _state_percentile(bounds, counts, 0.50),
+                "p90": _state_percentile(bounds, counts, 0.90),
+                "p99": _state_percentile(bounds, counts, 0.99),
             }
         return out
 
@@ -485,7 +657,6 @@ class MetricsRegistry:
         with self._lock:
             gauges = dict(self._gauges)
             gauge_fns = dict(self._gauge_fns)
-            hists = dict(self._hists)
         for name, fn in gauge_fns.items():
             try:
                 gauges[name] = float(fn())
@@ -495,32 +666,39 @@ class MetricsRegistry:
             metric = f"{prefix}{name}"
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {gauges[name]}")
-        by_family: Dict[str, List[Tuple[tuple, Histogram]]] = {}
-        for (name, lkey), h in hists.items():
-            by_family.setdefault(name, []).append((lkey, h))
+        # combined local + provider histograms (native_* families merge
+        # into the same exposition the Python engines feed)
+        by_family: Dict[str, List[Tuple[tuple, list]]] = {}
+        for (name, lkey), st in self._hist_states().items():
+            by_family.setdefault(name, []).append((lkey, st))
         for name in sorted(by_family):
             metric = f"{prefix}{name}"
             lines.append(f"# TYPE {metric} histogram")
-            for lkey, h in sorted(by_family[name], key=lambda kv: kv[0]):
-                snap = h.snapshot()
-                for le, cum in snap["buckets"]:
-                    le_s = "+Inf" if le == float("inf") else repr(le)
-                    labels = dict(lkey) | {"le": le_s}
+            for lkey, (bounds, counts, vsum, count) in sorted(
+                by_family[name], key=lambda kv: kv[0]
+            ):
+                cum = 0
+                for le, c in zip(bounds, counts):
+                    cum += c
+                    labels = dict(lkey) | {"le": repr(float(le))}
                     lines.append(
                         f"{metric}_bucket{_render_labels(_label_key(labels))} {cum}"
                     )
+                labels = dict(lkey) | {"le": "+Inf"}
                 lines.append(
-                    f"{metric}_sum{_render_labels(lkey)} {snap['sum']}"
+                    f"{metric}_bucket{_render_labels(_label_key(labels))} {count}"
                 )
-                lines.append(
-                    f"{metric}_count{_render_labels(lkey)} {snap['count']}"
-                )
+                lines.append(f"{metric}_sum{_render_labels(lkey)} {vsum}")
+                lines.append(f"{metric}_count{_render_labels(lkey)} {count}")
             for q, tag in ((0.50, "p50"), (0.90, "p90"), (0.99, "p99")):
                 qmetric = f"{metric}_{tag}"
                 lines.append(f"# TYPE {qmetric} gauge")
-                for lkey, h in sorted(by_family[name], key=lambda kv: kv[0]):
+                for lkey, (bounds, counts, _vsum, _count) in sorted(
+                    by_family[name], key=lambda kv: kv[0]
+                ):
                     lines.append(
-                        f"{qmetric}{_render_labels(lkey)} {h.percentile(q)}"
+                        f"{qmetric}{_render_labels(lkey)} "
+                        f"{_state_percentile(bounds, counts, q)}"
                     )
         return "\n".join(lines) + "\n"
 
@@ -555,21 +733,30 @@ class MetricsRegistry:
                     lc_delta.setdefault(name, {})[json.dumps(lkey)] = d
         if lc_delta:
             out["lc"] = lc_delta
-        with self._lock:
-            hists = dict(self._hists)
+        # combined local + provider histograms: the native engines'
+        # families ride the same heartbeat deltas toward the scheduler
+        # aggregate as everything else
         h_delta = []
-        for (name, lkey), h in hists.items():
-            raw, vsum, count = h.raw_state()
+        for (name, lkey), st in self._hist_states().items():
+            bounds, raw, vsum, count = st
             prev = self._shipped_hists.get(
                 (name, lkey), ([0] * len(raw), 0.0, 0)
             )
             d_counts = [a - b for a, b in zip(raw, prev[0])]
             d_count = count - prev[2]
-            if d_count:
+            if d_count < 0 or any(d < 0 for d in d_counts):
+                # a provider is mid-absorb (popped from the registry but
+                # not yet folded into local histograms): combined totals
+                # transiently went backwards.  Ship nothing and KEEP the
+                # old baseline — the fold restores the totals, and the
+                # next beat's delta stays exact.  Lowering the baseline
+                # here would re-ship the provider's whole history.
+                continue
+            if d_count > 0:
                 h_delta.append({
                     "name": name,
                     "l": [list(kv) for kv in lkey],
-                    "le": list(h.bounds),
+                    "le": list(bounds),
                     "b": d_counts,
                     "s": vsum - prev[1],
                     "n": d_count,

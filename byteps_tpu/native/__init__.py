@@ -41,6 +41,23 @@ DRAIN_REC_DTYPE = np.dtype([
 ])
 assert DRAIN_REC_DTYPE.itemsize == 56
 
+#: SpanRec mirror (ps_server.cc — change both together): one child-span
+#: record drained from the native engine's trace ring via
+#: ``bps_native_server_drain_spans`` (docs/observability.md)
+SPAN_REC_DTYPE = np.dtype([
+    ("trace", "<u8"), ("parent", "<u8"), ("key", "<u8"),
+    ("ts", "<f8"), ("dur", "<f8"), ("kind", "<i4"), ("flags", "<u4"),
+])
+assert SPAN_REC_DTYPE.itemsize == 48
+
+#: SpanKind index order (ps_server.cc) → span names matching the Python
+#: server's child-span model (server.py _child_span call sites)
+NATIVE_SPAN_KINDS = ("recv", "sum", "publish", "reply", "resync")
+
+#: SpanRec.flags bits
+SPAN_FLAG_DEDUPE = 1
+SPAN_FLAG_FUSED = 2
+
 _lib: Optional[ctypes.CDLL] = None
 
 
@@ -128,6 +145,29 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             c.c_void_p, c.c_uint64, c.c_void_p, c.c_uint64,
         ]
         lib.bps_wire_resync_echo.restype = c.c_int64
+    # observability-parity surface (span drain + histogram feeds) — may
+    # be absent in a stale .so; counters/data plane still work without it
+    if hasattr(lib, "bps_native_server_drain_spans"):
+        lib.bps_native_server_set_trace.argtypes = [c.c_int32, c.c_int32]
+        lib.bps_native_server_set_trace.restype = None
+        lib.bps_native_server_drain_spans.argtypes = [
+            c.c_int32, c.c_void_p, c.c_int32,
+        ]
+        lib.bps_native_server_drain_spans.restype = c.c_int32
+        lib.bps_native_server_metrics_json.argtypes = [
+            c.c_int32, c.c_void_p, c.c_uint64,
+        ]
+        lib.bps_native_server_metrics_json.restype = c.c_int64
+        lib.bps_wire_fused_spans_echo.argtypes = [
+            c.c_void_p, c.c_uint64, c.POINTER(c.c_uint64), c.c_int64,
+        ]
+        lib.bps_wire_fused_spans_echo.restype = c.c_int64
+        lib.bps_wire_client_frame.argtypes = [
+            c.c_int32, c.c_uint32, c.c_uint64, c.c_uint32, c.c_uint32,
+            c.c_uint32, c.c_uint64, c.c_uint64, c.c_void_p, c.c_uint64,
+            c.c_void_p, c.c_uint64,
+        ]
+        lib.bps_wire_client_frame.restype = c.c_int64
     # native worker client data plane (ps_client.cc) — may be absent in a
     # stale .so; the pure-Python client covers every van without it
     if hasattr(lib, "bpsc_create"):
@@ -149,6 +189,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                 c.c_int64, c.c_void_p, c.c_int64, c.c_void_p, c.c_uint64,
             ]
             lib.bpsc_drain.restype = c.c_int64
+        if hasattr(lib, "bpsc_send2"):
+            # trace-context-aware send + the client histogram feed
+            lib.bpsc_send2.argtypes = [
+                c.c_int64, c.c_int32, c.c_uint32, c.c_uint64, c.c_uint32,
+                c.c_uint32, c.c_uint32, c.c_void_p, c.c_uint64, c.c_uint64,
+                c.c_uint64,
+            ]
+            lib.bpsc_send2.restype = c.c_int32
+            lib.bpsc_metrics_json.argtypes = [c.c_int64, c.c_void_p, c.c_uint64]
+            lib.bpsc_metrics_json.restype = c.c_int64
     return lib
 
 
@@ -167,12 +217,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None  # corrupt/partial .so → pure-Python fallbacks
-    if not hasattr(lib, "bps_native_server_counters") and autobuild:
+    if not hasattr(lib, "bps_native_server_drain_spans") and autobuild:
         # stale library from before the newest entry points (currently
-        # the native-parity surface: counters/fence/golden shims):
-        # rebuild, then load via a temp COPY — dlopen dedups by
-        # path/inode, so reloading the original path can hand back the
-        # old mapping
+        # the observability-parity surface: span drain + histogram
+        # feeds + trace-aware client send): rebuild, then load via a
+        # temp COPY — dlopen dedups by path/inode, so reloading the
+        # original path can hand back the old mapping
         _try_build()
         try:
             import shutil
@@ -184,7 +234,7 @@ def _load() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy(_LIB_PATH, tmp.name)
             fresh = ctypes.CDLL(tmp.name)
-            if hasattr(fresh, "bps_native_server_counters"):
+            if hasattr(fresh, "bps_native_server_drain_spans"):
                 lib = fresh
         except OSError:
             pass
@@ -212,6 +262,7 @@ NATIVE_COUNTER_NAMES = (
     "native_init_replay_ack",
     "native_resync_query",
     "native_zombie_reject",
+    "native_span_drop",
 )
 
 
@@ -230,6 +281,76 @@ def native_server_counters(server_id: int) -> dict:
     if n <= 0:
         return {}
     return {NATIVE_COUNTER_NAMES[i]: int(out[i]) for i in range(n)}
+
+
+def _metrics_json(call, ident) -> list:
+    """Shared grow-and-retry wrapper for the native metrics-JSON exports
+    → the ``register_hist_provider`` record list (empty when the source
+    is gone / the lib predates the export / the body is malformed)."""
+    import json
+
+    cap = 1 << 16
+    for _ in range(8):  # 64 KiB → 8 MiB: bounded growth, no spin
+        buf = (ctypes.c_uint8 * cap)()
+        n = call(ident, buf, cap)
+        if n == -1 or n == 0:
+            return []
+        if n < 0:
+            cap = max(-int(n), cap * 2)
+            continue
+        try:
+            doc = json.loads(bytes(buf[:n]).decode())
+        except (ValueError, UnicodeDecodeError):
+            return []
+        return list(doc.get("histograms") or [])
+    return []
+
+
+def native_server_histograms(server_id: int) -> list:
+    """One native server instance's histograms (``native_server_sum_seconds``
+    per key, ``native_request_bytes`` per key, ``native_server_publish_seconds``)
+    as histogram-provider records — the feed behind
+    :meth:`MetricsRegistry.register_hist_provider`
+    (docs/observability.md)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "bps_native_server_metrics_json"):
+        return []
+    return _metrics_json(lib.bps_native_server_metrics_json, server_id)
+
+
+def native_client_histograms(handle: int) -> list:
+    """One native client handle's histograms
+    (``native_rpc_round_trip_seconds``) as histogram-provider records."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "bpsc_metrics_json"):
+        return []
+    return _metrics_json(lib.bpsc_metrics_json, handle)
+
+
+def native_server_drain_spans(server_id: int, max_recs: int = 4096):
+    """Drain the native engine's child-span ring (docs/observability.md):
+    returns a structured ndarray of :data:`SPAN_REC_DTYPE` records
+    (empty once the instance is stopped or the lib predates the span
+    plane).  The caller — NativePSServer's drain loop — replays them
+    into the process tracer."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "bps_native_server_drain_spans"):
+        return np.zeros(0, dtype=SPAN_REC_DTYPE)
+    recs = np.zeros(max_recs, dtype=SPAN_REC_DTYPE)
+    n = lib.bps_native_server_drain_spans(
+        server_id, recs.ctypes.data_as(ctypes.c_void_p), max_recs
+    )
+    if n <= 0:
+        return np.zeros(0, dtype=SPAN_REC_DTYPE)
+    return recs[:n]
+
+
+def native_server_set_trace(server_id: int, on: bool) -> None:
+    """Mirror the wrapper's tracing decision (cfg.trace_on &&
+    cfg.trace_spans) into the C++ engine's span gate."""
+    lib = _load()
+    if lib is not None and hasattr(lib, "bps_native_server_set_trace"):
+        lib.bps_native_server_set_trace(server_id, int(bool(on)))
 
 
 def _ptr(a: np.ndarray):

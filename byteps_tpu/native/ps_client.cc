@@ -72,17 +72,25 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <chrono>
 #include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
 
+#include "hist.h"
 #include "wire.h"
 
 namespace {
 
 using bps_wire::Header;
 using bps_wire::kMagic;
+
+uint64_t steady_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 typedef void (*bpsc_cb_t)(void* ctx, int32_t op, int32_t status,
                           uint32_t flags, uint32_t seq, uint64_t key,
@@ -208,8 +216,17 @@ struct NativeClient {
   struct Pending {
     uint8_t* sink;
     uint64_t sink_len;
+    // send timestamp of this seq's newest attempt (0 = not sent yet):
+    // feeds the native per-attempt round-trip histogram below
+    uint64_t t_send_ns = 0;
   };
   std::unordered_map<uint32_t, Pending> pending;
+
+  // Per-attempt RPC latency, measured where the wire is (send syscall →
+  // completion enqueue, no ctypes trampoline / drain batching in the
+  // number) — exported as native_rpc_round_trip_seconds through
+  // bpsc_metrics_json and telemetry's histogram-provider seam.
+  bps_hist::Hist rtt_hist;
   bool dead = false;  // set by the LAST lane to exit (after the drain)
   int live_lanes = 0;
 
@@ -296,12 +313,14 @@ struct NativeClient {
       m.len = be64toh(h.length);
       uint8_t* sink = nullptr;
       uint64_t sink_len = 0;
+      uint64_t t_send_ns = 0;
       {
         std::lock_guard<std::mutex> g(mu);
         auto it = pending.find(m.seq);
         if (it != pending.end()) {
           sink = it->second.sink;
           sink_len = it->second.sink_len;
+          t_send_ns = it->second.t_send_ns;
         }
       }
       if (m.len) {
@@ -329,6 +348,10 @@ struct NativeClient {
         std::lock_guard<std::mutex> g(mu);
         pending.erase(m.seq);
       }
+      // per-attempt round trip: payload fully landed, response not yet
+      // delivered to Python (the wire-true number, retries excluded —
+      // each attempt re-stamps t_send_ns)
+      if (t_send_ns) rtt_hist.observe((double)(steady_ns() - t_send_ns) * 1e-9);
       push_completion(std::move(m));
     }
     lane_exit();
@@ -346,6 +369,26 @@ std::shared_ptr<NativeClient> cli_for(int64_t id) {
   std::lock_guard<std::mutex> g(g_cli_mu);
   auto it = g_clients.find(id);
   return it == g_clients.end() ? nullptr : it->second;
+}
+
+// Build the pre-payload part of one outgoing frame into out (32-byte
+// header, plus the 16-byte trace-context block when trace_id != 0 —
+// trace ids are nonzero by construction, tracing.new_trace_id).  The
+// ONE encode path bpsc_send and the golden-fixture shim
+// (bps_wire_client_frame) share, so the live client encoder is what the
+// byte-exact fixtures pin.  Returns the byte count (32 or 48).
+size_t build_frame_head(uint8_t out[48], int32_t op, uint32_t seq,
+                        uint64_t key, uint32_t cmd, uint32_t version,
+                        uint32_t flags, uint64_t len, uint64_t trace_id,
+                        uint64_t span_id) {
+  Header hd;
+  uint8_t status = trace_id ? bps_wire::kTraceFlag : 0;
+  bps_wire::pack_header(&hd, (uint8_t)op, status, (uint8_t)flags, seq, key,
+                        cmd, version, len);
+  std::memcpy(out, &hd, sizeof(hd));
+  if (!trace_id) return sizeof(hd);
+  bps_wire::pack_trace(out + sizeof(hd), trace_id, span_id);
+  return sizeof(hd) + 16;
 }
 
 }  // namespace
@@ -398,9 +441,17 @@ int64_t bpsc_alloc_seq(int64_t h, void* sink, uint64_t sink_len) {
   return (int64_t)seq;
 }
 
-int32_t bpsc_send(int64_t h, int32_t op, uint32_t seq, uint64_t key,
-                  uint32_t cmd, uint32_t version, uint32_t flags,
-                  const void* payload, uint64_t len) {
+// Trace-context-aware send (docs/observability.md): trace_id/span_id
+// ride the optional 16-byte TRACE_FLAG block after the header, exactly
+// as transport.py Message.encode emits it — the Python engine's span
+// context now propagates through the native client too, so the server's
+// child spans join the worker spans whichever client implementation
+// carried the frame.  trace_id 0 = untraced frame (the ids are nonzero
+// by construction).
+int32_t bpsc_send2(int64_t h, int32_t op, uint32_t seq, uint64_t key,
+                   uint32_t cmd, uint32_t version, uint32_t flags,
+                   const void* payload, uint64_t len, uint64_t trace_id,
+                   uint64_t span_id) {
   auto c = cli_for(h);
   if (!c) return -1;
   ClientLane* lane = c->lanes[key % c->lanes.size()].get();
@@ -409,14 +460,22 @@ int32_t bpsc_send(int64_t h, int32_t op, uint32_t seq, uint64_t key,
   // same path: the native client is payload-agnostic, so the fused
   // pack and recovery-plane routing in comm/ps_client.py work over
   // either client implementation)
-  Header hd;
-  bps_wire::pack_header(&hd, (uint8_t)op, 0, (uint8_t)flags, seq, key, cmd,
-                        version, len);
+  uint8_t head[48];
+  size_t head_len = build_frame_head(head, op, seq, key, cmd, version, flags,
+                                     len, trace_id, span_id);
+  // per-attempt latency starts at the send, transport included —
+  // re-stamped on every retry attempt (the Python client's t_sent
+  // placement); registered seq only, control sends have no entry
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->pending.find(seq);
+    if (it != c->pending.end()) it->second.t_send_ns = steady_ns();
+  }
   // scatter-gather send: header + payload leave through one writev with
   // zero payload memcpys (transport.py sendmsg parity)
-  iovec iov[2] = {{&hd, sizeof(hd)}, {const_cast<void*>(payload), len}};
+  iovec iov[2] = {{head, head_len}, {const_cast<void*>(payload), len}};
   int iovcnt = len ? 2 : 1;
-  size_t off = 0, total = sizeof(hd) + (size_t)len;
+  size_t off = 0, total = head_len + (size_t)len;
   std::lock_guard<std::mutex> g(lane->send_mu);
   while (off < total) {
     iovec cur[2];
@@ -438,6 +497,52 @@ int32_t bpsc_send(int64_t h, int32_t op, uint32_t seq, uint64_t key,
     off += (size_t)w;
   }
   return 0;
+}
+
+// pre-observability surface: an untraced bpsc_send2 (kept so an older
+// Python layer over a fresh .so keeps working)
+int32_t bpsc_send(int64_t h, int32_t op, uint32_t seq, uint64_t key,
+                  uint32_t cmd, uint32_t version, uint32_t flags,
+                  const void* payload, uint64_t len) {
+  return bpsc_send2(h, op, seq, key, cmd, version, flags, payload, len, 0, 0);
+}
+
+// One client handle's histograms as a JSON document (same shape as
+// bps_native_server_metrics_json) — parsed by native/__init__.py and
+// fed through telemetry's histogram-provider seam so the native data
+// plane's rpc_round_trip lands in get_metrics()/Prometheus/the cluster
+// aggregate.  Returns bytes written, -(needed) when cap is too small,
+// or -1 for an unknown handle.
+int64_t bpsc_metrics_json(int64_t h, uint8_t* out, uint64_t cap) {
+  auto c = cli_for(h);
+  if (!c) return -1;
+  std::string body = "{\"histograms\": [";
+  c->rtt_hist.append_json(&body, "native_rpc_round_trip_seconds", nullptr,
+                          "");
+  body += "]}";
+  if (body.size() > cap) return -(int64_t)body.size();
+  std::memcpy(out, body.data(), body.size());
+  return (int64_t)body.size();
+}
+
+// Golden-fixture shim (tests/test_wire_golden.py): emit one complete
+// frame (header [+ trace block] + payload) through the LIVE client
+// encode path (build_frame_head — the same bytes bpsc_send2 writes), so
+// transport.py Message.encode and the native client cannot drift.
+// Returns bytes written or -(needed) when cap is too small.
+int64_t bps_wire_client_frame(int32_t op, uint32_t seq, uint64_t key,
+                              uint32_t cmd, uint32_t version, uint32_t flags,
+                              uint64_t trace_id, uint64_t span_id,
+                              const uint8_t* payload, uint64_t len,
+                              uint8_t* out, uint64_t cap) {
+  uint8_t head[48];
+  size_t head_len = build_frame_head(head, op, seq, key, cmd, version, flags,
+                                     len, trace_id, span_id);
+  uint64_t total = head_len + len;
+  if (total > cap) return -(int64_t)total;
+  std::memcpy(out, head, head_len);
+  if (len) std::memcpy(out + head_len, payload, len);
+  return (int64_t)total;
 }
 
 int64_t bpsc_drain(int64_t h, void* recs_out, int64_t max_recs,

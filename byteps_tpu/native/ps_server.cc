@@ -45,6 +45,7 @@
 #include <tuple>
 #include <vector>
 
+#include "hist.h"
 #include "wire.h"
 
 // from reducer.cc / compressor.cc (same shared object)
@@ -111,7 +112,127 @@ enum NativeCounter {
   kCtrInitReplayAck,  // INITs acked from the completed-barrier record
   kCtrResyncQuery,    // Op.RESYNC_QUERY frames answered from the ledger
   kCtrZombieReject,   // pushes rejected by the live-rank fence
+  kCtrSpanDrop,       // span records dropped on a full trace ring
   kCtrCount,
+};
+
+// The native_* names, index-matched to NativeCounter — the one place
+// the names live on the C++ side.  bps_native_server_metrics_json
+// exports counters under these names, and tools/check_metrics_doc.py
+// scans these literals so the docs/observability.md catalog covers the
+// native plane too.
+const char* const kCounterNames[kCtrCount] = {
+    "native_wire_rpc",        "native_fused_frames",  "native_fused_keys",
+    "native_push_dedup",      "native_init_replay_ack",
+    "native_resync_query",    "native_zombie_reject", "native_span_drop",
+};
+
+// ---------------------------------------------------------------------------
+// span plane (docs/observability.md): the C++ engine stamps the same
+// recv→sum→publish→reply child spans the Python server does, but it
+// must never touch Python from the data path — records land in a
+// bounded lock-free ring and the wrapper (server.py NativePSServer)
+// drains them via bps_native_server_drain_spans into the process
+// tracer, which writes the same server<rank>/comm.json file
+// tools/trace_merge.py already stitches.
+// ---------------------------------------------------------------------------
+
+// span kinds, index-matched to NATIVE_SPAN_KINDS in native/__init__.py
+enum SpanKind {
+  kSpanRecv = 0,   // engine-queue dwell (enqueue → handler start)
+  kSpanSum,        // ledger + summation under the key lock
+  kSpanPublish,    // round publish (swap + waiter flush prep)
+  kSpanReply,      // response serialization + send
+  kSpanResync,     // Op.RESYNC_QUERY answered from the ledger
+};
+
+constexpr uint32_t kSpanFlagDedupe = 1;  // replay suppressed by the ledger
+constexpr uint32_t kSpanFlagFused = 2;   // fused-member child span
+
+// mirrored by SPAN_REC_DTYPE in native/__init__.py — change both
+// together (64-bit fields first: no implicit padding holes)
+struct SpanRec {
+  uint64_t trace_id;    // worker's trace id (wire trace-context block)
+  uint64_t parent;      // wire span id (or fused-member trailer id)
+  uint64_t key;
+  double ts;            // wall-clock seconds (time.time() parity)
+  double dur;           // seconds
+  int32_t kind;         // SpanKind
+  uint32_t flags;       // kSpanFlag*
+};
+static_assert(sizeof(SpanRec) == 48, "SpanRec layout drifted");
+
+double wall_now() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+// Bounded lock-free MPMC ring (Vyukov bounded queue): engine threads
+// produce span records concurrently, the wrapper's drain thread
+// consumes in batches.  A full ring DROPS (the producer must never
+// block the data plane on the observer); drops are counted so the
+// timeline says it is incomplete instead of silently lying.
+class SpanRing {
+ public:
+  static constexpr size_t kCap = 1 << 14;  // 16384 records (~768 KiB)
+
+  SpanRing() {
+    for (size_t i = 0; i < kCap; ++i)
+      slots_[i].seq.store(i, std::memory_order_relaxed);
+  }
+
+  bool push(const SpanRec& r) {
+    size_t pos = head_.load(std::memory_order_relaxed);
+    for (;;) {
+      Slot& s = slots_[pos & (kCap - 1)];
+      size_t seq = s.seq.load(std::memory_order_acquire);
+      intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+      if (dif == 0) {
+        if (head_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed))
+          break;
+      } else if (dif < 0) {
+        return false;  // full: drop (caller counts it)
+      } else {
+        pos = head_.load(std::memory_order_relaxed);
+      }
+    }
+    Slot& s = slots_[pos & (kCap - 1)];
+    s.rec = r;
+    s.seq.store(pos + 1, std::memory_order_release);
+    return true;
+  }
+
+  // drain up to cap records; single consumer assumed (the drain thread),
+  // but the CAS keeps even racing consumers safe
+  int32_t pop(SpanRec* out, int32_t cap) {
+    int32_t n = 0;
+    while (n < cap) {
+      size_t pos = tail_.load(std::memory_order_relaxed);
+      Slot& s = slots_[pos & (kCap - 1)];
+      size_t seq = s.seq.load(std::memory_order_acquire);
+      if ((intptr_t)seq - (intptr_t)(pos + 1) < 0) break;  // empty
+      if (!tail_.compare_exchange_weak(pos, pos + 1,
+                                       std::memory_order_relaxed))
+        continue;
+      out[n++] = slots_[pos & (kCap - 1)].rec;
+      slots_[pos & (kCap - 1)].seq.store(pos + kCap,
+                                         std::memory_order_release);
+    }
+    return n;
+  }
+
+ private:
+  struct Slot {
+    std::atomic<size_t> seq;
+    SpanRec rec;
+  };
+  Slot slots_[kCap];
+  // head/tail on separate cache lines: producers and the consumer
+  // otherwise false-share one line on every push/pop
+  alignas(64) std::atomic<size_t> head_{0};
+  alignas(64) std::atomic<size_t> tail_{0};
 };
 
 int dtype_size(int32_t dt) {
@@ -626,7 +747,8 @@ struct FusedMember {
 // trailer (count × u64, distributed tracing) is ignored — the
 // pre-observability decoder contract transport.py documents.
 bool parse_fused_push(const uint8_t* body, uint64_t size,
-                      std::vector<FusedMember>* out) {
+                      std::vector<FusedMember>* out,
+                      std::vector<uint64_t>* span_ids = nullptr) {
   if (size < 4) return false;
   uint32_t count_be;
   std::memcpy(&count_be, body, 4);
@@ -655,6 +777,18 @@ bool parse_fused_push(const uint8_t* body, uint64_t size,
     m.payload = body + off;
     off += m.len;
     out->push_back(m);
+  }
+  // Optional member-span trailer (count × u64, distributed tracing):
+  // recovered only when the caller asks — transport.decode_fused_spans
+  // parity, so fused member child spans can parent onto their own
+  // worker-side spans instead of the pack span.
+  if (span_ids && size - off == 8ull * count && count) {
+    span_ids->reserve(count);
+    for (uint32_t i = 0; i < count; ++i) {
+      uint64_t id_be;
+      std::memcpy(&id_be, body + off + 8ull * i, 8);
+      span_ids->push_back(be64toh(id_be));
+    }
   }
   return true;
 }
@@ -820,6 +954,12 @@ struct EngineTask {
   uint64_t key = 0;
   uint32_t cmd = 0;
   uint32_t version = 0;
+  // wire trace context (0 = untraced frame / tracing off): the worker's
+  // (trace id, span id) off the TRACE_FLAG block, plus the enqueue
+  // wall-clock that bounds the "recv" (queue-dwell) child span
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  double t_enq = 0.0;
   std::vector<uint8_t> payload;
 };
 
@@ -890,6 +1030,13 @@ struct KeyState {
   std::map<uint8_t, uint32_t> init_done;
   std::unique_ptr<Codec> codec;
   std::vector<uint8_t> pull_payload;
+  // per-key telemetry (docs/observability.md): summation latency and
+  // request sizes — the per-tensor feed the adaptive-compression
+  // direction picks codecs from.  Always-on like the Python engine's
+  // server_sum_seconds (an observe is a bound scan + 3 relaxed adds).
+  bps_hist::Hist sum_hist;
+  bps_hist::Hist size_hist;
+  KeyState() { size_hist.init_size_buckets(); }
 };
 
 class NativeServer {
@@ -952,6 +1099,46 @@ class NativeServer {
     for (int32_t i = 0; i < n; ++i)
       out[i] = ctr_[i].load(std::memory_order_relaxed);
     return n;
+  }
+
+  // span plane on/off (NativePSServer mirrors cfg.trace_on &&
+  // cfg.trace_spans here; the env default below covers direct starts)
+  void set_trace(bool on) { trace_on_.store(on, std::memory_order_relaxed); }
+  bool tracing() const { return trace_on_.load(std::memory_order_relaxed); }
+
+  int32_t drain_spans(SpanRec* out, int32_t cap) {
+    return span_ring_.pop(out, cap);
+  }
+
+  // Histograms + counters as one JSON document (names live here in the
+  // .cc, where tools/check_metrics_doc.py scans them): the body behind
+  // bps_native_server_metrics_json, parsed by native/__init__.py and fed
+  // through telemetry's histogram-provider seam into get_metrics(),
+  // Prometheus, and the heartbeat cluster aggregate.
+  std::string metrics_json() {
+    std::string out = "{\"histograms\": [";
+    std::vector<std::pair<uint64_t, KeyState*>> all;
+    {
+      std::lock_guard<std::mutex> g(keys_mu_);
+      for (auto& [k, ks] : keys_) all.emplace_back(k, ks.get());
+    }
+    for (auto& [key, ks] : all) {
+      std::string kv = std::to_string(key);
+      ks->sum_hist.append_json(&out, "native_server_sum_seconds", "key", kv);
+      ks->size_hist.append_json(&out, "native_request_bytes", "key", kv);
+    }
+    publish_hist_.append_json(&out, "native_server_publish_seconds", nullptr,
+                              "");
+    out += "], \"counters\": {";
+    char buf[96];
+    for (int i = 0; i < kCtrCount; ++i) {
+      snprintf(buf, sizeof buf, "%s\"%s\": %llu", i ? ", " : "",
+               kCounterNames[i],
+               (unsigned long long)ctr_[i].load(std::memory_order_relaxed));
+      out += buf;
+    }
+    out += "}}";
+    return out;
   }
 
   int start(int port, int num_workers, bool enable_async) {
@@ -1133,11 +1320,13 @@ class NativeServer {
       bool ok = true;
       if (t.op == kPush)
         ok = handle_push(t.conn, t.seq, t.key, t.cmd, t.version, t.flags,
-                         t.payload);
+                         t.payload, t.trace_id, t.span_id, t.t_enq);
       else if (t.op == kPull)
-        ok = handle_pull(t.conn, t.seq, t.key, t.cmd, t.version, t.payload);
+        ok = handle_pull(t.conn, t.seq, t.key, t.cmd, t.version, t.payload,
+                         t.trace_id, t.span_id, t.t_enq);
       else if (t.op == kFused)
-        ok = handle_fused(t.conn, t.seq, t.key, t.flags, t.payload);
+        ok = handle_fused(t.conn, t.seq, t.key, t.flags, t.payload,
+                         t.trace_id, t.span_id, t.t_enq);
       if (!ok) {
         // malformed request → drop the connection: wake() unblocks the
         // serve thread's recv; the transport closes with its last holder
@@ -1165,24 +1354,19 @@ class NativeServer {
 
       // Optional trace context (transport.py TRACE_FLAG, status bit 7):
       // a tracing worker appends 16 bytes (u64 trace_id + u64 span_id)
-      // after the header.  The native engine does not stamp spans —
-      // skip the block so the stream stays framed, and say so once per
-      // process so an operator wondering where the server child spans
-      // went gets an answer (the Python engine is the traced one).
-      if (h.status & 0x80) {
+      // after the header.  The block is always consumed (the stream
+      // must stay framed), but decoded into span context only when the
+      // span plane is on — with BYTEPS_TRACE_SPANS=0 this is one
+      // relaxed atomic load and no ring ever sees a write.
+      uint64_t trace_id = 0, span_id = 0;
+      if (h.status & kTraceFlag) {
         uint8_t trace_ctx[16];
         if (!conn->recv_exact(trace_ctx, sizeof(trace_ctx))) {
           NDBG("serve: trace-context recv failed");
           break;
         }
-        static std::atomic<bool> warned{false};
-        if (!warned.exchange(true)) {
-          fprintf(stderr,
-                  "byteps-native: ignoring trace context on incoming frames "
-                  "(the C++ engine emits no spans; use the Python server "
-                  "for server-side tracing)\n");
-        }
-        h.status &= static_cast<uint8_t>(~0x80);
+        if (tracing()) bps_wire::unpack_trace(trace_ctx, &trace_id, &span_id);
+        h.status &= static_cast<uint8_t>(~kTraceFlag);
       }
 
       uint32_t seq = ntohl(h.seq);
@@ -1211,7 +1395,8 @@ class NativeServer {
           // recovery plane: answered inline — a read-mostly snapshot of
           // the exactly-once ledger, and the asking worker is stalled on
           // it (mirrors the Python server's serve-thread handling)
-          if (!handle_resync(conn, seq, key, payload)) return;
+          if (!handle_resync(conn, seq, key, payload, trace_id, span_id))
+            return;
           break;
         case kPush:
         case kPull:
@@ -1236,6 +1421,11 @@ class NativeServer {
           t.key = key;
           t.cmd = cmd;
           t.version = version;
+          if (trace_id) {  // traced frame: bound the recv (queue-dwell) span
+            t.trace_id = trace_id;
+            t.span_id = span_id;
+            t.t_enq = wall_now();
+          }
           t.payload = std::move(payload);
           payload.clear();
           queues_[thread_for(key, t.payload.size())]->put(std::move(t), prio);
@@ -1428,7 +1618,8 @@ class NativeServer {
       uint64_t len, bool compressed,
       std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>,
                              uint32_t>>* flush,
-      std::vector<FusedReplyPtr>* fused_done) {
+      std::vector<FusedReplyPtr>* fused_done,
+      double* publish_dur = nullptr) {
     // malformed compressed payload → drop conn (mirrors malformed-init)
     if (compressed && !ks.codec->wire_ok((int64_t)len)) return false;
     float* accf = (float*)ks.accum.data();
@@ -1460,37 +1651,67 @@ class NativeServer {
       ks.recv_count++;
     }
     if (wid && version > 0) ks.push_seen[wid] = version;
-    if (!async_ && ks.recv_count >= num_workers_.load())
+    if (!async_ && ks.recv_count >= num_workers_.load()) {
+      double p0 = wall_now();
       publish_round_locked(ks, flush, fused_done);
+      if (publish_dur) *publish_dur = wall_now() - p0;
+    }
     return true;
   }
 
   bool handle_push(const ConnPtr& conn, uint32_t seq, uint64_t key, uint32_t cmd,
                    uint32_t version, uint8_t flags,
-                   const std::vector<uint8_t>& payload) {
+                   const std::vector<uint8_t>& payload, uint64_t trace_id,
+                   uint64_t span_id, double t_enq) {
     if (fenced(flags)) return false;  // evicted worker → drop conn
     int32_t rtype, dtype;
     decode_cantor(cmd, &rtype, &dtype);
     auto& ks = key_state(key);
     std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>> flush;
     std::vector<FusedReplyPtr> fused_done;
+    // child spans mirror server.py: recv (engine-queue dwell) → sum
+    // (dedupe-annotated) → publish (when this push closed the round) →
+    // reply, all parented onto the wire-propagated worker span
+    double t_start = wall_now();
+    if (trace_id && t_enq > 0)
+      span(trace_id, span_id, key, t_enq, t_start - t_enq, kSpanRecv);
+    ks.size_hist.observe((double)payload.size());
+    bool dedupe = false;
+    double published = 0.0;
     if (rtype == 1) {  // kRowSparsePushPull: scatter-sum rows
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) return false;
-      if (!is_replayed_push_locked(ks, flags, version) &&
+      dedupe = is_replayed_push_locked(ks, flags, version);
+      if (!dedupe &&
           !handle_push_rowsparse_locked(ks, flags, version, payload, &flush,
-                                        &fused_done))
+                                        &fused_done, &published))
         return false;
     } else {
       std::lock_guard<std::mutex> g(ks.mu);
       if (ks.store.empty()) return false;  // push before init → drop conn
       bool compressed = (rtype == 2) && ks.codec != nullptr;
-      if (!is_replayed_push_locked(ks, flags, version) &&
+      dedupe = is_replayed_push_locked(ks, flags, version);
+      if (!dedupe &&
           !sum_push_locked(ks, flags, version, payload.data(), payload.size(),
-                           compressed, &flush, &fused_done))
+                           compressed, &flush, &fused_done, &published))
         return false;
     }
+    double t_summed = wall_now();
+    double sum_dur = t_summed - t_start - published;
+    if (sum_dur < 0) sum_dur = 0;
+    ks.sum_hist.observe(sum_dur);
+    if (published > 0) publish_hist_.observe(published);
+    if (trace_id) {
+      span(trace_id, span_id, key, t_start, sum_dur, kSpanSum,
+           dedupe ? kSpanFlagDedupe : 0);
+      if (published > 0)
+        span(trace_id, span_id, key, t_summed - published, published,
+             kSpanPublish);
+    }
     send_msg(conn, kPush, seq, key, version, nullptr, 0);
+    if (trace_id)
+      span(trace_id, span_id, key, t_summed, wall_now() - t_summed,
+           kSpanReply);
     for (auto& [pconn, pseq, data, ver] : flush)
       send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
     for (auto& fr : fused_done) send_fused_reply(fr);
@@ -1565,13 +1786,22 @@ class NativeServer {
   // are ledger-recorded, so a retransmitted frame re-sums nothing whose
   // original landed.
   bool handle_fused(const ConnPtr& conn, uint32_t seq, uint64_t route_key,
-                    uint8_t flags, const std::vector<uint8_t>& payload) {
+                    uint8_t flags, const std::vector<uint8_t>& payload,
+                    uint64_t trace_id, uint64_t span_id, double t_enq) {
     if (fenced(flags)) return false;  // evicted worker → drop conn
     std::vector<FusedMember> members;
-    if (!parse_fused_push(payload.data(), payload.size(), &members))
+    // member-span trailer (tracing): each member's sum/publish children
+    // parent onto ITS worker-side span; the pack's own span (outer
+    // header context) bounds recv — server.py _handle_fused parity
+    std::vector<uint64_t> member_spans;
+    if (!parse_fused_push(payload.data(), payload.size(), &members,
+                          trace_id ? &member_spans : nullptr))
       return false;  // malformed/empty fused frame → drop conn
     ctr_[kCtrFusedFrames].fetch_add(1, std::memory_order_relaxed);
     ctr_[kCtrFusedKeys].fetch_add(members.size(), std::memory_order_relaxed);
+    if (trace_id && t_enq > 0)
+      span(trace_id, span_id, route_key, t_enq, wall_now() - t_enq,
+           kSpanRecv, kSpanFlagFused);
     auto reply = std::make_shared<FusedReply>();
     reply->conn = conn;
     reply->seq = seq;
@@ -1592,13 +1822,18 @@ class NativeServer {
       std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>,
                              uint32_t>> flush;
       std::vector<FusedReplyPtr> fused_done;
+      double t_m0 = wall_now();
+      double published = 0.0;
+      bool dedupe = false;
+      ks.size_hist.observe((double)m.len);
       {
         std::lock_guard<std::mutex> g(ks.mu);
         if (ks.store.empty()) return false;  // member before init → drop
         bool compressed = (rtype == 2) && ks.codec != nullptr;
-        if (!is_replayed_push_locked(ks, flags, m.version) &&
+        dedupe = is_replayed_push_locked(ks, flags, m.version);
+        if (!dedupe &&
             !sum_push_locked(ks, flags, m.version, m.payload, m.len,
-                             compressed, &flush, &fused_done))
+                             compressed, &flush, &fused_done, &published))
           return false;
         // this member's pull half: answered now if its round is
         // published (async mode always is), else parked on the key
@@ -1609,6 +1844,21 @@ class NativeServer {
         } else {
           ks.fused_waiters.push_back({m.version, reply, slot, compressed});
         }
+      }
+      double t_m1 = wall_now();
+      double sum_dur = t_m1 - t_m0 - published;
+      if (sum_dur < 0) sum_dur = 0;
+      ks.sum_hist.observe(sum_dur);
+      if (published > 0) publish_hist_.observe(published);
+      if (trace_id) {
+        uint64_t parent = member_spans.size() == members.size()
+                              ? member_spans[slot]
+                              : span_id;
+        span(trace_id, parent, m.key, t_m0, sum_dur, kSpanSum,
+             kSpanFlagFused | (dedupe ? kSpanFlagDedupe : 0));
+        if (published > 0)
+          span(trace_id, parent, m.key, t_m1 - published, published,
+               kSpanPublish, kSpanFlagFused);
       }
       for (auto& [pconn, pseq, data, ver] : flush)
         send_msg(pconn, kPull, pseq, m.key, ver, data.data(), data.size());
@@ -1626,11 +1876,13 @@ class NativeServer {
   // through the normal PUSH path — ledger dedupe, fence, publish all
   // apply unchanged.
   bool handle_resync(const ConnPtr& conn, uint32_t seq, uint64_t route_key,
-                     const std::vector<uint8_t>& payload) {
+                     const std::vector<uint8_t>& payload, uint64_t trace_id,
+                     uint64_t span_id) {
     uint32_t wid = 0;
     std::vector<uint64_t> keys;
     if (!parse_resync_query(payload.data(), payload.size(), &wid, &keys))
       return false;  // malformed recovery frame → drop conn (Python parity)
+    double t0 = trace_id ? wall_now() : 0.0;
     ctr_[kCtrResyncQuery].fetch_add(1, std::memory_order_relaxed);
     if (keys.empty()) {
       std::lock_guard<std::mutex> g(keys_mu_);
@@ -1657,6 +1909,10 @@ class NativeServer {
     std::string body = encode_resync_state_bytes(states);
     send_msg(conn, kResyncState, seq, route_key, 0,
              (const uint8_t*)body.data(), body.size());
+    // the heal's server-side half joins the worker's RESYNC span on the
+    // merged Perfetto timeline (server.py _handle_resync parity)
+    if (trace_id)
+      span(trace_id, span_id, route_key, t0, wall_now() - t0, kSpanResync);
     return true;
   }
 
@@ -1668,7 +1924,7 @@ class NativeServer {
       const std::vector<uint8_t>& payload,
       std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>*
           flush,
-      std::vector<FusedReplyPtr>* fused_done) {
+      std::vector<FusedReplyPtr>* fused_done, double* publish_dur = nullptr) {
     uint32_t nrows, row_len;
     if (!rs_parse_header(payload, &nrows, &row_len)) return false;
     if (dtype_size(ks.dtype) != 4) return false;
@@ -1703,8 +1959,11 @@ class NativeServer {
     }
     ks.recv_count++;
     if (wid && version > 0) ks.push_seen[wid] = version;
-    if (ks.recv_count >= num_workers_.load())
+    if (ks.recv_count >= num_workers_.load()) {
+      double p0 = wall_now();
       publish_round_locked(ks, flush, fused_done);
+      if (publish_dur) *publish_dur = wall_now() - p0;
+    }
     return true;
   }
 
@@ -1743,10 +2002,14 @@ class NativeServer {
   }
 
   bool handle_pull(const ConnPtr& conn, uint32_t seq, uint64_t key, uint32_t cmd,
-                   uint32_t version, const std::vector<uint8_t>& payload) {
+                   uint32_t version, const std::vector<uint8_t>& payload,
+                   uint64_t trace_id, uint64_t span_id, double t_enq) {
     int32_t rtype, dtype;
     decode_cantor(cmd, &rtype, &dtype);
     auto& ks = key_state(key);
+    double t_start = trace_id ? wall_now() : 0.0;
+    if (trace_id && t_enq > 0)
+      span(trace_id, span_id, key, t_enq, t_start - t_enq, kSpanRecv);
     std::vector<uint8_t> data;
     uint32_t ver;
     {
@@ -1754,6 +2017,9 @@ class NativeServer {
       if (ks.store.empty()) return false;  // pull before init → drop conn
       bool ready = async_ || version <= ks.store_version;
       if (!ready) {
+        // parked: the round publish answers it; the worker-side PULL
+        // span keeps the wait attributable — no park span (server.py
+        // parity)
         ks.pending.push_back({version, conn, seq, rtype == 2,
                               rtype == 1 ? payload : std::vector<uint8_t>{}});
         return true;
@@ -1765,7 +2031,10 @@ class NativeServer {
       }
       ver = ks.store_version;
     }
+    double t_ready = trace_id ? wall_now() : 0.0;
     send_msg(conn, kPull, seq, key, ver, data.data(), data.size());
+    if (trace_id)
+      span(trace_id, span_id, key, t_ready, wall_now() - t_ready, kSpanReply);
     return true;
   }
 
@@ -1800,6 +2069,26 @@ class NativeServer {
   // observability counters (NativeCounter order; read via
   // bps_native_server_counters so GIL-free runs aren't metrics-blind)
   std::atomic<uint64_t> ctr_[kCtrCount] = {};
+  // span plane: default from the env (a directly-started engine traces
+  // iff the process would), overridden by bps_native_server_set_trace
+  // (NativePSServer pushes cfg.trace_on && cfg.trace_spans)
+  std::atomic<bool> trace_on_{[] {
+    const char* on = getenv("BYTEPS_TRACE_ON");
+    const char* sp = getenv("BYTEPS_TRACE_SPANS");
+    return on && atoi(on) != 0 && !(sp && atoi(sp) == 0);
+  }()};
+  SpanRing span_ring_;
+  bps_hist::Hist publish_hist_;
+
+  // one child-span record into the ring; a full ring drops + counts —
+  // the observer must never stall the data plane
+  void span(uint64_t trace_id, uint64_t parent, uint64_t key, double ts,
+            double dur, int32_t kind, uint32_t fl = 0) {
+    if (!trace_id) return;
+    SpanRec r{trace_id, parent, key, ts, dur < 0 ? 0 : dur, kind, fl};
+    if (!span_ring_.push(r))
+      ctr_[kCtrSpanDrop].fetch_add(1, std::memory_order_relaxed);
+  }
 };
 
 // several server instances may coexist in one process (multi-server
@@ -1874,6 +2163,44 @@ void bps_native_server_set_live_workers(int32_t port, const uint8_t* flags,
   std::lock_guard<std::mutex> g(g_server_mu);
   auto it = g_servers.find(port);
   if (it != g_servers.end()) it->second->set_live_workers(flags, n);
+}
+
+// Toggle an instance's span plane (NativePSServer pushes cfg.trace_on
+// && cfg.trace_spans; the engine's own default comes from the env).
+void bps_native_server_set_trace(int32_t port, int32_t on) {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  auto it = g_servers.find(port);
+  if (it != g_servers.end()) it->second->set_trace(on != 0);
+}
+
+// Drain up to cap child-span records (SpanRec layout, mirrored by
+// SPAN_REC_DTYPE in native/__init__.py) from an instance's trace ring.
+// The Python wrapper replays them into the process tracer, which writes
+// the same server<rank>/comm.json file tools/trace_merge.py stitches.
+// Returns the record count, 0 when empty, -1 for an unknown instance.
+int32_t bps_native_server_drain_spans(int32_t port, void* out, int32_t cap) {
+  // held across the drain (like the counters getter): stop() erases the
+  // instance under this lock before deleting it, so the pointer cannot
+  // dangle mid-pop
+  std::lock_guard<std::mutex> g(g_server_mu);
+  auto it = g_servers.find(port);
+  if (it == g_servers.end()) return -1;
+  return it->second->drain_spans((SpanRec*)out, cap);
+}
+
+// One instance's histograms + counters as a JSON document (see
+// NativeServer::metrics_json) — the feed behind the histogram-provider
+// seam in core/telemetry.py.  Returns bytes written, -(needed) when cap
+// is too small, or -1 for an unknown instance.
+int64_t bps_native_server_metrics_json(int32_t port, uint8_t* out,
+                                       uint64_t cap) {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  auto it = g_servers.find(port);
+  if (it == g_servers.end()) return -1;
+  std::string body = it->second->metrics_json();
+  if (body.size() > cap) return -(int64_t)body.size();
+  std::memcpy(out, body.data(), body.size());
+  return (int64_t)body.size();
 }
 
 // ---------------------------------------------------------------------------
@@ -1968,6 +2295,22 @@ int64_t bps_wire_fused_echo(const uint8_t* in, uint64_t len, uint8_t* out,
     }
   }
   return (int64_t)(p - out);
+}
+
+// Parse a fused-push body with the live decoder and return the
+// member-span TRAILER ids (host order) — the C++ side of
+// transport.decode_fused_spans, pinning the trailer parser the fused
+// tracing path (handle_fused member parenting) actually uses.  Returns
+// the id count (0 = no trailer), -1 on a parse failure, or -(needed)
+// when cap is too small.
+int64_t bps_wire_fused_spans_echo(const uint8_t* in, uint64_t len,
+                                  uint64_t* out, int64_t cap) {
+  std::vector<FusedMember> members;
+  std::vector<uint64_t> spans;
+  if (!parse_fused_push(in, len, &members, &spans)) return -1;
+  if ((int64_t)spans.size() > cap) return -(int64_t)spans.size();
+  for (size_t i = 0; i < spans.size(); ++i) out[i] = spans[i];
+  return (int64_t)spans.size();
 }
 
 // Parse a resync-query body with the live parser and echo it as

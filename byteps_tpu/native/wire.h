@@ -72,6 +72,17 @@ inline void pack_trace(uint8_t out[16], uint64_t trace_id, uint64_t span_id) {
   std::memcpy(out + 8, &s, 8);
 }
 
+// Inverse of pack_trace: decode the wire block into host-order ids
+// (server-side span stamping joins child spans onto these).
+inline void unpack_trace(const uint8_t in[16], uint64_t* trace_id,
+                         uint64_t* span_id) {
+  uint64_t t, s;
+  std::memcpy(&t, in, 8);
+  std::memcpy(&s, in + 8, 8);
+  *trace_id = be64toh(t);
+  *span_id = be64toh(s);
+}
+
 }  // namespace bps_wire
 
 #endif  // BYTEPS_TPU_NATIVE_WIRE_H_

@@ -370,8 +370,9 @@ class PSServer:
         self.rank = book["rank"]
         self.num_workers = book["num_workers"]
         self._adopt_worker_ranks(book)
-        # cross-process span identity (getattr: NativePSServer borrows
-        # this method and has no Python-side tracer)
+        # cross-process span identity (getattr keeps borrowed use safe;
+        # both PSServer and NativePSServer carry a tracer — the native
+        # wrapper's is fed by the engine's span-ring drain)
         tracer = getattr(self, "tracer", None)
         if tracer is not None:
             tracer.process_name = f"server{self.rank}"
@@ -1318,12 +1319,89 @@ class NativePSServer:
         # merge the engine's counters into the process scrape surface
         # (get_robustness_counters / Prometheus families / heartbeat
         # deltas) so GIL-free runs aren't metrics-blind
-        from byteps_tpu.core.telemetry import counters
-        from byteps_tpu.native import native_server_counters
+        from byteps_tpu.core.telemetry import counters, metrics
+        from byteps_tpu.native import (
+            native_server_counters,
+            native_server_histograms,
+            native_server_set_trace,
+        )
 
         sid = self._id
         self._counters_provider = lambda: native_server_counters(sid)
         counters().register_provider(self._counters_provider)
+        # …and the engine's histograms (per-key sum latency / request
+        # sizes, publish latency) through the histogram-provider seam —
+        # native_* families land in get_metrics(), Prometheus, and the
+        # heartbeat cluster aggregate (docs/observability.md)
+        self._hist_provider = lambda: native_server_histograms(sid)
+        metrics().register_hist_provider(self._hist_provider)
+        # span plane (docs/observability.md): the C++ engine stamps the
+        # same recv→sum→publish→reply child spans the Python server
+        # does, buffered in a native ring; this wrapper drains them into
+        # a process tracer that writes the same server<rank>/comm.json
+        # file tools/trace_merge.py stitches.
+        from byteps_tpu.core.tracing import Tracer, get_process_tracer, set_process_tracer
+
+        self.tracer = Tracer(
+            enabled=cfg.trace_on,
+            trace_dir=cfg.trace_dir,
+            local_rank="server",
+            process_name="server",
+            spans_enabled=cfg.trace_spans,
+        )
+        if get_process_tracer() is None:
+            set_process_tracer(self.tracer)
+        native_server_set_trace(sid, cfg.trace_on and cfg.trace_spans)
+        self._span_drain_thread: Optional[threading.Thread] = None
+        if cfg.trace_on and cfg.trace_spans:
+            self._span_drain_thread = threading.Thread(
+                target=self._span_drain_loop, name="bps-native-span-drain",
+                daemon=True,
+            )
+            self._span_drain_thread.start()
+
+    def _drain_spans_once(self) -> int:
+        """Replay the engine's buffered child-span records into the
+        tracer.  Child span ids are minted HERE (nothing references
+        them — children parent onto the wire-propagated worker span
+        ids, server.py _child_span parity), so the C++ side never needs
+        an id generator.  ``engine: "native"`` tags each span so
+        ``trace_merge.py --critical-path`` can attribute per engine."""
+        from byteps_tpu.core.tracing import new_trace_id, span_args
+        from byteps_tpu.native import (
+            NATIVE_SPAN_KINDS,
+            SPAN_FLAG_DEDUPE,
+            SPAN_FLAG_FUSED,
+            native_server_drain_spans,
+        )
+
+        recs = native_server_drain_spans(self._id)
+        for rec in recs:
+            kind = int(rec["kind"])
+            name = (
+                NATIVE_SPAN_KINDS[kind]
+                if 0 <= kind < len(NATIVE_SPAN_KINDS) else f"kind{kind}"
+            )
+            flags = int(rec["flags"])
+            extra = {"engine": "native"}
+            if name == "sum":
+                extra["dedupe"] = bool(flags & SPAN_FLAG_DEDUPE)
+            if flags & SPAN_FLAG_FUSED:
+                extra["fused"] = True
+            self.tracer.record_span(
+                f"key{int(rec['key'])}", name, float(rec["ts"]),
+                float(rec["dur"]),
+                span_args(int(rec["trace"]), new_trace_id(),
+                          parent_id=int(rec["parent"]), **extra),
+            )
+        return len(recs)
+
+    def _span_drain_loop(self) -> None:
+        while not self._stop.wait(0.1):
+            try:
+                self._drain_spans_once()
+            except Exception:  # noqa: BLE001 — the observer must not die loudly
+                return
 
     def native_counters(self) -> dict:
         """This instance's engine-side counters (``native_*`` names) —
@@ -1355,9 +1433,9 @@ class NativePSServer:
         )
 
     def start(self, register: bool = True) -> None:
-        # scrape surface even with the C++ data plane: the process-global
-        # registry still carries control-plane counters and gauges (the
-        # engine's per-RPC latency stays native-side, untracked)
+        # scrape surface with the C++ data plane: the process-global
+        # registry carries control-plane counters/gauges PLUS the
+        # engine's own counters and histograms via the provider seams
         if self.cfg.metrics_port > 0 and self._metrics_http is None:
             from byteps_tpu.core.telemetry import serve_metrics
 
@@ -1377,10 +1455,25 @@ class NativePSServer:
         # freeze the engine's final counter values BEFORE the instance
         # id disappears, so post-stop snapshots keep everything the
         # GIL-free plane counted (and a racing scrape can't double-count)
-        from byteps_tpu.core.telemetry import counters
+        from byteps_tpu.core.telemetry import counters, metrics
 
         counters().absorb_provider(self._counters_provider)
+        metrics().absorb_hist_provider(self._hist_provider)
+        if self._span_drain_thread is not None:
+            self._span_drain_thread.join(timeout=2.0)
+            self._span_drain_thread = None
+        # final span drain + flush while the instance still exists: the
+        # engine's last buffered children must reach server<rank>/comm.json
+        # or the merged timeline loses the server half of the tail (drain
+        # until empty — one call returns at most one ctypes batch, and a
+        # burst backlog can hold several)
+        try:
+            while self._drain_spans_once():
+                pass
+        except Exception:  # noqa: BLE001
+            pass
         self._lib.bps_native_server_stop(self._id)
+        self.tracer.flush()
         close_socket(self._sched_conn)
 
 

@@ -67,22 +67,35 @@ class TestStagingOverlap:
 
         engine._proceed = rec_proceed
         engine.client.push = rec_push
+        overlapped = False
         try:
-            x = jnp.arange(64 * 1024, dtype=jnp.float32)  # 64 partitions
-            out = bps.push_pull(x, name="overlap.x", average=False)
-            np.testing.assert_allclose(
-                np.asarray(out), np.arange(64 * 1024, dtype=np.float32)
-            )
+            # A loaded box can starve the stage threads long enough that
+            # one round drains every D2H before the first push fires —
+            # retry the measurement; genuinely serialized pipelining
+            # fails all rounds.
+            for _attempt in range(3):
+                with ev_lock:
+                    events.clear()
+                x = jnp.arange(64 * 1024, dtype=jnp.float32)  # 64 partitions
+                out = bps.push_pull(x, name="overlap.x", average=False)
+                np.testing.assert_allclose(
+                    np.asarray(out), np.arange(64 * 1024, dtype=np.float32)
+                )
+                with ev_lock:
+                    d2h = [t for kind, _, t in events if kind == "d2h_done"]
+                    push = [t for kind, _, t in events if kind == "push"]
+                assert len(d2h) == 64 and len(push) == 64
+                if min(push) < max(d2h):
+                    overlapped = True
+                    break
         finally:
             engine._proceed = orig_proceed
             engine.client.push = orig_push
             bps.shutdown()
 
-        d2h = [t for kind, _, t in events if kind == "d2h_done"]
-        push = [t for kind, _, t in events if kind == "push"]
-        assert len(d2h) == 64 and len(push) == 64
-        assert min(push) < max(d2h), (
+        assert overlapped, (
             "no overlap: every push happened after all D2H copies finished"
+            " in all 3 rounds"
         )
 
     def test_async_returns_before_materialization(self, small_partition_cluster):

@@ -40,13 +40,23 @@ _REQUIRED_SYMBOLS = (
     "bps_wire_resync_echo",
     "bpsc_create",
     "bpsc_drain",
+    # native observability parity (ISSUE 6): span drain + trace gate,
+    # histogram JSON feeds, trace-aware client send, golden shims
+    "bps_native_server_drain_spans",
+    "bps_native_server_set_trace",
+    "bps_native_server_metrics_json",
+    "bpsc_send2",
+    "bpsc_metrics_json",
+    "bps_wire_client_frame",
+    "bps_wire_fused_spans_echo",
 )
 
 
 def _sources():
     return sorted(
         glob.glob(os.path.join(_NATIVE_DIR, "*.cc"))
-        + [os.path.join(_NATIVE_DIR, "wire.h")]
+        + [os.path.join(_NATIVE_DIR, "wire.h"),
+           os.path.join(_NATIVE_DIR, "hist.h")]
     )
 
 

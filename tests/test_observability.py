@@ -14,8 +14,13 @@ Layers under test:
 - cross-process trace merge (tools/trace_merge.py) on a fake cluster
   with fusion + chaos-injected retries
 - the metrics catalog guard (tools/check_metrics_doc.py)
-- native-engine interop: the C++ server skips trace-context bytes on
-  uds/shm frames (old↔new frame interop)
+- native-engine interop: traced and untraced frames on one uds/shm
+  stream stay framed (old↔new frame interop)
+- native observability parity (ISSUE 6): the C++ engine's child spans
+  (recv→sum→publish→reply, dedupe-annotated, fused members parented on
+  trailer ids) drained into the process tracer; the histogram-provider
+  seam merging native_* histograms into snapshot/Prometheus/deltas;
+  trace_merge orphan accounting + --critical-path attribution
 """
 
 import json
@@ -773,3 +778,417 @@ class TestNativeTraceInterop:
             close_socket(sock)
         finally:
             srv.stop()
+
+
+class TestHistProviderSeam:
+    """The histogram twin of the counter-provider seam: external raw-
+    bucket records (the native engines' feed) must merge into EVERY read
+    surface and survive absorb/reset (pure-Python — no native lib)."""
+
+    REC = {
+        "name": "native_server_sum_seconds",
+        "labels": {"key": "7"},
+        "le": [0.001, 0.01],
+        "b": [2, 1, 1],  # raw counts incl. +Inf
+        "sum": 0.5,
+        "count": 4,
+    }
+
+    def _registry(self):
+        return MetricsRegistry()
+
+    def test_snapshot_and_prometheus_include_provider(self):
+        reg = self._registry()
+        reg.register_hist_provider(lambda: [dict(self.REC)])
+        snap = reg.snapshot()["histograms"]
+        assert snap['native_server_sum_seconds{key="7"}']["count"] == 4
+        text = reg.render_prometheus()
+        assert 'native_server_sum_seconds_bucket{key="7",le="0.001"} 2' in text
+        assert 'native_server_sum_seconds_count{key="7"} 4' in text
+        assert "native_server_sum_seconds_p50" in text
+
+    def test_provider_merges_into_local_family(self):
+        """A local histogram with the same (name, labels, bounds) and a
+        provider feed sum bucket-wise — one combined family."""
+        reg = self._registry()
+        h = reg.histogram("native_server_sum_seconds", labels={"key": "7"},
+                          buckets=(0.001, 0.01))
+        h.observe(0.0005)
+        reg.register_hist_provider(lambda: [dict(self.REC)])
+        snap = reg.snapshot()["histograms"]
+        assert snap['native_server_sum_seconds{key="7"}']["count"] == 5
+
+    def test_delta_ships_provider_increments_once(self):
+        reg = self._registry()
+        state = {"count": 4}
+        def provider():
+            rec = dict(self.REC)
+            rec["count"] = state["count"]
+            rec["b"] = [2, 1, state["count"] - 3]
+            return [rec]
+        reg.register_hist_provider(provider)
+        d1 = reg.delta_snapshot()
+        assert any(r["name"] == "native_server_sum_seconds" and r["n"] == 4
+                   for r in d1["h"])
+        assert not reg.delta_snapshot().get("h")  # nothing new
+        state["count"] = 6
+        d3 = reg.delta_snapshot()
+        assert any(r["n"] == 2 for r in d3["h"])
+
+    def test_absorb_preserves_totals_and_delta_continuity(self):
+        reg = self._registry()
+        fn = lambda: [dict(self.REC)]  # noqa: E731
+        reg.register_hist_provider(fn)
+        reg.delta_snapshot()  # baseline shipped
+        reg.absorb_hist_provider(fn)
+        snap = reg.snapshot()["histograms"]
+        assert snap['native_server_sum_seconds{key="7"}']["count"] == 4
+        # absorbed totals are unchanged → no spurious delta
+        assert not reg.delta_snapshot().get("h")
+
+    def test_reset_rebaselines_provider(self):
+        reg = self._registry()
+        reg.register_hist_provider(lambda: [dict(self.REC)])
+        assert reg.snapshot()["histograms"]
+        reg.reset()
+        # native source never clears, but post-reset view starts at zero
+        assert 'native_server_sum_seconds{key="7"}' not in (
+            reg.snapshot()["histograms"]
+        )
+
+    def test_malformed_records_dropped(self):
+        reg = self._registry()
+        reg.register_hist_provider(lambda: [
+            {"name": "x"},                              # missing fields
+            {"name": "y", "labels": {}, "le": [1.0],
+             "b": [1], "sum": 0, "count": 1},           # b too short
+            "not-a-dict",
+        ])
+        assert reg.snapshot()["histograms"] == {}
+
+
+@pytest.mark.skipif(not _have_native(), reason="native lib not built")
+class TestNativeServerChildSpans:
+    """Tentpole: the C++ engine stamps the same child-span model the
+    Python server does — drained through the span ring into the process
+    tracer (conftest's native timeout guards apply)."""
+
+    def _server(self, tmp_path, monkeypatch, num_worker=1):
+        from byteps_tpu.server.server import NativePSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "tcp")
+        cfg = Config(num_worker=num_worker, num_server=1, trace_on=True,
+                     trace_dir=str(tmp_path))
+        return NativePSServer(cfg)
+
+    def _wait_spans(self, srv, n, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with srv.tracer._lock:
+                events = [e for e in srv.tracer._events
+                          if e.get("cat") == "span"]
+            if len(events) >= n:
+                return events
+            time.sleep(0.05)
+        raise AssertionError(
+            f"native span drain produced {len(events)} events, wanted {n}"
+        )
+
+    def test_native_push_children_join_worker_span_and_dedupe(
+            self, tmp_path, monkeypatch):
+        srv = self._server(tmp_path, monkeypatch)
+        try:
+            sock = connect(srv.host, srv.port)
+            cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                   int(DataType.FLOAT32))
+            x = np.arange(8, dtype=np.float32)
+            send_message(sock, Message(
+                Op.INIT, key=3, seq=1, flags=1,
+                payload=struct.pack("!QI", 8, int(DataType.FLOAT32)),
+            ))
+            assert recv_message(sock).op == Op.INIT
+            send_message(sock, Message(
+                Op.PUSH, key=3, seq=2, flags=1, cmd=cmd, version=1,
+                payload=x.tobytes(), trace=(0xCAFE, 0xD00D),
+            ))
+            assert recv_message(sock).op == Op.PUSH
+            # replay (retry after a lost ack): dedupe-annotated sum span
+            send_message(sock, Message(
+                Op.PUSH, key=3, seq=3, flags=1, cmd=cmd, version=1,
+                payload=x.tobytes(), trace=(0xCAFE, 0xD00D),
+            ))
+            assert recv_message(sock).op == Op.PUSH
+            events = self._wait_spans(srv, 7)
+            assert {e["name"] for e in events} >= {"recv", "sum", "publish",
+                                                  "reply"}
+            for e in events:
+                assert e["args"]["trace"] == format(0xCAFE, "x")
+                assert e["args"]["parent"] == format(0xD00D, "x")
+                assert e["args"]["engine"] == "native"
+            sums = [e for e in events if e["name"] == "sum"]
+            assert [e["args"]["dedupe"] for e in sums] == [False, True]
+            assert srv.native_counters()["native_push_dedup"] == 1
+            from byteps_tpu.comm.transport import close_socket
+
+            close_socket(sock)
+        finally:
+            srv.stop()
+        # stop() flushed the drained spans to server<rank>/comm.json for
+        # the merge tool (rank unset → "server" subdir)
+        out = tmp_path / "server" / "comm.json"
+        assert out.exists()
+        written = json.load(open(out))["traceEvents"]
+        assert any(e.get("cat") == "span" for e in written)
+
+    def test_native_fused_members_parent_on_trailer_ids(self, tmp_path, monkeypatch):
+        srv = self._server(tmp_path, monkeypatch)
+        try:
+            sock = connect(srv.host, srv.port)
+            cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                   int(DataType.FLOAT32))
+            for key, seq in ((11, 1), (12, 2)):
+                send_message(sock, Message(
+                    Op.INIT, key=key, seq=seq, flags=1,
+                    payload=struct.pack("!QI", 4, int(DataType.FLOAT32)),
+                ))
+                assert recv_message(sock).op == Op.INIT
+            frame = encode_fused_push(
+                [(11, cmd, 1, np.ones(4, np.float32).tobytes()),
+                 (12, cmd, 1, np.full(4, 2.0, np.float32).tobytes())],
+                span_ids=[0x111, 0x222],
+            )
+            send_message(sock, Message(
+                Op.FUSED, key=11, seq=3, flags=1, cmd=2, payload=frame,
+                trace=(0xFACE, 0xF00),
+            ))
+            reply = recv_message(sock)
+            assert reply.op == Op.FUSED
+            events = self._wait_spans(srv, 3)
+            sums = [e for e in events if e["name"] == "sum"]
+            assert {e["args"]["parent"] for e in sums} == {
+                format(0x111, "x"), format(0x222, "x")
+            }
+            assert all(e["args"]["fused"] for e in sums)
+            assert all(e["args"]["trace"] == format(0xFACE, "x")
+                       for e in sums)
+            recvs = [e for e in events if e["name"] == "recv"]
+            assert recvs and recvs[0]["args"]["parent"] == format(0xF00, "x")
+            from byteps_tpu.comm.transport import close_socket
+
+            close_socket(sock)
+        finally:
+            srv.stop()
+
+    def test_native_spans_off_is_silent(self, tmp_path, monkeypatch):
+        """BYTEPS_TRACE_SPANS=0 semantics: trace-flagged frames are
+        consumed but the ring never sees a write."""
+        from byteps_tpu.server.server import NativePSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "tcp")
+        cfg = Config(num_worker=1, num_server=1, trace_on=True,
+                     trace_spans=False, trace_dir=str(tmp_path))
+        srv = NativePSServer(cfg)
+        try:
+            from byteps_tpu.native import native_server_drain_spans
+
+            sock = connect(srv.host, srv.port)
+            cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                   int(DataType.FLOAT32))
+            send_message(sock, Message(
+                Op.INIT, key=5, seq=1, flags=1,
+                payload=struct.pack("!QI", 4, int(DataType.FLOAT32)),
+                trace=(0xAB, 0xCD),
+            ))
+            assert recv_message(sock).op == Op.INIT
+            send_message(sock, Message(
+                Op.PUSH, key=5, seq=2, flags=1, cmd=cmd, version=1,
+                payload=np.ones(4, np.float32).tobytes(), trace=(0xAB, 0xCE),
+            ))
+            assert recv_message(sock).op == Op.PUSH
+            assert len(native_server_drain_spans(srv._id)) == 0
+            from byteps_tpu.comm.transport import close_socket
+
+            close_socket(sock)
+        finally:
+            srv.stop()
+
+
+@pytest.mark.skipif(not _have_native(), reason="native lib not built")
+class TestNativeHistogramSeam:
+    """Native server + client histograms reach get_metrics_text() and
+    survive source stop (conftest's native timeout guards apply)."""
+
+    def test_native_server_histograms_merge_and_survive_stop(
+            self, tmp_path, monkeypatch):
+        from byteps_tpu.server.server import NativePSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "tcp")
+        cfg = Config(num_worker=1, num_server=1)
+        srv = NativePSServer(cfg)
+        try:
+            sock = connect(srv.host, srv.port)
+            cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                   int(DataType.FLOAT32))
+            send_message(sock, Message(
+                Op.INIT, key=9, seq=1, flags=1,
+                payload=struct.pack("!QI", 8, int(DataType.FLOAT32)),
+            ))
+            assert recv_message(sock).op == Op.INIT
+            send_message(sock, Message(
+                Op.PUSH, key=9, seq=2, flags=1, cmd=cmd, version=1,
+                payload=np.ones(8, np.float32).tobytes(),
+            ))
+            assert recv_message(sock).op == Op.PUSH
+            text = metrics().render_prometheus()
+            assert 'native_server_sum_seconds_count{key="9"} 1' in text
+            assert 'native_request_bytes_count{key="9"} 1' in text
+            snap = metrics().snapshot()["histograms"]
+            assert snap['native_server_sum_seconds{key="9"}']["count"] == 1
+            from byteps_tpu.comm.transport import close_socket
+
+            close_socket(sock)
+        finally:
+            srv.stop()
+        # absorbed at stop: totals survive the instance
+        text = metrics().render_prometheus()
+        assert 'native_server_sum_seconds_count{key="9"} 1' in text
+
+    def test_native_client_rtt_histogram(self, monkeypatch):
+        from byteps_tpu.comm.ps_client import _NativeServerConn
+        from byteps_tpu.native import get_lib
+
+        lib = get_lib()
+        port = lib.bps_native_server_start(0, 1, 0)
+        assert port > 0
+        conn = None
+        try:
+            conn = _NativeServerConn("127.0.0.1", port)
+            done = threading.Event()
+            box = []
+
+            def cb(msg):
+                box.append(msg)
+                done.set()
+
+            seq = conn.alloc_seq(cb)
+            assert seq >= 0
+            conn.send_msg(Message(Op.PING, seq=seq, trace=(0x77, 0x88)))
+            assert done.wait(5.0) and box[0] is not None
+            text = metrics().render_prometheus()
+            assert "native_rpc_round_trip_seconds_count 1" in text
+        finally:
+            if conn is not None:
+                conn.close_all()
+            lib.bps_native_server_stop(port)
+        # absorbed at close: the attempt's latency survives
+        assert "native_rpc_round_trip_seconds_count 1" in (
+            metrics().render_prometheus()
+        )
+
+
+class TestTraceMergeAttribution:
+    """trace_merge satellites: orphaned-span accounting + the
+    --critical-path per-engine attribution pass (synthetic trace files —
+    no cluster needed)."""
+
+    def _write(self, d, name, events):
+        sub = d / name
+        sub.mkdir(parents=True, exist_ok=True)
+        with open(sub / "comm.json", "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def _span(self, pid, tid, name, ts_us, dur_us, trace, span=None,
+              parent=None, **extra):
+        args = {"trace": format(trace, "x")}
+        if span is not None:
+            args["span"] = format(span, "x")
+        if parent is not None:
+            args["parent"] = format(parent, "x")
+        args.update(extra)
+        return {"name": name, "cat": "span", "ph": "X", "ts": ts_us,
+                "dur": dur_us, "pid": pid, "tid": tid, "args": args}
+
+    def _merge_tool(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import trace_merge
+        finally:
+            sys.path.remove(os.path.join(REPO, "tools"))
+        return trace_merge
+
+    def test_orphans_counted_not_dropped_silently(self, tmp_path):
+        tm = self._merge_tool()
+        self._write(tmp_path, "server0", [
+            # child whose parent (a worker span) was never merged in —
+            # the worker file is "missing"
+            self._span("server0", "key1", "sum", 100, 10, trace=0xA1,
+                       span=0x10, parent=0xDEAD),
+        ])
+        merged = tm.merge(tm.find_trace_files([str(tmp_path)]))
+        assert merged["otherData"]["orphaned_spans"] == 1
+        assert merged["otherData"]["orphaned_parent_ids"] == 1
+        assert merged["otherData"]["linked_spans"] == 0
+
+    def test_critical_path_attributes_per_engine_and_stage(self, tmp_path):
+        tm = self._merge_tool()
+        T = 0xAA
+        # worker: one PUSH RPC-stage span (span 0x5), 0..1000µs
+        self._write(tmp_path, "0", [
+            self._span("worker0", "k", "PUSH", 0, 1000, trace=T, span=0x5),
+        ])
+        # python server: children covering 200..800µs
+        self._write(tmp_path, "server0", [
+            self._span("server0", "key1", "recv", 200, 100, trace=T,
+                       span=0x20, parent=0x5),
+            self._span("server0", "key1", "sum", 300, 300, trace=T,
+                       span=0x21, parent=0x5),
+            self._span("server0", "key1", "publish", 600, 100, trace=T,
+                       span=0x22, parent=0x5),
+            self._span("server0", "key1", "reply", 700, 100, trace=T,
+                       span=0x23, parent=0x5),
+        ])
+        # native server: a second worker RPC + engine-tagged children
+        self._write(tmp_path, "1", [
+            self._span("worker1", "k", "PUSH", 0, 500, trace=T, span=0x6),
+        ])
+        self._write(tmp_path, "server1", [
+            self._span("server1", "key1", "recv", 100, 50, trace=T,
+                       span=0x30, parent=0x6, engine="native"),
+            self._span("server1", "key1", "sum", 150, 200, trace=T,
+                       span=0x31, parent=0x6, engine="native"),
+        ])
+        merged = tm.merge(tm.find_trace_files([str(tmp_path)]))
+        attrib = tm.critical_path(merged)
+        assert set(attrib["engines"]) == {"python", "native"}
+        py = attrib["engines"]["python"]["stages"]
+        assert py["queue_wait"]["total_s"] == pytest.approx(100e-6)
+        assert py["sum"]["total_s"] == pytest.approx(300e-6)
+        assert py["publish"]["total_s"] == pytest.approx(100e-6)
+        assert py["reply"]["total_s"] == pytest.approx(100e-6)
+        # wire = worker extent (1000) - server extent (200..800 = 600)
+        assert py["wire"]["total_s"] == pytest.approx(400e-6)
+        nat = attrib["engines"]["native"]["stages"]
+        assert nat["sum"]["total_s"] == pytest.approx(200e-6)
+        # wire = 500 - (100..350 = 250)
+        assert nat["wire"]["total_s"] == pytest.approx(250e-6)
+        assert attrib["linked_rpcs"] == 2
+        shares = [d["share"] for d in py.values()]
+        assert sum(shares) == pytest.approx(1.0)
+
+    def test_cli_writes_attribution_artifact(self, tmp_path):
+        tm = self._merge_tool()
+        T = 0xBB
+        self._write(tmp_path, "0", [
+            self._span("worker0", "k", "PUSH", 0, 100, trace=T, span=0x9),
+        ])
+        self._write(tmp_path, "server0", [
+            self._span("server0", "key1", "sum", 10, 50, trace=T,
+                       span=0x40, parent=0x9),
+        ])
+        out = tmp_path / "merged.json"
+        attrib = tmp_path / "attrib.json"
+        rc = tm.main([str(tmp_path), "-o", str(out),
+                      "--critical-path", str(attrib)])
+        assert rc == 0
+        doc = json.load(open(attrib))
+        assert doc["engines"]["python"]["rpcs"] == 1

@@ -14,24 +14,42 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _run_quick() -> dict:
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "overlap_bench.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 class TestOverlapBench:
     def test_quick_run_produces_sane_artifact(self):
-        out = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "overlap_bench.py"),
-             "--quick"],
-            capture_output=True, text=True, timeout=600,
-        )
-        assert out.returncode == 0, out.stderr[-2000:]
-        d = json.loads(out.stdout.strip().splitlines()[-1])
+        """Tier-1: the harness stays executable and its artifact keeps
+        its shape.  FUNCTIONAL assertions only — wall-clock orderings at
+        quick scale are a known flake on loaded CI hosts (run-to-run
+        noise exceeds the margins) and live in the ``slow`` test below."""
+        d = _run_quick()
         med = d["median_step_s"]
         assert set(med) == {"full", "fifo", "nobarrier", "nopart", "none"}
         assert all(v > 0 for v in med.values())
-        # the two orderings that hold even at quick scale: a full barrier
-        # and unpartitioned tensors both cost wall-clock
+        # loss decreased over the quick run (it is a real training loop)
+        c = d["configs"]["full"]
+        assert c["loss_last"] < c["loss_first"]
+
+    @pytest.mark.slow
+    def test_quick_run_timing_orderings(self):
+        """The two orderings that hold even at quick scale — but only on
+        an unloaded machine, so this wall-clock assertion is gated out
+        of tier-1 (``-m slow``); the calibrated orderings are asserted
+        on the committed artifact below either way."""
+        med = _run_quick()["median_step_s"]
         assert med["full"] < med["nobarrier"] * 1.05
         assert med["full"] < med["nopart"]
 

@@ -106,6 +106,105 @@ class TestGoldenFrames:
         )
 
 
+#: sha256 of the CLIENT-encoder fixture stream (trace-flagged frames
+#: through bps_wire_client_frame, the live bpsc_send2 path) as frozen at
+#: the native-observability port
+CLIENT_GOLDEN_SHA256 = (
+    "f9f374ed7bfd26fe3aba64732883f46eccaea3661d0924852ee4414d639bd557"
+)
+
+
+def _client_frame(op, seq, key, cmd, version, flags, trace, payload) -> bytes:
+    """One frame through the LIVE native client encoder (the same
+    build_frame_head bytes bpsc_send2 writes)."""
+    lib = _lib()
+    out = (ctypes.c_uint8 * (len(payload) + 64))()
+    t, s = trace if trace else (0, 0)
+    n = lib.bps_wire_client_frame(
+        int(op), seq, key, cmd, version, flags, t, s, bytes(payload),
+        len(payload), out, len(out),
+    )
+    assert n > 0, f"bps_wire_client_frame failed: {n}"
+    return bytes(out[:n])
+
+
+def client_golden_frames() -> bytes:
+    """Trace-context fixtures through the native CLIENT encoder — the
+    direction the Python fixtures above don't pin (bps_wire_golden goes
+    through the server-side pack_header path; bpsc_send2's framing —
+    TRACE_FLAG status bit + 16-byte block placement — is what these
+    freeze).  Mirrors the transport.py frames 1:1."""
+    frames = [
+        # traced PUSH (the hot-path case: engine span context on a push)
+        (Op.PUSH, 21, 42, 6, 3, 1, (0x0123456789ABCDEF, 0x0FEDCBA987654321),
+         bytes(range(8))),
+        # traced PULL (empty payload + trace block)
+        (Op.PULL, 22, 42, 6, 3, 0, (0x1111111111111111, 0x2222222222222222),
+         b""),
+        # UNtraced PUSH through the same encoder (no block, status clean)
+        (Op.PUSH, 23, 42, 6, 4, 1, None, bytes(range(8))),
+        # traced FUSED frame whose body carries the member-span TRAILER
+        # (encode_fused_push span_ids) — trailer bytes ride as payload,
+        # outer header carries the pack's trace context
+        (Op.FUSED, 24, 101, 2, 0, 1, (0x3333333333333333, 0x4444444444444444),
+         encode_fused_push(
+             [(101, 6, 1, b"abcd"), (202, 11, 2, b"wxyz")],
+             span_ids=[0xAAAAAAAAAAAAAAA1, 0xBBBBBBBBBBBBBBB2],
+         )),
+    ]
+    return b"".join(_client_frame(*f) for f in frames)
+
+
+def python_client_golden_frames() -> bytes:
+    """The same frames via transport.py Message.encode."""
+    out = b""
+    out += Message(Op.PUSH, key=42, payload=bytes(range(8)), seq=21, cmd=6,
+                   version=3, flags=1,
+                   trace=(0x0123456789ABCDEF, 0x0FEDCBA987654321)).encode()
+    out += Message(Op.PULL, key=42, seq=22, cmd=6, version=3,
+                   trace=(0x1111111111111111, 0x2222222222222222)).encode()
+    out += Message(Op.PUSH, key=42, payload=bytes(range(8)), seq=23, cmd=6,
+                   version=4, flags=1).encode()
+    fused = encode_fused_push(
+        [(101, 6, 1, b"abcd"), (202, 11, 2, b"wxyz")],
+        span_ids=[0xAAAAAAAAAAAAAAA1, 0xBBBBBBBBBBBBBBB2],
+    )
+    out += Message(Op.FUSED, key=101, payload=fused, seq=24, cmd=2, flags=1,
+                   trace=(0x3333333333333333, 0x4444444444444444)).encode()
+    return out
+
+
+class TestClientGoldenFrames:
+    def test_client_encoder_matches_python(self):
+        if not hasattr(_lib(), "bps_wire_client_frame"):
+            pytest.skip("lib predates the client golden shim")
+        assert client_golden_frames() == python_client_golden_frames()
+
+    def test_client_frames_match_frozen_digest(self):
+        digest = hashlib.sha256(python_client_golden_frames()).hexdigest()
+        assert digest == CLIENT_GOLDEN_SHA256, (
+            "the trace-context wire format changed — a PROTOCOL revision: "
+            "update CLIENT_GOLDEN_SHA256 and audit every decoder"
+        )
+
+    def test_native_trailer_parser_recovers_span_ids(self):
+        """The fused member-span TRAILER through the live native parser
+        (the ids handle_fused parents member child spans onto) must
+        round-trip the Python encoder's ids exactly."""
+        lib = _lib()
+        if not hasattr(lib, "bps_wire_fused_spans_echo"):
+            pytest.skip("lib predates the trailer-parser shim")
+        members = [(101, 6, 1, b"abcd"), (1 << 40, 0, 9, b"")]
+        ids = [0x1234, (1 << 63) | 1]
+        body = encode_fused_push(members, span_ids=ids)
+        out = (ctypes.c_uint64 * 8)()
+        n = lib.bps_wire_fused_spans_echo(body, len(body), out, 8)
+        assert n == 2 and list(out[:2]) == ids
+        # trailer-less body: parser reports none (old-sender compat)
+        plain = encode_fused_push(members)
+        assert lib.bps_wire_fused_spans_echo(plain, len(plain), out, 8) == 0
+
+
 def _fused_echo(body: bytes) -> bytes:
     lib = _lib()
     out = (ctypes.c_uint8 * (len(body) + 64))()

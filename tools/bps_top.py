@@ -54,15 +54,26 @@ def _fmt_s(v: float) -> str:
 
 
 def _histo_rows(s: Sample) -> list:
+    """Latency rows with percentiles.  Native-engine families
+    (``byteps_native_*``, fed through the histogram-provider seam) sort
+    NEXT TO their Python twins — ``native_rpc_round_trip_seconds``
+    lands beside ``rpc_round_trip_seconds`` tagged ``[native]`` — so a
+    mixed-engine cluster reads in one screen."""
     rows = []
-    fams = sorted({
-        n[: -len("_p50")] for (n, _lbl) in s if n.endswith("_p50")
-    })
+    fams = sorted(
+        {n[: -len("_p50")] for (n, _lbl) in s if n.endswith("_p50")},
+        # group by the engine-stripped name, python row first
+        key=lambda f: (f.replace("byteps_native_", "byteps_"),
+                       "native_" in f),
+    )
     for fam in fams:
+        disp = fam.replace("byteps_", "")
+        if disp.startswith("native_"):
+            disp = disp[len("native_"):] + " [native]"
         for lbl in sorted({l for (n, l) in s if n == fam + "_p50"}):
             count = s.get((fam + "_count", lbl), 0)
             rows.append((
-                fam.replace("byteps_", "") + (lbl or ""),
+                disp + (lbl or ""),
                 int(count),
                 s.get((fam + "_p50", lbl), 0.0),
                 s.get((fam + "_p90", lbl), 0.0),

@@ -12,7 +12,13 @@ Scans ``byteps_tpu/`` for metric registrations/bumps —
 — and fails (exit 1) listing any name absent from the metric catalog in
 ``docs/observability.md``.  f-string names (``f"fusion_flush_{reason}"``)
 are matched by their literal prefix: at least one documented name must
-start with it.  Wired into tier-1 as
+start with it.
+
+The native C++ plane is covered too: every ``"native_*"`` string
+literal in ``byteps_tpu/native/*.cc`` (counter names in ps_server.cc's
+``kCounterNames``, histogram names at their registration sites) must
+appear in the catalog — the GIL-free engines' metric names rot exactly
+like the Python ones.  Wired into tier-1 as
 ``tests/test_observability.py::test_metrics_catalog_complete`` so the
 catalog cannot rot.
 
@@ -36,6 +42,12 @@ _CALL_RE = re.compile(
 #: metric names in the docs catalog: any backticked word-ish token
 _DOC_NAME_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
 
+#: a native metric name minted in C++ — any native_* string literal in
+#: the engine sources (counter name tables, histogram registration
+#: sites).  The native_ prefix is the naming contract
+#: (docs/observability.md), so the literal scan IS the registration scan.
+_NATIVE_NAME_RE = re.compile(r"\"(native_[a-z0-9_]+)\"")
+
 
 def discover_emitted(repo: str) -> dict:
     """{name_or_prefix: [file:line, ...]}; prefixes end with '*'."""
@@ -45,9 +57,19 @@ def discover_emitted(repo: str) -> dict:
         if "__pycache__" in root:
             continue
         for fn in files:
+            path = os.path.join(root, fn)
+            if fn.endswith(".cc"):
+                # native plane: scan the C++ sources' string literals for
+                # native_* metric names (counters + histograms)
+                with open(path) as f:
+                    text = f.read()
+                for m in _NATIVE_NAME_RE.finditer(text):
+                    line = text[: m.start()].count("\n") + 1
+                    rel = os.path.relpath(path, repo)
+                    found.setdefault(m.group(1), []).append(f"{rel}:{line}")
+                continue
             if not fn.endswith(".py"):
                 continue
-            path = os.path.join(root, fn)
             with open(path) as f:
                 text = f.read()
             for m in _CALL_RE.finditer(text):

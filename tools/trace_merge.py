@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """Stitch per-process byteps trace files into ONE cross-process timeline.
 
-Each worker writes ``<trace_dir>/<local_rank>/comm.json`` and each Python
-server ``<trace_dir>/server<rank>/comm.json`` (core/tracing.py).  Span
-events carry wire-propagated trace/span ids (docs/observability.md), so a
-worker's PUSH span and the server's recv→sum→publish→reply children share
-a trace id — but they live in separate files.  This tool:
+Each worker writes ``<trace_dir>/<local_rank>/comm.json`` and each server
+(Python engine directly, native C++ engine via the span-ring drain in
+NativePSServer) ``<trace_dir>/server<rank>/comm.json`` (core/tracing.py).
+Span events carry wire-propagated trace/span ids (docs/observability.md),
+so a worker's PUSH span and the server's recv→sum→publish→reply children
+share a trace id — but they live in separate files.  This tool:
 
 1. collects every ``comm.json`` under the given directories (or explicit
    file paths),
@@ -16,11 +17,21 @@ a trace id — but they live in separate files.  This tool:
 3. emits Chrome trace FLOW events (``ph: s/f``) linking every
    parent→child span pair found across processes, so Perfetto draws
    arrows from the worker RPC span into the server's child spans,
-4. writes one merged Perfetto-loadable JSON.
+4. counts ORPHANED children (parent id never seen — a missing server or
+   worker file) instead of silently dropping the arrow: a clean-looking
+   merge that actually lost a process now says so,
+5. writes one merged Perfetto-loadable JSON.
 
 Usage:
 
     python tools/trace_merge.py -o merged.json TRACE_DIR [TRACE_DIR ...]
+
+``--critical-path ATTRIB.json`` additionally walks the merged flow graph
+and attributes where the time of one training step went — engine-queue
+wait vs wire vs sum vs publish vs reply, split per engine (``python`` /
+``native``; native server children are tagged ``engine: "native"`` by
+the drain) — the baseline artifact the multi-core key-striping work is
+judged against (TRACE_ATTRIB_r06.json).
 
 Demo recipe (2 workers / 1 server, fused + chaos): docs/observability.md.
 """
@@ -95,13 +106,17 @@ def merge(files: List[str]) -> dict:
             events.append(ev)
 
     # flow events: arrow from the parent span (worker RPC) to each child
-    # (server-side stage).  One flow id per parent span.
+    # (server-side stage).  One flow id per parent span.  A child whose
+    # parent was never merged in (missing worker/server file, dropped
+    # window) is an ORPHAN — counted, not silently armless.
     flow_id = 0
     seen_parent_flow: Dict[str, int] = {}
+    orphan_parents: Dict[str, int] = {}
     flows: List[dict] = []
     for parent, cpid, ctid, cts in child_refs:
         anchor = by_span.get(parent)
         if anchor is None:
+            orphan_parents[parent] = orphan_parents.get(parent, 0) + 1
             continue  # parent span's process wasn't merged in
         ppid, ptid, pts, pdur = anchor
         fid = seen_parent_flow.get(parent)
@@ -125,8 +140,132 @@ def merge(files: List[str]) -> dict:
             "merged_from": files,
             "linked_spans": len(seen_parent_flow),
             "cross_process_children": len(child_refs),
+            # children whose parent id never appeared in any merged file
+            # — usually a process whose trace file is missing entirely
+            "orphaned_spans": sum(orphan_parents.values()),
+            "orphaned_parent_ids": len(orphan_parents),
         },
     }
+
+
+# --- critical-path attribution (docs/observability.md) ---------------------
+#
+# Walk the merged flow graph: every server child span names its stage
+# (recv = engine-queue wait, sum, publish, reply, resync) and parents
+# onto the worker span that caused it.  The worker side of the same RPC
+# is the PUSH / PULL / FUSE stage event carrying that span id.  Whatever
+# part of the worker-observed RPC the server stages don't cover is wire
+# + client overhead.  Aggregated per engine (the native server's drained
+# children carry ``engine: "native"``), per stage, and per trace (one
+# push_pull invocation = one trace = one step's worth of one tensor).
+
+#: worker pipeline stages that bound one wire RPC (engine.py stage names)
+_RPC_STAGES = {"PUSH", "PULL", "FUSE", "RESYNC", "INIT"}
+_SERVER_STAGES = ("recv", "sum", "publish", "reply", "resync")
+
+
+def critical_path(merged: dict) -> dict:
+    #: parent span id → {"extent": [min_ts, max_end] of worker RPC-stage
+    #: events, "any": [min_ts, max_end] of ANY owning event}
+    parents: Dict[str, dict] = {}
+    #: parent span id → list of child dicts
+    children: Dict[str, List[dict]] = {}
+    traces = set()
+    for ev in merged.get("traceEvents", []):
+        if ev.get("cat") != "span" or ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        span, parent = args.get("span"), args.get("parent")
+        if args.get("trace"):
+            traces.add(args["trace"])
+        if parent:
+            children.setdefault(parent, []).append({
+                "name": ev.get("name", ""),
+                "ts": float(ev.get("ts", 0.0)),
+                "dur": float(ev.get("dur", 0.0)),
+                "engine": args.get("engine", "python"),
+            })
+            continue
+        if span:
+            p = parents.setdefault(span, {"extent": None, "any": None})
+            t0 = float(ev.get("ts", 0.0))
+            t1 = t0 + float(ev.get("dur", 0.0))
+            which = "extent" if ev.get("name") in _RPC_STAGES else "any"
+            cur = p[which]
+            if cur is None:
+                p[which] = [t0, t1]
+            else:
+                cur[0] = min(cur[0], t0)
+                cur[1] = max(cur[1], t1)
+
+    engines: Dict[str, dict] = {}
+    for parent, kids in children.items():
+        engine = kids[0]["engine"]
+        agg = engines.setdefault(engine, {
+            "rpcs": 0,
+            "stages_us": {s: 0.0 for s in _SERVER_STAGES},
+            "wire_us": 0.0,
+            "wire_rpcs": 0,
+        })
+        agg["rpcs"] += 1
+        srv0, srv1 = None, None
+        for k in kids:
+            if k["name"] in agg["stages_us"]:
+                agg["stages_us"][k["name"]] += k["dur"]
+            t0, t1 = k["ts"], k["ts"] + k["dur"]
+            srv0 = t0 if srv0 is None else min(srv0, t0)
+            srv1 = t1 if srv1 is None else max(srv1, t1)
+        # wire + client overhead: the worker-observed RPC extent minus
+        # the server-side extent.  Same-host clocks (the demo recipe)
+        # make this meaningful; cross-host skew shows up as negative
+        # and is floored.
+        anchor = parents.get(parent)
+        extent = anchor and (anchor["extent"] or anchor["any"])
+        if extent is not None and srv0 is not None:
+            wire = max(0.0, (extent[1] - extent[0]) - (srv1 - srv0))
+            agg["wire_us"] += wire
+            agg["wire_rpcs"] += 1
+
+    out: Dict[str, dict] = {}
+    for engine, agg in engines.items():
+        total = sum(agg["stages_us"].values()) + agg["wire_us"]
+        stages = {}
+        for s in _SERVER_STAGES:
+            us = agg["stages_us"][s]
+            stages["queue_wait" if s == "recv" else s] = {
+                "total_s": us / 1e6,
+                "mean_s": us / 1e6 / agg["rpcs"] if agg["rpcs"] else 0.0,
+                "share": us / total if total else 0.0,
+            }
+        stages["wire"] = {
+            "total_s": agg["wire_us"] / 1e6,
+            "mean_s": (agg["wire_us"] / 1e6 / agg["wire_rpcs"]
+                       if agg["wire_rpcs"] else 0.0),
+            "share": agg["wire_us"] / total if total else 0.0,
+        }
+        out[engine] = {"rpcs": agg["rpcs"], "stages": stages}
+    return {
+        "traces": len(traces),
+        "linked_rpcs": sum(e["rpcs"] for e in out.values()),
+        "orphaned_spans": merged.get("otherData", {}).get("orphaned_spans", 0),
+        "engines": out,
+    }
+
+
+def _print_attribution(attrib: dict) -> None:
+    print(
+        f"critical path: {attrib['linked_rpcs']} linked RPC(s) across "
+        f"{attrib['traces']} trace(s)"
+    )
+    for engine, agg in sorted(attrib["engines"].items()):
+        print(f"  [{engine}] {agg['rpcs']} rpcs")
+        for stage, d in agg["stages"].items():
+            if d["total_s"] == 0.0:
+                continue
+            print(
+                f"    {stage:<11s} {d['total_s'] * 1e3:9.3f} ms total  "
+                f"{d['mean_s'] * 1e6:9.1f} µs/rpc  {d['share'] * 100:5.1f}%"
+            )
 
 
 def main(argv=None) -> int:
@@ -134,6 +273,12 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="+",
                     help="trace dirs (searched recursively) or comm.json files")
     ap.add_argument("-o", "--output", default="merged_trace.json")
+    ap.add_argument(
+        "--critical-path", metavar="ATTRIB_JSON", default=None,
+        help="also walk the merged flow graph and write a per-engine, "
+        "per-stage step-time attribution (queue wait / sum / publish / "
+        "reply / wire) to this path",
+    )
     args = ap.parse_args(argv)
     files = find_trace_files(args.paths)
     if not files:
@@ -143,12 +288,26 @@ def main(argv=None) -> int:
     with open(args.output, "w") as f:
         json.dump(merged, f)
     meta = merged["otherData"]
+    orphan_note = ""
+    if meta["orphaned_spans"]:
+        orphan_note = (
+            f", {meta['orphaned_spans']} ORPHANED span(s) across "
+            f"{meta['orphaned_parent_ids']} missing parent id(s) — a "
+            "process's trace file is probably missing"
+        )
     print(
         f"merged {len(files)} file(s) → {args.output}: "
         f"{len(merged['traceEvents'])} events, "
         f"{meta['linked_spans']} linked spans, "
         f"{meta['cross_process_children']} cross-process children"
+        f"{orphan_note}"
     )
+    if args.critical_path:
+        attrib = critical_path(merged)
+        with open(args.critical_path, "w") as f:
+            json.dump(attrib, f, indent=2, sort_keys=True)
+        _print_attribution(attrib)
+        print(f"attribution → {args.critical_path}")
     return 0
 
 
