@@ -102,7 +102,6 @@ class Config:
     server_engine_threads: int = 4  # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False  # BYTEPS_SERVER_ENABLE_SCHEDULE
     enable_async: bool = False  # BYTEPS_ENABLE_ASYNC
-
     # --- failure detection (ps-lite heartbeats, SURVEY §5.3) ---
     heartbeat_interval: float = 5.0  # BYTEPS_HEARTBEAT_INTERVAL; 0 disables
     # scheduler-side liveness policy: a registered node whose heartbeat

@@ -397,8 +397,11 @@ class MetricsRegistry:
         # returning raw-bucket records, merged into every read surface.
         # id → (fn, baseline captured at reset())
         self._hist_providers: Dict[int, tuple] = {}
-        self._gauges: Dict[str, float] = {}
-        self._gauge_fns: Dict[str, Callable[[], float]] = {}
+        # gauges keyed by (name, label set), like histograms — label
+        # combinations form one exposition family (the striped native
+        # engine's native_stripe_queue_depth{stripe} is the first user)
+        self._gauges: Dict[Tuple[str, tuple], float] = {}
+        self._gauge_fns: Dict[Tuple[str, tuple], Callable[[], float]] = {}
         # delta baseline for heartbeat piggyback.  Normally one consumer
         # per process (the heartbeat loop), but in-process test clusters
         # run worker + server beats against one shared registry — the
@@ -425,14 +428,26 @@ class MetricsRegistry:
                 buckets: Tuple[float, ...] = LATENCY_BUCKETS) -> None:
         self.histogram(name, labels, buckets).observe(value)
 
-    def gauge_set(self, name: str, value: float) -> None:
+    def gauge_set(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
-            self._gauges[name] = float(value)
+            self._gauges[(name, _label_key(labels))] = float(value)
 
-    def gauge_fn(self, name: str, fn: Callable[[], float]) -> None:
+    def gauge_fn(self, name: str, fn: Callable[[], float],
+                 labels: Optional[Dict[str, str]] = None) -> None:
         """Lazy gauge: ``fn()`` is sampled at exposition time."""
         with self._lock:
-            self._gauge_fns[name] = fn
+            self._gauge_fns[(name, _label_key(labels))] = fn
+
+    def gauge_remove(self, name: str,
+                     labels: Optional[Dict[str, str]] = None) -> None:
+        """Drop one gauge series — how a stopping source (a native
+        server's per-stripe depth feeds) leaves the scrape surface
+        instead of exporting a dead callable forever."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._gauges.pop(key, None)
+            self._gauge_fns.pop(key, None)
 
     # --- histogram providers (native C++ engines) ------------------------
 
@@ -610,12 +625,15 @@ class MetricsRegistry:
                 name: {_render_labels(k) or "{}": v for k, v in per.items()}
                 for name, per in self.counters.snapshot_labeled().items()
             },
-            "gauges": dict(gauges),
+            "gauges": {
+                name + _render_labels(lkey): v
+                for (name, lkey), v in gauges.items()
+            },
             "histograms": {},
         }
-        for name, fn in gauge_fns.items():
+        for (name, lkey), fn in gauge_fns.items():
             try:
-                out["gauges"][name] = float(fn())
+                out["gauges"][name + _render_labels(lkey)] = float(fn())
             except Exception:  # noqa: BLE001 — a broken gauge can't break scrape
                 continue
         for (name, lkey), st in self._hist_states().items():
@@ -657,15 +675,21 @@ class MetricsRegistry:
         with self._lock:
             gauges = dict(self._gauges)
             gauge_fns = dict(self._gauge_fns)
-        for name, fn in gauge_fns.items():
+        for gkey, fn in gauge_fns.items():
             try:
-                gauges[name] = float(fn())
+                gauges[gkey] = float(fn())
             except Exception:  # noqa: BLE001
                 continue
-        for name in sorted(gauges):
+        # label combinations group under one TYPE line per family, like
+        # the histogram exposition below
+        g_fams: Dict[str, List[Tuple[tuple, float]]] = {}
+        for (name, lkey), v in gauges.items():
+            g_fams.setdefault(name, []).append((lkey, v))
+        for name in sorted(g_fams):
             metric = f"{prefix}{name}"
             lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {gauges[name]}")
+            for lkey, v in sorted(g_fams[name]):
+                lines.append(f"{metric}{_render_labels(lkey)} {v}")
         # combined local + provider histograms (native_* families merge
         # into the same exposition the Python engines feed)
         by_family: Dict[str, List[Tuple[tuple, list]]] = {}

@@ -43,12 +43,15 @@ assert DRAIN_REC_DTYPE.itemsize == 56
 
 #: SpanRec mirror (ps_server.cc — change both together): one child-span
 #: record drained from the native engine's trace ring via
-#: ``bps_native_server_drain_spans`` (docs/observability.md)
+#: ``bps_native_server_drain_spans`` (docs/observability.md).  ``stripe``
+#: is the reducer lane that executed the stage (-1 = a serve/control
+#: thread); the drain maps each stripe to its own Perfetto track.
 SPAN_REC_DTYPE = np.dtype([
     ("trace", "<u8"), ("parent", "<u8"), ("key", "<u8"),
     ("ts", "<f8"), ("dur", "<f8"), ("kind", "<i4"), ("flags", "<u4"),
+    ("stripe", "<i4"), ("_pad", "<u4"),
 ])
-assert SPAN_REC_DTYPE.itemsize == 48
+assert SPAN_REC_DTYPE.itemsize == 56
 
 #: SpanKind index order (ps_server.cc) → span names matching the Python
 #: server's child-span model (server.py _child_span call sites)
@@ -168,6 +171,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             c.c_void_p, c.c_uint64,
         ]
         lib.bps_wire_client_frame.restype = c.c_int64
+    # key-striped reducer plane (ISSUE 7): per-stripe queue-depth feed +
+    # the live key→stripe mapping shim — also the layout marker for the
+    # 56-byte SpanRec (older libs drained 48-byte records)
+    if hasattr(lib, "bps_native_server_stripe_queue_depths"):
+        lib.bps_native_server_stripe_queue_depths.argtypes = [
+            c.c_int32, c.POINTER(c.c_uint64), c.c_int32,
+        ]
+        lib.bps_native_server_stripe_queue_depths.restype = c.c_int32
+        lib.bps_wire_key_stripe.argtypes = [c.c_uint64, c.c_int32]
+        lib.bps_wire_key_stripe.restype = c.c_int32
     # native worker client data plane (ps_client.cc) — may be absent in a
     # stale .so; the pure-Python client covers every van without it
     if hasattr(lib, "bpsc_create"):
@@ -217,12 +230,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None  # corrupt/partial .so → pure-Python fallbacks
-    if not hasattr(lib, "bps_native_server_drain_spans") and autobuild:
+    if not hasattr(lib, "bps_native_server_stripe_queue_depths") and autobuild:
         # stale library from before the newest entry points (currently
-        # the observability-parity surface: span drain + histogram
-        # feeds + trace-aware client send): rebuild, then load via a
-        # temp COPY — dlopen dedups by path/inode, so reloading the
-        # original path can hand back the old mapping
+        # the key-striped reducer plane: stripe-depth feed + key→stripe
+        # shim — also the 56-byte SpanRec layout marker): rebuild, then
+        # load via a temp COPY — dlopen dedups by path/inode, so
+        # reloading the original path can hand back the old mapping
         _try_build()
         try:
             import shutil
@@ -234,7 +247,7 @@ def _load() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy(_LIB_PATH, tmp.name)
             fresh = ctypes.CDLL(tmp.name)
-            if hasattr(fresh, "bps_native_server_drain_spans"):
+            if hasattr(fresh, "bps_native_server_stripe_queue_depths"):
                 lib = fresh
         except OSError:
             pass
@@ -332,9 +345,13 @@ def native_server_drain_spans(server_id: int, max_recs: int = 4096):
     returns a structured ndarray of :data:`SPAN_REC_DTYPE` records
     (empty once the instance is stopped or the lib predates the span
     plane).  The caller — NativePSServer's drain loop — replays them
-    into the process tracer."""
+    into the process tracer.  Gated on the striping surface too: a
+    pre-striping lib writes 48-byte records the 56-byte dtype would
+    mis-decode."""
     lib = _load()
-    if lib is None or not hasattr(lib, "bps_native_server_drain_spans"):
+    if (lib is None
+            or not hasattr(lib, "bps_native_server_drain_spans")
+            or not hasattr(lib, "bps_native_server_stripe_queue_depths")):
         return np.zeros(0, dtype=SPAN_REC_DTYPE)
     recs = np.zeros(max_recs, dtype=SPAN_REC_DTYPE)
     n = lib.bps_native_server_drain_spans(
@@ -343,6 +360,31 @@ def native_server_drain_spans(server_id: int, max_recs: int = 4096):
     if n <= 0:
         return np.zeros(0, dtype=SPAN_REC_DTYPE)
     return recs[:n]
+
+
+def native_server_stripe_depths(server_id: int) -> list:
+    """Current task backlog per reducer stripe of one native server
+    instance (the ``native_stripe_queue_depth{stripe}`` gauge feed;
+    docs/perf.md hot-stripe note).  Empty once the instance is stopped
+    or the lib predates the striping surface."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "bps_native_server_stripe_queue_depths"):
+        return []
+    out = (ctypes.c_uint64 * 64)()
+    n = lib.bps_native_server_stripe_queue_depths(server_id, out, 64)
+    if n <= 0:
+        return []
+    return [int(out[i]) for i in range(n)]
+
+
+def key_stripe(key: int, n_stripes: int) -> int:
+    """The live key→reducer-stripe mapping (wire.h ``key_stripe``), or
+    ``key % n_stripes`` as a stand-in when the lib is unavailable (only
+    tests use this helper; the engine always uses the native hash)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "bps_wire_key_stripe"):
+        return int(key) % max(1, int(n_stripes))
+    return int(lib.bps_wire_key_stripe(key, n_stripes))
 
 
 def native_server_set_trace(server_id: int, on: bool) -> None:

@@ -3,16 +3,23 @@
 // C++ end-to-end server engine matching byteps/server/server.cc's role
 // (SURVEY §2.3): per-connection reader threads parse the framed protocol
 // (byteps_tpu/comm/transport.py: 32-byte big-endian header + payload) and
-// execute the KV semantics under per-key locks — init-as-barrier,
-// COPY_FIRST/SUM_RECV/ALL_RECV rounds with buffered pulls, async
-// parameter-store mode, and server-side compression (decompress-or-
-// sparse-sum on push, compress-merged for pulls, optional error feedback;
-// momentum is worker-only, compressor_registry.cc:40-56).
+// hand decoded frames to a KEY-STRIPED reducer plane — the key space is
+// sharded by hash across N reducer threads (BYTEPS_SERVER_STRIPES), each
+// owning its keys' entire state (rounds, exactly-once ledger, init/fused
+// waiters, publish cache) behind one per-stripe lock, fed through a
+// bounded lock-free task ring.  KV semantics are unchanged:
+// init-as-barrier, COPY_FIRST/SUM_RECV/ALL_RECV rounds with buffered
+// pulls, async parameter-store mode, and server-side compression
+// (decompress-or-sparse-sum on push, compress-merged for pulls, optional
+// error feedback; momentum is worker-only, compressor_registry.cc:40-56).
+// Op.FUSED frames are decoded on the I/O thread, members scatter to
+// their stripes, and an atomic-countdown gather emits the single
+// multi-key reply (docs/architecture.md "Key striping").
 //
 // Control plane (scheduler registration, barriers, heartbeats) stays in
 // the Python wrapper — this engine owns only the worker-facing data
-// socket, where the throughput is.  No GIL: reader threads sum on all
-// cores through the same vectorized kernels in reducer.cc/compressor.cc.
+// socket, where the throughput is.  No GIL: reducers sum on all cores
+// through the same vectorized kernels in reducer.cc/compressor.cc.
 
 #include <arpa/inet.h>
 #include <endian.h>
@@ -159,8 +166,13 @@ struct SpanRec {
   double dur;           // seconds
   int32_t kind;         // SpanKind
   uint32_t flags;       // kSpanFlag*
+  // reducer stripe that executed the stage (-1 = a serve/control thread:
+  // resync answers, fused-frame decode).  The drain maps each stripe to
+  // its own Perfetto lane so the merged timeline shows reducer occupancy.
+  int32_t stripe;
+  uint32_t pad_;
 };
-static_assert(sizeof(SpanRec) == 48, "SpanRec layout drifted");
+static_assert(sizeof(SpanRec) == 56, "SpanRec layout drifted");
 
 double wall_now() {
   timespec ts;
@@ -939,12 +951,35 @@ static bool rs_parse_header(const std::vector<uint8_t>& p, uint32_t* nrows,
 }
 
 // ---------------------------------------------------------------------------
-// engine queue plane (server.cc:82-202, queue.h:49-97): N engine threads,
-// each owning a priority queue; with BYTEPS_SERVER_ENABLE_SCHEDULE=1 the
-// queue pops the key with the fewest accumulated pushes first
-// (anti-starvation), else FIFO.  Keys pin to one thread (least-loaded
-// cached assignment, server.h:154-178) so per-key processing stays ordered.
+// key-striped reducer plane (docs/architecture.md "Key striping").  The
+// key space is sharded across N reducer threads by hash
+// (wire.h key_stripe; BYTEPS_SERVER_STRIPES, default min(4, cores)):
+// each stripe owns its keys' ENTIRE mutable state — store/accum rounds,
+// the exactly-once ledger, init/fused waiters, publish cache — behind
+// ONE per-stripe lock, and a bounded MPSC task ring carries decoded
+// frames from the I/O (serve) threads to the stripe's reducer.  Keys
+// are independent, so stripes never take each other's locks: sum and
+// publish parallelize embarrassingly, and nothing global sits on the
+// hot path (the previous engine plane took a process-wide keys_mu_ +
+// tid_mu_ on EVERY data frame).  With BYTEPS_SERVER_ENABLE_SCHEDULE=1
+// a stripe swaps its ring for the reference's anti-starvation priority
+// queue (fewest accumulated pushes first, queue.h:49-97).  Per-key
+// ordering is preserved: one key always maps to one stripe, and the
+// serve thread enqueues a connection's frames in arrival order.
+//
+// BYTEPS_SERVER_STRIPES=1 (striping off) takes an INLINE fast path:
+// with one shard there is nothing to parallelize, so paying the
+// ring hop + reducer wakeup per frame only adds scheduling latency
+// (~2.5x round time on an oversubscribed box).  The serve thread runs
+// the handler directly — the pre-striping engine shape — under the
+// same shard lock, so semantics are identical to the queued path and
+// ordering still follows the connection's arrival order.
 // ---------------------------------------------------------------------------
+
+// internal task kind for a fused member scattered to its own stripe
+// (the serve thread decodes Op.FUSED and fans the members out; distinct
+// from the wire ops so the reducer switch stays unambiguous)
+constexpr uint8_t kTaskFusedMember = 0xFE;
 
 struct EngineTask {
   uint8_t op = 0;
@@ -961,6 +996,94 @@ struct EngineTask {
   uint64_t span_id = 0;
   double t_enq = 0.0;
   std::vector<uint8_t> payload;
+  // fused-member scatter state (op == kTaskFusedMember): the member's
+  // payload is a VIEW (off/len) into the shared frame buffer — one frame
+  // allocation serves every member task, refcounted until the last
+  // stripe finishes — and the gather accumulator + slot say where this
+  // member's pull-half lands in the single multi-key reply.
+  std::shared_ptr<std::vector<uint8_t>> frame;
+  uint64_t off = 0, len = 0;
+  FusedReplyPtr freply;
+  uint32_t slot = 0;
+  uint64_t member_span = 0;  // trailer span id (0 = no trailer)
+};
+
+// Bounded lock-free MPMC ring of tasks (same Vyukov shape as SpanRing)
+// — the SPSC-per-producer handoff from I/O threads to one stripe's
+// reducer.  Unlike the span ring, a full ring must NOT drop (tasks are
+// protocol state): producers back off in Stripe::put.  1024 tasks of
+// in-flight backlog per stripe bounds memory without throttling the
+// common case (rounds drain in microseconds).
+class TaskRing {
+ public:
+  static constexpr size_t kCap = 1 << 10;
+
+  TaskRing() {
+    for (size_t i = 0; i < kCap; ++i)
+      slots_[i].seq.store(i, std::memory_order_relaxed);
+  }
+
+  // moves from t ONLY on success; a full ring leaves t intact
+  bool try_push(EngineTask& t) {
+    size_t pos = head_.load(std::memory_order_relaxed);
+    for (;;) {
+      Slot& s = slots_[pos & (kCap - 1)];
+      size_t seq = s.seq.load(std::memory_order_acquire);
+      intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+      if (dif == 0) {
+        if (head_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed))
+          break;
+      } else if (dif < 0) {
+        return false;  // full: caller backs off and retries
+      } else {
+        pos = head_.load(std::memory_order_relaxed);
+      }
+    }
+    Slot& s = slots_[pos & (kCap - 1)];
+    s.task = std::move(t);
+    s.seq.store(pos + 1, std::memory_order_release);
+    return true;
+  }
+
+  bool try_pop(EngineTask* out) {
+    size_t pos = tail_.load(std::memory_order_relaxed);
+    for (;;) {
+      Slot& s = slots_[pos & (kCap - 1)];
+      size_t seq = s.seq.load(std::memory_order_acquire);
+      intptr_t dif = (intptr_t)seq - (intptr_t)(pos + 1);
+      if (dif == 0) {
+        if (tail_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed))
+          break;
+      } else if (dif < 0) {
+        return false;  // empty
+      } else {
+        pos = tail_.load(std::memory_order_relaxed);
+      }
+    }
+    Slot& s = slots_[pos & (kCap - 1)];
+    *out = std::move(s.task);
+    s.task = EngineTask{};  // release conn/frame refs in the slot NOW
+    s.seq.store(pos + kCap, std::memory_order_release);
+    return true;
+  }
+
+  // approximate backlog (relaxed reads): the hot-stripe imbalance gauge
+  size_t depth() const {
+    size_t h = head_.load(std::memory_order_relaxed);
+    size_t t = tail_.load(std::memory_order_relaxed);
+    return h >= t ? h - t : 0;
+  }
+
+ private:
+  struct Slot {
+    std::atomic<size_t> seq;
+    EngineTask task;
+  };
+  Slot slots_[kCap];
+  alignas(64) std::atomic<size_t> head_{0};
+  alignas(64) std::atomic<size_t> tail_{0};
 };
 
 class EngineQueue {
@@ -985,6 +1108,11 @@ class EngineQueue {
     return true;
   }
 
+  size_t size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return items_.size();
+  }
+
  private:
   struct Item {
     uint64_t prio;
@@ -1004,8 +1132,13 @@ class EngineQueue {
   std::condition_variable cv_;
 };
 
+// One key's full server-side state.  Since the key-striping port there
+// is no per-key mutex: a key lives on exactly one stripe (wire.h
+// key_stripe) and every mutation happens under that stripe's shard lock
+// — either on the stripe's reducer thread (sums, publishes) or on a
+// control-plane thread that takes the same lock (init barrier, resync
+// snapshot, compressor registration, resize).
 struct KeyState {
-  std::mutex mu;
   std::vector<uint8_t> store, accum;
   int32_t dtype = 0;
   int64_t nelems = 0;
@@ -1044,37 +1177,47 @@ class NativeServer {
   void set_num_workers(int n) {
     num_workers_.store(n);
     if (n <= 0) return;
-    std::vector<std::pair<uint64_t, KeyState*>> all;
-    {
-      std::lock_guard<std::mutex> g(keys_mu_);
-      for (auto& [k, ks] : keys_) all.emplace_back(k, ks.get());
-    }
     // an init barrier that is now full releases immediately: survivors
     // blocked in the init RPC must not wait forever for an evicted
-    // worker's INIT (mirrors the Python server's update_num_workers)
-    for (auto& [key, ks] : all) {
-      std::vector<InitWaiter> waiters;
+    // worker's INIT (mirrors the Python server's update_num_workers).
+    // One stripe at a time — stripe locks never nest — and sends happen
+    // OUTSIDE the shard lock, same discipline as the reducers.
+    for (auto& stp : stripes_) {
+      std::vector<std::pair<uint64_t, std::vector<InitWaiter>>> released;
       {
-        std::lock_guard<std::mutex> g(ks->mu);
-        if ((int)ks->init_waiters.size() >= n)
-          complete_init_barrier_locked(*ks, &waiters);
+        std::lock_guard<std::mutex> g(stp->mu);
+        for (auto& [key, ks] : stp->keys) {
+          if ((int)ks->init_waiters.size() >= n) {
+            std::vector<InitWaiter> waiters;
+            complete_init_barrier_locked(*ks, &waiters);
+            released.emplace_back(key, std::move(waiters));
+          }
+        }
       }
-      for (auto& w : waiters) send_msg(w.conn, kInit, w.seq, key, 0, nullptr, 0);
+      for (auto& [key, waiters] : released)
+        for (auto& w : waiters)
+          send_msg(w.conn, kInit, w.seq, key, 0, nullptr, 0);
     }
     if (async_) return;
     // elastic scale-down: a round that already holds >= n pushes will
     // never see the departed workers' contributions — publish it now and
     // flush its buffered pulls (mirrors the Python server)
-    for (auto& [key, ks] : all) {
-      std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>>
-          flush;
+    for (auto& stp : stripes_) {
+      std::vector<std::tuple<uint64_t, ConnPtr, uint32_t, std::vector<uint8_t>,
+                             uint32_t>> flush;
       std::vector<FusedReplyPtr> fused_done;
       {
-        std::lock_guard<std::mutex> g(ks->mu);
-        if (ks->store.empty() || ks->recv_count < n) continue;
-        publish_round_locked(*ks, &flush, &fused_done);
+        std::lock_guard<std::mutex> g(stp->mu);
+        for (auto& [key, ks] : stp->keys) {
+          if (ks->store.empty() || ks->recv_count < n) continue;
+          std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>,
+                                 uint32_t>> kf;
+          publish_round_locked(*ks, &kf, &fused_done);
+          for (auto& [pconn, pseq, data, ver] : kf)
+            flush.emplace_back(key, pconn, pseq, std::move(data), ver);
+        }
       }
-      for (auto& [pconn, pseq, data, ver] : flush)
+      for (auto& [key, pconn, pseq, data, ver] : flush)
         send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
       for (auto& fr : fused_done) send_fused_reply(fr);
     }
@@ -1101,6 +1244,18 @@ class NativeServer {
     return n;
   }
 
+  // current per-stripe task backlog (approximate, relaxed reads) — the
+  // native_stripe_queue_depth{stripe} gauge feed: a persistently deep
+  // stripe while its siblings idle means the key hash is aliasing hot
+  // keys onto one reducer (docs/perf.md)
+  int32_t read_stripe_depths(uint64_t* out, int32_t cap) const {
+    int32_t n = std::min<int32_t>(cap, (int32_t)stripes_.size());
+    for (int32_t i = 0; i < n; ++i)
+      out[i] = stripes_[i]->pq ? stripes_[i]->pq->size()
+                               : stripes_[i]->ring.depth();
+    return n;
+  }
+
   // span plane on/off (NativePSServer mirrors cfg.trace_on &&
   // cfg.trace_spans here; the env default below covers direct starts)
   void set_trace(bool on) { trace_on_.store(on, std::memory_order_relaxed); }
@@ -1118,15 +1273,24 @@ class NativeServer {
   std::string metrics_json() {
     std::string out = "{\"histograms\": [";
     std::vector<std::pair<uint64_t, KeyState*>> all;
-    {
-      std::lock_guard<std::mutex> g(keys_mu_);
-      for (auto& [k, ks] : keys_) all.emplace_back(k, ks.get());
+    for (auto& stp : stripes_) {
+      std::lock_guard<std::mutex> g(stp->mu);
+      for (auto& [k, ks] : stp->keys) all.emplace_back(k, ks.get());
     }
     for (auto& [key, ks] : all) {
       std::string kv = std::to_string(key);
       ks->sum_hist.append_json(&out, "native_server_sum_seconds", "key", kv);
       ks->size_hist.append_json(&out, "native_request_bytes", "key", kv);
     }
+    // Per-reducer summation occupancy, labeled by stripe — a SEPARATE
+    // family from the per-key native_server_sum_seconds (same rule as
+    // the *_labeled_total counter families: one family whose series
+    // overlap the same observations would double-count under sum()).
+    // A hot stripe (bad key hash / skewed tensor sizes) shows up as one
+    // stripe's count/sum running away from its siblings.
+    for (size_t i = 0; i < stripes_.size(); ++i)
+      stripes_[i]->sum_hist.append_json(&out, "native_stripe_sum_seconds",
+                                        "stripe", std::to_string(i));
     publish_hist_.append_json(&out, "native_server_publish_seconds", nullptr,
                               "");
     out += "], \"counters\": {";
@@ -1196,9 +1360,10 @@ class NativeServer {
     if (accept_thread_.joinable()) accept_thread_.join();
     if (listen_fd_ >= 0) { shutdown(listen_fd_, SHUT_RDWR); close(listen_fd_); }
     if (!uds_path_.empty()) ::unlink(uds_path_.c_str());
-    for (auto& t : engine_threads_)
-      if (t.joinable()) t.join();
-    engine_threads_.clear();
+    // reducers poll stop_ on a 200ms pop timeout; tasks still queued at
+    // teardown are dropped (their conn refs release with the ring)
+    for (auto& stp : stripes_)
+      if (stp->reducer.joinable()) stp->reducer.join();
     std::vector<std::thread> threads;
     {
       // wake (not destroy) live conns so blocked recv()s return; the
@@ -1215,18 +1380,62 @@ class NativeServer {
   }
 
  private:
+  // One key-space shard: the key map, every owned KeyState, and the
+  // schedule-mode priority bookkeeping live behind `mu`; decoded frames
+  // arrive through the bounded task ring (or the priority queue when
+  // BYTEPS_SERVER_ENABLE_SCHEDULE=1) and are executed by this stripe's
+  // one reducer thread.  qmu/cv_* are ONLY the ring's park/backpressure
+  // slow path — steady-state handoff is lock-free.
+  struct Stripe {
+    std::mutex mu;
+    std::map<uint64_t, std::unique_ptr<KeyState>> keys;
+    std::map<uint64_t, uint64_t> pushed_total;  // schedule-mode priorities
+    TaskRing ring;
+    std::unique_ptr<EngineQueue> pq;  // schedule mode replaces the ring
+    std::thread reducer;
+    bps_hist::Hist sum_hist;  // this reducer's per-task summation time
+    std::mutex qmu;
+    std::condition_variable cv_empty, cv_full;
+    std::atomic<bool> parked{false};     // reducer asleep in stripe_pop
+    std::atomic<int> prod_waiting{0};    // producers asleep on a full ring
+  };
+
   bool start_engine(int num_workers, bool enable_async) {
     num_workers_.store(num_workers);
     async_ = enable_async;
-    const char* et = getenv("BYTEPS_SERVER_ENGINE_THREAD");
-    n_engine_ = et ? std::max(1, atoi(et)) : 4;
     const char* sch = getenv("BYTEPS_SERVER_ENABLE_SCHEDULE");
     schedule_ = sch && atoi(sch) != 0;
-    tid_load_.assign(n_engine_, 0);
-    for (int i = 0; i < n_engine_; ++i)
-      queues_.emplace_back(new EngineQueue(schedule_));
-    for (int i = 0; i < n_engine_; ++i)
-      engine_threads_.emplace_back([this, i] { engine_loop(i); });
+    // BYTEPS_SERVER_STRIPES: reducer-thread count the key space shards
+    // across.  Default min(4, cores): below 4 cores more stripes only
+    // buy context switching; above, 4 reducers already saturate the
+    // memory bus this sum-and-memcpy workload lives on (docs/perf.md).
+    // When STRIPES is unset, an explicit BYTEPS_SERVER_ENGINE_THREAD is
+    // honored as the stripe count — it was this engine's thread knob
+    // before striping, and deployments that sized it must not silently
+    // drop to the auto default on upgrade (docs/env.md).
+    const char* sv = getenv("BYTEPS_SERVER_STRIPES");
+    int n = sv ? atoi(sv) : 0;
+    if (n <= 0) {
+      const char* et = getenv("BYTEPS_SERVER_ENGINE_THREAD");
+      n = et ? atoi(et) : 0;
+    }
+    if (n <= 0) {
+      int hw = (int)std::thread::hardware_concurrency();
+      n = std::min(4, hw > 0 ? hw : 4);
+    }
+    if (n > 64) n = 64;  // sanity cap: fds + stacks, not a real topology
+    for (int i = 0; i < n; ++i) {
+      stripes_.emplace_back(new Stripe());
+      if (schedule_) stripes_.back()->pq.reset(new EngineQueue(true));
+    }
+    // striping off (one stripe, no anti-starvation queue): run handlers
+    // inline on the serve threads — no reducer thread, no ring hop (see
+    // the plane comment above).  Schedule mode keeps the queue even at
+    // one stripe: its whole point is reordering across a backlog.
+    inline_exec_ = (n == 1 && !schedule_);
+    if (!inline_exec_)
+      for (int i = 0; i < n; ++i)
+        stripes_[i]->reducer = std::thread([this, i] { reducer_loop(i); });
     accept_thread_ = std::thread([this] { accept_loop(); });
     return true;
   }
@@ -1289,50 +1498,116 @@ class NativeServer {
     if (len) conn->send_all(payload, len);
   }
 
-  KeyState& key_state(uint64_t key) {
-    std::lock_guard<std::mutex> g(keys_mu_);
-    auto& slot = keys_[key];
+  int32_t stripe_idx(uint64_t key) const {
+    return (int32_t)bps_wire::key_stripe(key, (uint32_t)stripes_.size());
+  }
+  Stripe& stripe_of(uint64_t key) { return *stripes_[stripe_idx(key)]; }
+
+  // the ONE KeyState accessor; caller holds st.mu
+  KeyState& key_state_locked(Stripe& st, uint64_t key) {
+    auto& slot = st.keys[key];
     if (!slot) slot = std::make_unique<KeyState>();
     return *slot;
   }
 
-  // key→engine-thread least-loaded cached assignment (server.h:154-178)
-  int thread_for(uint64_t key, uint64_t length) {
-    std::lock_guard<std::mutex> g(tid_mu_);
-    auto it = tid_cache_.find(key);
-    int tid;
-    if (it != tid_cache_.end()) {
-      tid = it->second;
-    } else {
-      tid = 0;
-      for (int i = 1; i < n_engine_; ++i)
-        if (tid_load_[i] < tid_load_[tid]) tid = i;
-      tid_cache_[key] = tid;
+  // Producer half of the stripe handoff (serve threads).  Fast path is
+  // one lock-free ring push + a fence + one flag load; the mutex/cv pair
+  // only runs when the ring is FULL (backpressure: the producer yields,
+  // then naps 1ms ticks until the reducer frees a slot — bounded
+  // timeouts make a lost wakeup cost one tick, never a hang) or when
+  // the reducer declared itself parked (empty-queue doorbell).
+  void stripe_put(Stripe& st, EngineTask&& t, uint64_t prio) {
+    if (st.pq) {
+      st.pq->put(std::move(t), prio);
+      return;
     }
-    tid_load_[tid] += length;
-    return tid;
+    int spins = 0;
+    while (!st.ring.try_push(t)) {  // moves from t only on success
+      if (stop_.load()) return;  // teardown: drop; the conn is dying too
+      if (++spins <= 32) {
+        sched_yield();
+        continue;
+      }
+      std::unique_lock<std::mutex> lk(st.qmu);
+      st.prod_waiting.fetch_add(1, std::memory_order_relaxed);
+      st.cv_full.wait_for(lk, std::chrono::milliseconds(1));
+      st.prod_waiting.fetch_sub(1, std::memory_order_relaxed);
+    }
+    // Doorbell check.  The seq_cst fence pairs with the one in
+    // stripe_pop: without it this is the store-buffering litmus (our
+    // ring-slot store / parked load vs the reducer's parked store /
+    // ring-slot recheck can BOTH read stale values on x86 StoreLoad
+    // reordering), and a lost doorbell leaves the task queued for the
+    // reducer's full 200ms pop timeout.
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    if (st.parked.load(std::memory_order_relaxed)) {
+      std::lock_guard<std::mutex> g(st.qmu);
+      st.cv_empty.notify_one();
+    }
   }
 
-  void engine_loop(int tid) {
+  // Consumer half (the stripe's reducer only).  Pops lock-free while
+  // work is queued; parks on cv_empty when idle, with the park flag
+  // published under qmu and one recheck so a concurrent producer either
+  // sees the flag or the recheck sees its task.  The timeout doubles as
+  // the stop_ poll tick.
+  bool stripe_pop(Stripe& st, EngineTask* out, int timeout_ms) {
+    if (st.pq) return st.pq->pop(out, timeout_ms);
+    if (st.ring.try_pop(out)) {
+      wake_producers(st);
+      return true;
+    }
+    {
+      std::unique_lock<std::mutex> lk(st.qmu);
+      st.parked.store(true, std::memory_order_release);
+      // pairs with stripe_put's fence: the flag store must be visible
+      // before the recheck reads the ring, or producer and reducer can
+      // each miss the other's write and the wakeup is lost
+      std::atomic_thread_fence(std::memory_order_seq_cst);
+      if (st.ring.try_pop(out)) {
+        st.parked.store(false, std::memory_order_release);
+        lk.unlock();
+        wake_producers(st);
+        return true;
+      }
+      st.cv_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+      st.parked.store(false, std::memory_order_release);
+    }
+    if (st.ring.try_pop(out)) {
+      wake_producers(st);
+      return true;
+    }
+    return false;
+  }
+
+  void wake_producers(Stripe& st) {
+    if (st.prod_waiting.load(std::memory_order_relaxed) > 0) {
+      std::lock_guard<std::mutex> g(st.qmu);
+      st.cv_full.notify_all();
+    }
+  }
+
+  // one decoded data-plane task through its handler — shared by the
+  // reducer threads and the stripes=1 inline fast path (serve threads)
+  bool run_task(Stripe& st, int sid, EngineTask& t) {
+    if (t.op == kPush) return handle_push(st, sid, t);
+    if (t.op == kPull) return handle_pull(st, sid, t);
+    if (t.op == kTaskFusedMember) return handle_fused_member(st, sid, t);
+    return true;
+  }
+
+  void reducer_loop(int sid) {
+    Stripe& st = *stripes_[sid];
     EngineTask t;
     while (!stop_.load()) {
-      if (!queues_[tid]->pop(&t, 200)) continue;
-      bool ok = true;
-      if (t.op == kPush)
-        ok = handle_push(t.conn, t.seq, t.key, t.cmd, t.version, t.flags,
-                         t.payload, t.trace_id, t.span_id, t.t_enq);
-      else if (t.op == kPull)
-        ok = handle_pull(t.conn, t.seq, t.key, t.cmd, t.version, t.payload,
-                         t.trace_id, t.span_id, t.t_enq);
-      else if (t.op == kFused)
-        ok = handle_fused(t.conn, t.seq, t.key, t.flags, t.payload,
-                         t.trace_id, t.span_id, t.t_enq);
+      if (!stripe_pop(st, &t, 200)) continue;
+      bool ok = run_task(st, sid, t);
       if (!ok) {
         // malformed request → drop the connection: wake() unblocks the
         // serve thread's recv; the transport closes with its last holder
         t.conn->wake();
       }
-      t.conn.reset();  // release promptly; last holder closes the fd
+      t = EngineTask{};  // release conn/frame/reply refs promptly
     }
   }
 
@@ -1399,19 +1674,19 @@ class NativeServer {
             return;
           break;
         case kPush:
-        case kPull:
-        case kFused: {
-          // data plane rides the engine queues; the anti-starvation prio
-          // is the key's accumulated push count (queue.h:49-97), snapshot
-          // at enqueue like the reference's cached priority.  A fused
-          // frame routes — and prioritizes — by its first member's key
-          // (the outer header key), same as the Python client sends it.
+        case kPull: {
+          // data plane rides the stripe rings: key → stripe by hash
+          // (wire.h key_stripe), nothing global on this path.  The
+          // anti-starvation prio (schedule mode only) is the key's
+          // accumulated push count (queue.h:49-97), snapshot at enqueue
+          // like the reference's cached priority.
           ctr_[kCtrWireRpc].fetch_add(1, std::memory_order_relaxed);
-          uint64_t prio;
-          {
-            std::lock_guard<std::mutex> g(tid_mu_);
-            if (h.op != kPull) pushed_total_[key]++;
-            prio = pushed_total_[key];
+          Stripe& st = stripe_of(key);
+          uint64_t prio = 0;
+          if (schedule_) {
+            std::lock_guard<std::mutex> g(st.mu);
+            if (h.op != kPull) st.pushed_total[key]++;
+            prio = st.pushed_total[key];
           }
           EngineTask t;
           t.op = h.op;
@@ -1428,7 +1703,25 @@ class NativeServer {
           }
           t.payload = std::move(payload);
           payload.clear();
-          queues_[thread_for(key, t.payload.size())]->put(std::move(t), prio);
+          if (inline_exec_) {
+            // stripes=1: sum/serve on THIS thread (malformed → drop conn,
+            // the inline twin of the reducer's conn->wake())
+            if (!run_task(st, 0, t)) return;
+            break;
+          }
+          stripe_put(st, std::move(t), prio);
+          break;
+        }
+        case kFused: {
+          // Op.FUSED: decoded HERE on the I/O thread, members scattered
+          // to their owning stripes, the single multi-key reply gathered
+          // by the FusedReply countdown — the last member's reducer
+          // sends it (docs/architecture.md "Key striping").
+          ctr_[kCtrWireRpc].fetch_add(1, std::memory_order_relaxed);
+          if (!scatter_fused(conn, seq, key, h.flags, payload, trace_id,
+                             span_id))
+            return;  // malformed/fenced fused frame → drop conn
+          payload.clear();  // scatter took the buffer
           break;
         }
         default: {
@@ -1494,11 +1787,16 @@ class NativeServer {
     std::memcpy(&dt, payload.data() + 8, 4);
     n = be64toh(n);
     dt = ntohl(dt);
-    auto& ks = key_state(key);
+    // INIT routes to the key's owning stripe: barrier state lives with
+    // the rest of the key's state behind the shard lock, so token
+    // replay-acks and generation resets stay atomic with the sums the
+    // stripe's reducer is running
+    Stripe& stripe = stripe_of(key);
     std::vector<InitWaiter> waiters;
     bool replay_ack = false;
     {
-      std::lock_guard<std::mutex> g(ks.mu);
+      std::lock_guard<std::mutex> g(stripe.mu);
+      KeyState& ks = key_state_locked(stripe, key);
       if (ks.store.empty()) {
         ks.dtype = (int32_t)dt;
         ks.nelems = (int64_t)n;
@@ -1574,9 +1872,10 @@ class NativeServer {
       if (nl == std::string::npos) break;
       pos = nl + 1;
     }
-    auto& ks = key_state(key);
+    Stripe& stripe = stripe_of(key);
     {
-      std::lock_guard<std::mutex> g(ks.mu);
+      std::lock_guard<std::mutex> g(stripe.mu);
+      KeyState& ks = key_state_locked(stripe, key);
       ks.codec = make_codec(kw, ks.nelems);
     }
     send_msg(conn, kRegisterCompressor, seq, key, 0, nullptr, 0);
@@ -1659,61 +1958,63 @@ class NativeServer {
     return true;
   }
 
-  bool handle_push(const ConnPtr& conn, uint32_t seq, uint64_t key, uint32_t cmd,
-                   uint32_t version, uint8_t flags,
-                   const std::vector<uint8_t>& payload, uint64_t trace_id,
-                   uint64_t span_id, double t_enq) {
-    if (fenced(flags)) return false;  // evicted worker → drop conn
+  // one plain PUSH on its key's reducer thread (caller: reducer_loop)
+  bool handle_push(Stripe& st, int sid, EngineTask& t) {
+    if (fenced(t.flags)) return false;  // evicted worker → drop conn
     int32_t rtype, dtype;
-    decode_cantor(cmd, &rtype, &dtype);
-    auto& ks = key_state(key);
+    decode_cantor(t.cmd, &rtype, &dtype);
     std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>, uint32_t>> flush;
     std::vector<FusedReplyPtr> fused_done;
-    // child spans mirror server.py: recv (engine-queue dwell) → sum
+    // child spans mirror server.py: recv (stripe-queue dwell) → sum
     // (dedupe-annotated) → publish (when this push closed the round) →
     // reply, all parented onto the wire-propagated worker span
     double t_start = wall_now();
-    if (trace_id && t_enq > 0)
-      span(trace_id, span_id, key, t_enq, t_start - t_enq, kSpanRecv);
-    ks.size_hist.observe((double)payload.size());
+    if (t.trace_id && t.t_enq > 0)
+      span(t.trace_id, t.span_id, t.key, t.t_enq, t_start - t.t_enq,
+           kSpanRecv, 0, sid);
     bool dedupe = false;
     double published = 0.0;
-    if (rtype == 1) {  // kRowSparsePushPull: scatter-sum rows
-      std::lock_guard<std::mutex> g(ks.mu);
-      if (ks.store.empty()) return false;
-      dedupe = is_replayed_push_locked(ks, flags, version);
-      if (!dedupe &&
-          !handle_push_rowsparse_locked(ks, flags, version, payload, &flush,
-                                        &fused_done, &published))
-        return false;
-    } else {
-      std::lock_guard<std::mutex> g(ks.mu);
+    KeyState* ksp;
+    {
+      std::lock_guard<std::mutex> g(st.mu);
+      KeyState& ks = key_state_locked(st, t.key);
+      ksp = &ks;
       if (ks.store.empty()) return false;  // push before init → drop conn
-      bool compressed = (rtype == 2) && ks.codec != nullptr;
-      dedupe = is_replayed_push_locked(ks, flags, version);
-      if (!dedupe &&
-          !sum_push_locked(ks, flags, version, payload.data(), payload.size(),
-                           compressed, &flush, &fused_done, &published))
-        return false;
+      dedupe = is_replayed_push_locked(ks, t.flags, t.version);
+      if (rtype == 1) {  // kRowSparsePushPull: scatter-sum rows
+        if (!dedupe &&
+            !handle_push_rowsparse_locked(ks, t.flags, t.version, t.payload,
+                                          &flush, &fused_done, &published))
+          return false;
+      } else {
+        bool compressed = (rtype == 2) && ks.codec != nullptr;
+        if (!dedupe &&
+            !sum_push_locked(ks, t.flags, t.version, t.payload.data(),
+                             t.payload.size(), compressed, &flush,
+                             &fused_done, &published))
+          return false;
+      }
     }
+    ksp->size_hist.observe((double)t.payload.size());
     double t_summed = wall_now();
     double sum_dur = t_summed - t_start - published;
     if (sum_dur < 0) sum_dur = 0;
-    ks.sum_hist.observe(sum_dur);
+    ksp->sum_hist.observe(sum_dur);
+    st.sum_hist.observe(sum_dur);
     if (published > 0) publish_hist_.observe(published);
-    if (trace_id) {
-      span(trace_id, span_id, key, t_start, sum_dur, kSpanSum,
-           dedupe ? kSpanFlagDedupe : 0);
+    if (t.trace_id) {
+      span(t.trace_id, t.span_id, t.key, t_start, sum_dur, kSpanSum,
+           dedupe ? kSpanFlagDedupe : 0, sid);
       if (published > 0)
-        span(trace_id, span_id, key, t_summed - published, published,
-             kSpanPublish);
+        span(t.trace_id, t.span_id, t.key, t_summed - published, published,
+             kSpanPublish, 0, sid);
     }
-    send_msg(conn, kPush, seq, key, version, nullptr, 0);
-    if (trace_id)
-      span(trace_id, span_id, key, t_summed, wall_now() - t_summed,
-           kSpanReply);
+    send_msg(t.conn, kPush, t.seq, t.key, t.version, nullptr, 0);
+    if (t.trace_id)
+      span(t.trace_id, t.span_id, t.key, t_summed, wall_now() - t_summed,
+           kSpanReply, 0, sid);
     for (auto& [pconn, pseq, data, ver] : flush)
-      send_msg(pconn, kPull, pseq, key, ver, data.data(), data.size());
+      send_msg(pconn, kPull, pseq, t.key, ver, data.data(), data.size());
     for (auto& fr : fused_done) send_fused_reply(fr);
     return true;
   }
@@ -1778,30 +2079,37 @@ class NativeServer {
              body.size());
   }
 
-  // Op.FUSED (docs/perf.md): unpack one multi-key fused frame, run every
-  // sub-push through the per-(worker, key) exactly-once ledger, and
-  // answer with ONE multi-key reply once every member's round is
-  // published (server.py _handle_fused parity).  Frame-level retry
-  // safety falls out per key: members summed before a mid-frame error
-  // are ledger-recorded, so a retransmitted frame re-sums nothing whose
+  // Op.FUSED scatter (docs/perf.md), run on the I/O thread: unpack one
+  // multi-key fused frame and fan its members out to their owning
+  // stripes as kTaskFusedMember tasks, each a zero-copy VIEW into the
+  // refcounted frame buffer.  The FusedReply countdown gathers the
+  // single multi-key reply: whichever reducer fills the LAST slot sends
+  // it (server.py _handle_fused parity — one seq/deadline/retry state
+  // resolves atomically for every member).  Frame-level retry safety
+  // falls out per key: members summed before a mid-frame error are
+  // ledger-recorded, so a retransmitted frame re-sums nothing whose
   // original landed.
-  bool handle_fused(const ConnPtr& conn, uint32_t seq, uint64_t route_key,
-                    uint8_t flags, const std::vector<uint8_t>& payload,
-                    uint64_t trace_id, uint64_t span_id, double t_enq) {
+  bool scatter_fused(const ConnPtr& conn, uint32_t seq, uint64_t route_key,
+                     uint8_t flags, std::vector<uint8_t>& payload,
+                     uint64_t trace_id, uint64_t span_id) {
     if (fenced(flags)) return false;  // evicted worker → drop conn
+    double t_enq = trace_id ? wall_now() : 0.0;
+    auto frame = std::make_shared<std::vector<uint8_t>>(std::move(payload));
     std::vector<FusedMember> members;
     // member-span trailer (tracing): each member's sum/publish children
     // parent onto ITS worker-side span; the pack's own span (outer
     // header context) bounds recv — server.py _handle_fused parity
     std::vector<uint64_t> member_spans;
-    if (!parse_fused_push(payload.data(), payload.size(), &members,
+    if (!parse_fused_push(frame->data(), frame->size(), &members,
                           trace_id ? &member_spans : nullptr))
       return false;  // malformed/empty fused frame → drop conn
+    for (auto& m : members) {
+      int32_t rtype, dtype;
+      decode_cantor(m.cmd, &rtype, &dtype);
+      if (rtype == 1) return false;  // row-sparse members cannot fuse
+    }
     ctr_[kCtrFusedFrames].fetch_add(1, std::memory_order_relaxed);
     ctr_[kCtrFusedKeys].fetch_add(members.size(), std::memory_order_relaxed);
-    if (trace_id && t_enq > 0)
-      span(trace_id, span_id, route_key, t_enq, wall_now() - t_enq,
-           kSpanRecv, kSpanFlagFused);
     auto reply = std::make_shared<FusedReply>();
     reply->conn = conn;
     reply->seq = seq;
@@ -1812,59 +2120,107 @@ class NativeServer {
     reply->slots.resize(members.size());
     reply->filled.assign(members.size(), 0);
     reply->remaining = members.size();
-    bool completed = false;
     for (size_t slot = 0; slot < members.size(); ++slot) {
       auto& m = members[slot];
-      int32_t rtype, dtype;
-      decode_cantor(m.cmd, &rtype, &dtype);
-      if (rtype == 1) return false;  // row-sparse members cannot fuse
-      auto& ks = key_state(m.key);
-      std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>,
-                             uint32_t>> flush;
-      std::vector<FusedReplyPtr> fused_done;
-      double t_m0 = wall_now();
-      double published = 0.0;
-      bool dedupe = false;
-      ks.size_hist.observe((double)m.len);
-      {
-        std::lock_guard<std::mutex> g(ks.mu);
-        if (ks.store.empty()) return false;  // member before init → drop
-        bool compressed = (rtype == 2) && ks.codec != nullptr;
-        dedupe = is_replayed_push_locked(ks, flags, m.version);
-        if (!dedupe &&
-            !sum_push_locked(ks, flags, m.version, m.payload, m.len,
-                             compressed, &flush, &fused_done, &published))
-          return false;
-        // this member's pull half: answered now if its round is
-        // published (async mode always is), else parked on the key
-        if (async_ || m.version <= ks.store_version) {
-          if (reply->fill(slot, wire_payload_locked(ks, compressed),
-                          ks.store_version))
-            completed = true;
-        } else {
-          ks.fused_waiters.push_back({m.version, reply, slot, compressed});
-        }
+      Stripe& st = stripe_of(m.key);
+      uint64_t prio = 0;
+      if (schedule_) {
+        std::lock_guard<std::mutex> g(st.mu);
+        prio = ++st.pushed_total[m.key];
       }
-      double t_m1 = wall_now();
-      double sum_dur = t_m1 - t_m0 - published;
-      if (sum_dur < 0) sum_dur = 0;
-      ks.sum_hist.observe(sum_dur);
-      if (published > 0) publish_hist_.observe(published);
+      EngineTask t;
+      t.op = kTaskFusedMember;
+      t.flags = flags;
+      t.conn = conn;
+      t.seq = seq;
+      t.key = m.key;
+      t.cmd = m.cmd;
+      t.version = m.version;
       if (trace_id) {
-        uint64_t parent = member_spans.size() == members.size()
-                              ? member_spans[slot]
-                              : span_id;
-        span(trace_id, parent, m.key, t_m0, sum_dur, kSpanSum,
-             kSpanFlagFused | (dedupe ? kSpanFlagDedupe : 0));
-        if (published > 0)
-          span(trace_id, parent, m.key, t_m1 - published, published,
-               kSpanPublish, kSpanFlagFused);
+        t.trace_id = trace_id;
+        t.span_id = span_id;
+        t.member_span = member_spans.size() == members.size()
+                            ? member_spans[slot]
+                            : 0;
+        t.t_enq = wall_now();
       }
-      for (auto& [pconn, pseq, data, ver] : flush)
-        send_msg(pconn, kPull, pseq, m.key, ver, data.data(), data.size());
-      for (auto& fr : fused_done) send_fused_reply(fr);
+      t.frame = frame;
+      t.off = (uint64_t)(m.payload - frame->data());
+      t.len = m.len;
+      t.freply = reply;
+      t.slot = (uint32_t)slot;
+      if (inline_exec_) {
+        // stripes=1: each member sums on this serve thread in scatter
+        // order; the gather countdown still sends the one reply
+        if (!run_task(st, 0, t)) return false;
+        continue;
+      }
+      stripe_put(st, std::move(t), prio);
     }
-    if (completed) send_fused_reply(reply);
+    // the pack's recv span bounds decode + scatter on the I/O thread
+    // (stripe -1: not a reducer lane); member queue dwell shows up as
+    // the gap before each member's sum span on its stripe lane
+    if (trace_id)
+      span(trace_id, span_id, route_key, t_enq, wall_now() - t_enq,
+           kSpanRecv, kSpanFlagFused);
+    return true;
+  }
+
+  // one fused member on its key's reducer thread: the same sum core as
+  // a plain push, then fill-or-park the member's pull half
+  bool handle_fused_member(Stripe& st, int sid, EngineTask& t) {
+    if (fenced(t.flags)) return false;  // fence may have closed mid-frame
+    int32_t rtype, dtype;
+    decode_cantor(t.cmd, &rtype, &dtype);
+    const uint8_t* pay = t.frame->data() + t.off;
+    std::vector<std::tuple<ConnPtr, uint32_t, std::vector<uint8_t>,
+                           uint32_t>> flush;
+    std::vector<FusedReplyPtr> fused_done;
+    double t_m0 = wall_now();
+    double published = 0.0;
+    bool dedupe = false;
+    bool completed = false;
+    KeyState* ksp;
+    {
+      std::lock_guard<std::mutex> g(st.mu);
+      KeyState& ks = key_state_locked(st, t.key);
+      ksp = &ks;
+      if (ks.store.empty()) return false;  // member before init → drop
+      bool compressed = (rtype == 2) && ks.codec != nullptr;
+      dedupe = is_replayed_push_locked(ks, t.flags, t.version);
+      if (!dedupe &&
+          !sum_push_locked(ks, t.flags, t.version, pay, t.len, compressed,
+                           &flush, &fused_done, &published))
+        return false;
+      // this member's pull half: answered now if its round is
+      // published (async mode always is), else parked on the key
+      if (async_ || t.version <= ks.store_version) {
+        if (t.freply->fill(t.slot, wire_payload_locked(ks, compressed),
+                           ks.store_version))
+          completed = true;
+      } else {
+        ks.fused_waiters.push_back({t.version, t.freply, t.slot, compressed});
+      }
+    }
+    ksp->size_hist.observe((double)t.len);
+    double t_m1 = wall_now();
+    double sum_dur = t_m1 - t_m0 - published;
+    if (sum_dur < 0) sum_dur = 0;
+    ksp->sum_hist.observe(sum_dur);
+    st.sum_hist.observe(sum_dur);
+    if (published > 0) publish_hist_.observe(published);
+    if (t.trace_id) {
+      uint64_t parent = t.member_span ? t.member_span : t.span_id;
+      span(t.trace_id, parent, t.key, t_m0, sum_dur, kSpanSum,
+           kSpanFlagFused | (dedupe ? kSpanFlagDedupe : 0), sid);
+      if (published > 0)
+        span(t.trace_id, parent, t.key, t_m1 - published, published,
+             kSpanPublish, kSpanFlagFused, sid);
+    }
+    for (auto& [pconn, pseq, data, ver] : flush)
+      send_msg(pconn, kPull, pseq, t.key, ver, data.data(), data.size());
+    for (auto& fr : fused_done) send_fused_reply(fr);
+    if (completed) send_fused_reply(t.freply);
     return true;
   }
 
@@ -1885,24 +2241,27 @@ class NativeServer {
     double t0 = trace_id ? wall_now() : 0.0;
     ctr_[kCtrResyncQuery].fetch_add(1, std::memory_order_relaxed);
     if (keys.empty()) {
-      std::lock_guard<std::mutex> g(keys_mu_);
-      for (auto& [k, ks] : keys_) keys.push_back(k);
+      // "every key we hold" spans the stripes: gather per shard, then
+      // sort — ascending key order keeps the JSON body byte-identical
+      // to the pre-striping engine (and to server.py's sorted dict)
+      for (auto& stp : stripes_) {
+        std::lock_guard<std::mutex> g(stp->mu);
+        for (auto& [k, ks] : stp->keys) keys.push_back(k);
+      }
+      std::sort(keys.begin(), keys.end());
     }
     std::vector<std::tuple<uint64_t, uint32_t, uint32_t, int>> states;
     for (uint64_t k : keys) {
-      KeyState* ks = nullptr;
-      {
-        std::lock_guard<std::mutex> g(keys_mu_);
-        auto it = keys_.find(k);
-        if (it != keys_.end()) ks = it->second.get();
-      }
-      if (ks == nullptr) continue;
-      std::lock_guard<std::mutex> g(ks->mu);
+      Stripe& st = stripe_of(k);
+      std::lock_guard<std::mutex> g(st.mu);
+      auto it = st.keys.find(k);
+      if (it == st.keys.end()) continue;
+      KeyState* ks = it->second.get();
       if (ks->store.empty()) continue;
       uint32_t seen = 0;
       if (wid) {
-        auto it = ks->push_seen.find((uint8_t)wid);
-        if (it != ks->push_seen.end()) seen = it->second;
+        auto sit = ks->push_seen.find((uint8_t)wid);
+        if (sit != ks->push_seen.end()) seen = sit->second;
       }
       states.emplace_back(k, ks->store_version, seen, ks->recv_count);
     }
@@ -2001,40 +2360,41 @@ class NativeServer {
     return ks.store;
   }
 
-  bool handle_pull(const ConnPtr& conn, uint32_t seq, uint64_t key, uint32_t cmd,
-                   uint32_t version, const std::vector<uint8_t>& payload,
-                   uint64_t trace_id, uint64_t span_id, double t_enq) {
+  bool handle_pull(Stripe& st, int sid, EngineTask& t) {
     int32_t rtype, dtype;
-    decode_cantor(cmd, &rtype, &dtype);
-    auto& ks = key_state(key);
-    double t_start = trace_id ? wall_now() : 0.0;
-    if (trace_id && t_enq > 0)
-      span(trace_id, span_id, key, t_enq, t_start - t_enq, kSpanRecv);
+    decode_cantor(t.cmd, &rtype, &dtype);
+    double t_start = t.trace_id ? wall_now() : 0.0;
+    if (t.trace_id && t.t_enq > 0)
+      span(t.trace_id, t.span_id, t.key, t.t_enq, t_start - t.t_enq,
+           kSpanRecv, 0, sid);
     std::vector<uint8_t> data;
     uint32_t ver;
     {
-      std::lock_guard<std::mutex> g(ks.mu);
+      std::lock_guard<std::mutex> g(st.mu);
+      KeyState& ks = key_state_locked(st, t.key);
       if (ks.store.empty()) return false;  // pull before init → drop conn
-      bool ready = async_ || version <= ks.store_version;
+      bool ready = async_ || t.version <= ks.store_version;
       if (!ready) {
         // parked: the round publish answers it; the worker-side PULL
         // span keeps the wait attributable — no park span (server.py
         // parity)
-        ks.pending.push_back({version, conn, seq, rtype == 2,
-                              rtype == 1 ? payload : std::vector<uint8_t>{}});
+        ks.pending.push_back({t.version, t.conn, t.seq, rtype == 2,
+                              rtype == 1 ? t.payload
+                                         : std::vector<uint8_t>{}});
         return true;
       }
       if (rtype == 1) {
-        if (!rs_gather_locked(ks, payload, &data)) return false;
+        if (!rs_gather_locked(ks, t.payload, &data)) return false;
       } else {
         data = wire_payload_locked(ks, rtype == 2);
       }
       ver = ks.store_version;
     }
-    double t_ready = trace_id ? wall_now() : 0.0;
-    send_msg(conn, kPull, seq, key, ver, data.data(), data.size());
-    if (trace_id)
-      span(trace_id, span_id, key, t_ready, wall_now() - t_ready, kSpanReply);
+    double t_ready = t.trace_id ? wall_now() : 0.0;
+    send_msg(t.conn, kPull, t.seq, t.key, ver, data.data(), data.size());
+    if (t.trace_id)
+      span(t.trace_id, t.span_id, t.key, t_ready, wall_now() - t_ready,
+           kSpanReply, 0, sid);
     return true;
   }
 
@@ -2048,17 +2408,12 @@ class NativeServer {
   std::mutex conn_mu_;
   std::vector<ConnPtr> conns_;
   std::vector<std::thread> threads_;
-  std::mutex keys_mu_;
-  std::map<uint64_t, std::unique_ptr<KeyState>> keys_;
-  // engine queue plane
-  int n_engine_ = 4;
+  // key-striped reducer plane: all key state lives in the stripes
   bool schedule_ = false;
-  std::vector<std::unique_ptr<EngineQueue>> queues_;
-  std::vector<std::thread> engine_threads_;
-  std::mutex tid_mu_;
-  std::map<uint64_t, int> tid_cache_;
-  std::vector<uint64_t> tid_load_;
-  std::map<uint64_t, uint64_t> pushed_total_;
+  // stripes=1 fast path: handlers run inline on the serve threads (no
+  // reducer threads, no ring hop) — set once in start_engine
+  bool inline_exec_ = false;
+  std::vector<std::unique_ptr<Stripe>> stripes_;
   // EF residual lr (workers broadcast optimizer lr; default 1.0)
   std::atomic<float> ef_lr_{1.0f};
   // zombie fence: live worker flags from the scheduler's latest book
@@ -2081,11 +2436,15 @@ class NativeServer {
   bps_hist::Hist publish_hist_;
 
   // one child-span record into the ring; a full ring drops + counts —
-  // the observer must never stall the data plane
+  // the observer must never stall the data plane.  `stripe` is the
+  // executing reducer's lane (-1 = serve/control thread); the drain
+  // maps it to a per-stripe Perfetto track so the merged timeline
+  // shows reducer occupancy.
   void span(uint64_t trace_id, uint64_t parent, uint64_t key, double ts,
-            double dur, int32_t kind, uint32_t fl = 0) {
+            double dur, int32_t kind, uint32_t fl = 0, int32_t stripe = -1) {
     if (!trace_id) return;
-    SpanRec r{trace_id, parent, key, ts, dur < 0 ? 0 : dur, kind, fl};
+    SpanRec r{trace_id, parent, key, ts, dur < 0 ? 0 : dur, kind, fl,
+              stripe, 0};
     if (!span_ring_.push(r))
       ctr_[kCtrSpanDrop].fetch_add(1, std::memory_order_relaxed);
   }
@@ -2201,6 +2560,26 @@ int64_t bps_native_server_metrics_json(int32_t port, uint8_t* out,
   if (body.size() > cap) return -(int64_t)body.size();
   std::memcpy(out, body.data(), body.size());
   return (int64_t)body.size();
+}
+
+// Current task backlog per reducer stripe (approximate, lock-free
+// reads) — the native_stripe_queue_depth{stripe} gauge feed.  Returns
+// the stripe count filled (= the instance's stripe count when cap
+// allows), or -1 for an unknown instance.
+int32_t bps_native_server_stripe_queue_depths(int32_t port, uint64_t* out,
+                                              int32_t cap) {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  auto it = g_servers.find(port);
+  if (it == g_servers.end()) return -1;
+  return it->second->read_stripe_depths(out, cap);
+}
+
+// key → reducer stripe through the LIVE mapping (wire.h key_stripe) —
+// lets tests pick keys that do (or don't) share a stripe, and pins the
+// hash so a silent remapping can't invalidate committed benchmarks.
+int32_t bps_wire_key_stripe(uint64_t key, int32_t n_stripes) {
+  if (n_stripes <= 0) return -1;
+  return (int32_t)bps_wire::key_stripe(key, (uint32_t)n_stripes);
 }
 
 // ---------------------------------------------------------------------------

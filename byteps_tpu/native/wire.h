@@ -83,6 +83,21 @@ inline void unpack_trace(const uint8_t in[16], uint64_t* trace_id,
   *span_id = be64toh(s);
 }
 
+// key → reducer stripe (ps_server.cc key-striped engine plane).  Tensor
+// keys are small dense integers (partition ids), so a plain modulo would
+// stripe adjacent partitions of one tensor onto adjacent stripes — fine —
+// but correlated strides (every 4th key hot) would alias one stripe; the
+// splitmix64 finalizer decorrelates at ~1 cycle cost.  Lives here so the
+// golden shim (bps_wire_key_stripe) pins the mapping tests rely on.
+inline uint32_t key_stripe(uint64_t key, uint32_t n_stripes) {
+  if (n_stripes <= 1) return 0;
+  uint64_t z = key + 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  z ^= z >> 31;
+  return (uint32_t)(z % n_stripes);
+}
+
 }  // namespace bps_wire
 
 #endif  // BYTEPS_TPU_NATIVE_WIRE_H_

@@ -1335,6 +1335,38 @@ class NativePSServer:
         # heartbeat cluster aggregate (docs/observability.md)
         self._hist_provider = lambda: native_server_histograms(sid)
         metrics().register_hist_provider(self._hist_provider)
+        # per-stripe task backlog of the key-striped reducer plane, one
+        # gauge series per reducer (docs/perf.md hot-stripe note): a
+        # persistently deep stripe while its siblings idle means the key
+        # hash is aliasing hot keys onto one reducer.  Sampled lazily at
+        # exposition time; the stripe closures share one short-lived
+        # snapshot so a scrape costs one ctypes read, not one per stripe.
+        # The `server` label keys the series to THIS instance — benches
+        # run several NativePSServers in one process (scaling_bench
+        # threads mode), and unlabeled series would overwrite each other
+        # at registration and tear each other down at stop().
+        from byteps_tpu.native import native_server_stripe_depths
+
+        self._stripe_count = len(native_server_stripe_depths(sid))
+        self._gauge_labels = {"server": str(sid)}
+        depth_cache = {"t": 0.0, "depths": ()}
+        depth_mu = threading.Lock()
+
+        def _stripe_depth(i: int) -> float:
+            now = time.monotonic()
+            with depth_mu:
+                if now - depth_cache["t"] > 0.05:
+                    depth_cache["depths"] = native_server_stripe_depths(sid)
+                    depth_cache["t"] = now
+                depths = depth_cache["depths"]
+            return float(depths[i]) if i < len(depths) else 0.0
+
+        for i in range(self._stripe_count):
+            metrics().gauge_fn(
+                "native_stripe_queue_depth",
+                lambda i=i: _stripe_depth(i),
+                labels={"stripe": str(i), **self._gauge_labels},
+            )
         # span plane (docs/observability.md): the C++ engine stamps the
         # same recv→sum→publish→reply child spans the Python server
         # does, buffered in a native ring; this wrapper drains them into
@@ -1383,14 +1415,24 @@ class NativePSServer:
                 if 0 <= kind < len(NATIVE_SPAN_KINDS) else f"kind{kind}"
             )
             flags = int(rec["flags"])
-            extra = {"engine": "native"}
+            extra = {"engine": "native", "key": int(rec["key"])}
             if name == "sum":
                 extra["dedupe"] = bool(flags & SPAN_FLAG_DEDUPE)
             if flags & SPAN_FLAG_FUSED:
                 extra["fused"] = True
+            # each reducer stripe gets its own Perfetto thread lane so
+            # the merged timeline shows per-reducer occupancy (a hot
+            # stripe is one crowded lane); serve/control-thread spans
+            # (stripe -1: fused decode, resync answers) keep the per-key
+            # rows the pre-striping engine used
+            stripe = int(rec["stripe"])
+            if stripe >= 0:
+                track = f"stripe{stripe}"
+                extra["stripe"] = stripe
+            else:
+                track = f"key{int(rec['key'])}"
             self.tracer.record_span(
-                f"key{int(rec['key'])}", name, float(rec["ts"]),
-                float(rec["dur"]),
+                track, name, float(rec["ts"]), float(rec["dur"]),
                 span_args(int(rec["trace"]), new_trace_id(),
                           parent_id=int(rec["parent"]), **extra),
             )
@@ -1459,6 +1501,13 @@ class NativePSServer:
 
         counters().absorb_provider(self._counters_provider)
         metrics().absorb_hist_provider(self._hist_provider)
+        # backlog gauges describe a live engine only — drop the series
+        # rather than export a dead callable forever
+        for i in range(self._stripe_count):
+            metrics().gauge_remove(
+                "native_stripe_queue_depth",
+                labels={"stripe": str(i), **self._gauge_labels},
+            )
         if self._span_drain_thread is not None:
             self._span_drain_thread.join(timeout=2.0)
             self._span_drain_thread = None

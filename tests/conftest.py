@@ -106,6 +106,23 @@ def require_engine(engine: str) -> None:
         pytest.skip("native lib (with parity surface) not built")
 
 
+#: engine × reducer-stripe matrix for the parity suites (the key-striped
+#: native data plane): the native lanes run at 1 stripe — the
+#: single-reducer shape, behaviorally the pre-striping engine — AND at 4
+#: stripes (the multi-core default), pinning that striping changes no
+#: bytes and no semantics.  ``stripes=0`` on the python lane means "not
+#: applicable" (the knob only steers the C++ engine).
+ENGINE_STRIPES = [("python", 0), ("native", 1), ("native", 4)]
+ENGINE_STRIPES_IDS = ["python", "native-s1", "native-s4"]
+
+
+def set_stripes(monkeypatch, stripes: int) -> None:
+    """Pin BYTEPS_SERVER_STRIPES for a parity lane (read by the C++
+    engine at start; must run before the native server is built)."""
+    if stripes > 0:
+        monkeypatch.setenv("BYTEPS_SERVER_STRIPES", str(stripes))
+
+
 def make_ps_server(engine: str, cfg):
     """One PS server of the requested engine — the GIL-free C++ data
     plane speaks the full fused/ledger/resync protocol since the

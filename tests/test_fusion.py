@@ -44,7 +44,13 @@ from byteps_tpu.core.telemetry import counters
 from byteps_tpu.server.server import PSServer
 
 
-from conftest import make_ps_server, require_engine
+from conftest import (
+    ENGINE_STRIPES,
+    ENGINE_STRIPES_IDS,
+    make_ps_server,
+    require_engine,
+    set_stripes,
+)
 
 
 class TestFusedWire:
@@ -253,15 +259,19 @@ class TestFusedFallback:
 
 
 class TestFusedReplayDedupe:
-    @pytest.mark.parametrize("engine", ["python", "native"])
-    def test_resent_fused_frame_never_double_sums(self, engine):
+    @pytest.mark.parametrize(("engine", "stripes"), ENGINE_STRIPES,
+                             ids=ENGINE_STRIPES_IDS)
+    def test_resent_fused_frame_never_double_sums(self, engine, stripes,
+                                                  monkeypatch):
         """Wire-level exactly-once: worker 1 sends a fused frame TWICE
         (the retry case — e.g. its reply was dropped); worker 2 completes
         the rounds with plain pushes.  Every reply must carry the sum of
         exactly one contribution per worker per key — over BOTH server
         engines (the per-(worker, key) ledger is ported to the C++ data
-        plane)."""
+        plane) and over striped (4) AND single-reducer (1) native lanes
+        (the ledger now lives per stripe shard)."""
         require_engine(engine)
+        set_stripes(monkeypatch, stripes)
         cfg = Config(num_worker=2, num_server=1)
         if engine == "native":
             from byteps_tpu.server.server import NativePSServer
@@ -338,8 +348,10 @@ class TestFusedReplayDedupe:
 
 
 class TestFusionChaos:
-    @pytest.mark.parametrize("engine", ["python", "native"])
-    def test_fused_frames_bitwise_exact_under_chaos(self, engine, monkeypatch):
+    @pytest.mark.parametrize(("engine", "stripes"), ENGINE_STRIPES,
+                             ids=ENGINE_STRIPES_IDS)
+    def test_fused_frames_bitwise_exact_under_chaos(self, engine, stripes,
+                                                    monkeypatch):
         """The acceptance schedule with fusion ON: chaos:tcp, fixed seed,
         5% frame drops — dropped fused frames and dropped fused replies
         are healed by the single per-frame deadline/retry state, and the
@@ -349,6 +361,7 @@ class TestFusionChaos:
         worker side of each connection (the C++ listener stays clean —
         the same one-sidedness the 2-worker demo uses)."""
         require_engine(engine)
+        set_stripes(monkeypatch, stripes)
         monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
         monkeypatch.setenv("BYTEPS_CHAOS_SEED", "4242")
         monkeypatch.setenv("BYTEPS_CHAOS_DROP", "0.05")

@@ -49,6 +49,10 @@ _REQUIRED_SYMBOLS = (
     "bpsc_metrics_json",
     "bps_wire_client_frame",
     "bps_wire_fused_spans_echo",
+    # key-striped reducer plane (ISSUE 7): per-stripe backlog feed +
+    # the live key→stripe mapping shim (also marks the 56-byte SpanRec)
+    "bps_native_server_stripe_queue_depths",
+    "bps_wire_key_stripe",
 )
 
 

@@ -205,6 +205,27 @@ class TestPrometheusExposition:
         reg.observe("stage_dwell_seconds", 0.01, labels={"stage": "PUSH"})
         return reg
 
+    def test_labeled_gauges_family_and_remove(self):
+        """Gauges accept a label set (one TYPE line per family, one
+        series per label combination) and gauge_remove drops exactly
+        one series — the surface the per-stripe backlog feed uses."""
+        reg = self._registry()
+        reg.gauge_set("native_stripe_queue_depth", 3, labels={"stripe": "0"})
+        reg.gauge_fn("native_stripe_queue_depth", lambda: 7.0,
+                     labels={"stripe": "1"})
+        text = reg.render_prometheus()
+        assert text.count(
+            "# TYPE byteps_native_stripe_queue_depth gauge") == 1
+        assert 'byteps_native_stripe_queue_depth{stripe="0"} 3.0' in text
+        assert 'byteps_native_stripe_queue_depth{stripe="1"} 7.0' in text
+        gauges = reg.snapshot()["gauges"]
+        assert gauges['native_stripe_queue_depth{stripe="1"}'] == 7.0
+        assert gauges["pushpull_mbps"] == 42.0  # unlabeled keys unchanged
+        reg.gauge_remove("native_stripe_queue_depth", labels={"stripe": "1"})
+        text = reg.render_prometheus()
+        assert 'byteps_native_stripe_queue_depth{stripe="0"} 3.0' in text
+        assert 'stripe="1"' not in text
+
     def test_text_format_valid(self):
         import re
 
@@ -940,6 +961,43 @@ class TestNativeServerChildSpans:
         written = json.load(open(out))["traceEvents"]
         assert any(e.get("cat") == "span" for e in written)
 
+    def test_native_spans_land_on_per_stripe_lanes(self, tmp_path,
+                                                   monkeypatch):
+        """Key-striped engine: reducer-executed spans carry their stripe
+        and the drain maps each stripe to its own Perfetto thread lane
+        (``tid: stripeN``) so the merged timeline shows per-reducer
+        occupancy."""
+        from byteps_tpu.native import key_stripe
+
+        monkeypatch.setenv("BYTEPS_SERVER_STRIPES", "2")
+        srv = self._server(tmp_path, monkeypatch)
+        expect = key_stripe(3, 2)
+        try:
+            sock = connect(srv.host, srv.port)
+            cmd = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                                   int(DataType.FLOAT32))
+            send_message(sock, Message(
+                Op.INIT, key=3, seq=1, flags=1,
+                payload=struct.pack("!QI", 8, int(DataType.FLOAT32)),
+            ))
+            assert recv_message(sock).op == Op.INIT
+            send_message(sock, Message(
+                Op.PUSH, key=3, seq=2, flags=1, cmd=cmd, version=1,
+                payload=np.ones(8, np.float32).tobytes(),
+                trace=(0xBEEF, 0xF00D),
+            ))
+            assert recv_message(sock).op == Op.PUSH
+            events = self._wait_spans(srv, 4)
+            for e in events:
+                assert e["tid"] == f"stripe{expect}", e
+                assert e["args"]["stripe"] == expect
+                assert e["args"]["key"] == 3
+            from byteps_tpu.comm.transport import close_socket
+
+            close_socket(sock)
+        finally:
+            srv.stop()
+
     def test_native_fused_members_parent_on_trailer_ids(self, tmp_path, monkeypatch):
         srv = self._server(tmp_path, monkeypatch)
         try:
@@ -1052,6 +1110,43 @@ class TestNativeHistogramSeam:
         # absorbed at stop: totals survive the instance
         text = metrics().render_prometheus()
         assert 'native_server_sum_seconds_count{key="9"} 1' in text
+
+    def test_native_stripe_depth_gauges_appear_and_leave(self, monkeypatch):
+        """The key-striped engine exports one backlog gauge series per
+        reducer (labeled ``stripe`` + the owning ``server`` instance, so
+        two servers in one process can't collide); the series leave the
+        scrape surface when the instance stops (no dead callables) —
+        and only THAT instance's series leave."""
+        from byteps_tpu.server.server import NativePSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "tcp")
+        monkeypatch.setenv("BYTEPS_SERVER_STRIPES", "2")
+        cfg = Config(num_worker=1, num_server=1)
+        srv = NativePSServer(cfg)
+        srv2 = NativePSServer(cfg)
+        sid, sid2 = srv._id, srv2._id
+        try:
+            text = metrics().render_prometheus()
+            for inst in (sid, sid2):
+                for s in ("0", "1"):
+                    assert (
+                        f'byteps_native_stripe_queue_depth'
+                        f'{{server="{inst}",stripe="{s}"}}' in text
+                    ), text
+            gauges = metrics().snapshot()["gauges"]
+            key0 = f'native_stripe_queue_depth{{server="{sid}",stripe="0"}}'
+            assert gauges[key0] == 0.0
+        finally:
+            srv.stop()
+        # the sibling's series survive the first instance's stop
+        text = metrics().render_prometheus()
+        assert f'server="{sid}"' not in text
+        assert (
+            f'byteps_native_stripe_queue_depth{{server="{sid2}",stripe="0"}}'
+            in text
+        )
+        srv2.stop()
+        assert "native_stripe_queue_depth" not in metrics().render_prometheus()
 
     def test_native_client_rtt_histogram(self, monkeypatch):
         from byteps_tpu.comm.ps_client import _NativeServerConn
@@ -1174,6 +1269,35 @@ class TestTraceMergeAttribution:
         assert attrib["linked_rpcs"] == 2
         shares = [d["share"] for d in py.values()]
         assert sum(shares) == pytest.approx(1.0)
+
+    def test_critical_path_splits_sum_by_reducer_stripe(self, tmp_path):
+        """Native sum spans carry their reducer stripe; the attribution
+        pass reports per-reducer occupancy (`reducers`) so a hot stripe
+        is visible in TRACE_ATTRIB artifacts, not just the live gauges."""
+        tm = self._merge_tool()
+        T = 0xCC
+        self._write(tmp_path, "0", [
+            self._span("worker0", "k", "PUSH", 0, 1000, trace=T, span=0x7),
+        ])
+        self._write(tmp_path, "server0", [
+            self._span("server0", "stripe0", "sum", 100, 300, trace=T,
+                       span=0x50, parent=0x7, engine="native", stripe=0),
+            self._span("server0", "stripe1", "sum", 100, 100, trace=T,
+                       span=0x51, parent=0x7, engine="native", stripe=1),
+            # control-thread span (no stripe): counted in the stage
+            # totals but never in a reducer lane
+            self._span("server0", "key9", "resync", 500, 50, trace=T,
+                       span=0x52, parent=0x7, engine="native"),
+        ])
+        attrib = tm.critical_path(
+            tm.merge(tm.find_trace_files([str(tmp_path)])))
+        nat = attrib["engines"]["native"]
+        assert nat["stages"]["sum"]["total_s"] == pytest.approx(400e-6)
+        red = nat["reducers"]
+        assert set(red) == {"0", "1"}
+        assert red["0"]["sum_total_s"] == pytest.approx(300e-6)
+        assert red["0"]["share_of_sum"] == pytest.approx(0.75)
+        assert red["1"]["share_of_sum"] == pytest.approx(0.25)
 
     def test_cli_writes_attribution_artifact(self, tmp_path):
         tm = self._merge_tool()

@@ -52,6 +52,14 @@ from byteps_tpu.comm.transport import (
 )
 from byteps_tpu.core.telemetry import counters
 from byteps_tpu.server.server import PSServer
+from conftest import (
+    ENGINE_STRIPES,
+    ENGINE_STRIPES_IDS,
+    have_native_parity_server,
+    make_ps_server,
+    require_engine,
+    set_stripes,
+)
 
 CMD_F32 = get_command_type(RequestType.DEFAULT_PUSH_PULL, int(DataType.FLOAT32))
 
@@ -380,16 +388,21 @@ class TestHealInPlace:
         }.items():
             monkeypatch.setenv(k, v)
 
-    @pytest.mark.parametrize("engine", ["python", "native"])
-    def test_one_sided_giveup_heals_in_place(self, engine, monkeypatch):
+    @pytest.mark.parametrize(("engine", "stripes"), ENGINE_STRIPES,
+                             ids=ENGINE_STRIPES_IDS)
+    def test_one_sided_giveup_heals_in_place(self, engine, stripes,
+                                             monkeypatch):
         """Runs over BOTH server engines: the C++ data plane answers
         Op.RESYNC_QUERY from its own exactly-once ledger since the
         native-parity port — a give-up against a live native server
         heals in place with no re-init barrier, exactly like the Python
-        engine (the ``native`` param id arms the conftest hang guards)."""
+        engine (the ``native`` param id arms the conftest hang guards).
+        Native lanes run single-reducer (1) AND striped (4): the healing
+        snapshot is now a cross-stripe gather under shard locks."""
         from byteps_tpu.comm.rendezvous import Scheduler
 
         require_engine(engine)
+        set_stripes(monkeypatch, stripes)
         monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
         monkeypatch.setenv("BYTEPS_CHAOS_SEED", "5")
         monkeypatch.setenv("BYTEPS_CHAOS_DROP", "1.0")
@@ -538,9 +551,6 @@ class TestHealInPlace:
             srv.stop()
             sched.stop()
             _reset_chaos_budget()
-
-
-from conftest import have_native_parity_server, make_ps_server, require_engine
 
 
 def _have_native() -> bool:

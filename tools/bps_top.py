@@ -20,6 +20,7 @@ No dependencies beyond the stdlib; parses the text exposition directly.
 from __future__ import annotations
 
 import argparse
+import re
 import sys
 import time
 import urllib.request
@@ -88,6 +89,27 @@ def render(url: str, cur: Sample, prev: Sample, dt: float) -> str:
     for (name, lbl), v in sorted(cur.items()):
         if name == "byteps_pushpull_mbps":
             lines.append(f"  push/pull throughput : {v:10.2f} MB/s")
+    # reducer backlog of the key-striped native engine, one cell per
+    # stripe — a persistently deep cell while its siblings sit at 0 is
+    # the hot-stripe signature (docs/perf.md).  Sorted numerically (s2
+    # before s10); the series also carry a `server` instance label, so
+    # cells are prefixed with it when more than one server shares the
+    # endpoint (scaling_bench threads mode).
+    depths = []
+    for (name, lbl), v in cur.items():
+        if name != "byteps_native_stripe_queue_depth":
+            continue
+        sm = re.search(r'stripe="(\d+)"', lbl)
+        srv = re.search(r'server="([^"]*)"', lbl)
+        depths.append((srv.group(1) if srv else "",
+                       int(sm.group(1)) if sm else -1, v))
+    if depths:
+        many = len({s for s, _, _ in depths}) > 1
+        cells = " ".join(
+            (f"{srv}:" if many else "") + f"s{i}={int(v)}"
+            for srv, i, v in sorted(depths)
+        )
+        lines.append(f"  stripe queue depth   : {cells}")
     # latency families
     rows = _histo_rows(cur)
     if rows:

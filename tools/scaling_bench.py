@@ -267,6 +267,12 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--native", action="store_true",
                     help="use the C++ server data plane")
+    ap.add_argument("--stripes", type=int, default=0,
+                    help="BYTEPS_SERVER_STRIPES for the native engine's "
+                    "key-striped reducer plane (0 = engine default "
+                    "min(4, cores); 1 = striping off, inline sums on the "
+                    "serve threads) — the striped-vs-single A/B column of "
+                    "SCALING_r06.json")
     ap.add_argument("--van", default="tcp", choices=["tcp", "uds", "shm"],
                     help="transport van for the PS data plane")
     ap.add_argument("--multiproc", action="store_true",
@@ -281,6 +287,10 @@ def main() -> None:
         return
 
     os.environ["BYTEPS_VAN"] = args.van
+    if args.stripes > 0:
+        # read by the C++ engine at server start (threads mode) and
+        # inherited by server subprocesses (multiproc mode)
+        os.environ["BYTEPS_SERVER_STRIPES"] = str(args.stripes)
     worker_counts = [int(w) for w in args.workers.split(",")]
     per_worker = int(args.mbytes * 1e6)
     results = {}
@@ -311,6 +321,7 @@ def main() -> None:
         "extra": {
             "van": args.van,
             "engine": "native" if args.native else "python",
+            "stripes": args.stripes or "engine default",
             "multiproc": bool(args.multiproc),
             "round_time_s": {str(n): round(t, 4) for n, t in results.items()},
             "aggregate_mb_per_s": {str(n): round(t, 2) for n, t in thr.items()},

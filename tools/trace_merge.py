@@ -184,6 +184,9 @@ def critical_path(merged: dict) -> dict:
                 "ts": float(ev.get("ts", 0.0)),
                 "dur": float(ev.get("dur", 0.0)),
                 "engine": args.get("engine", "python"),
+                # reducer lane (native key-striped engine): which stripe
+                # thread executed this stage, -1/absent = control thread
+                "stripe": args.get("stripe"),
             })
             continue
         if span:
@@ -206,12 +209,21 @@ def critical_path(merged: dict) -> dict:
             "stages_us": {s: 0.0 for s in _SERVER_STAGES},
             "wire_us": 0.0,
             "wire_rpcs": 0,
+            "stripe_sum_us": {},
         })
         agg["rpcs"] += 1
         srv0, srv1 = None, None
         for k in kids:
             if k["name"] in agg["stages_us"]:
                 agg["stages_us"][k["name"]] += k["dur"]
+                # per-reducer occupancy (native striped engine): sum time
+                # split by the stripe lane that executed it, so a bad key
+                # hash shows up as one runaway reducer in the attribution
+                if k["name"] == "sum" and k.get("stripe") is not None:
+                    per = agg["stripe_sum_us"]
+                    per[str(k["stripe"])] = (
+                        per.get(str(k["stripe"]), 0.0) + k["dur"]
+                    )
             t0, t1 = k["ts"], k["ts"] + k["dur"]
             srv0 = t0 if srv0 is None else min(srv0, t0)
             srv1 = t1 if srv1 is None else max(srv1, t1)
@@ -244,6 +256,17 @@ def critical_path(merged: dict) -> dict:
             "share": agg["wire_us"] / total if total else 0.0,
         }
         out[engine] = {"rpcs": agg["rpcs"], "stages": stages}
+        if agg["stripe_sum_us"]:
+            sum_total = sum(agg["stripe_sum_us"].values())
+            out[engine]["reducers"] = {
+                stripe: {
+                    "sum_total_s": us / 1e6,
+                    "share_of_sum": us / sum_total if sum_total else 0.0,
+                }
+                for stripe, us in sorted(
+                    agg["stripe_sum_us"].items(), key=lambda kv: int(kv[0])
+                )
+            }
     return {
         "traces": len(traces),
         "linked_rpcs": sum(e["rpcs"] for e in out.values()),
@@ -265,6 +288,11 @@ def _print_attribution(attrib: dict) -> None:
             print(
                 f"    {stage:<11s} {d['total_s'] * 1e3:9.3f} ms total  "
                 f"{d['mean_s'] * 1e6:9.1f} µs/rpc  {d['share'] * 100:5.1f}%"
+            )
+        for stripe, d in agg.get("reducers", {}).items():
+            print(
+                f"    reducer {stripe:<3s} {d['sum_total_s'] * 1e3:9.3f} ms "
+                f"sum   {d['share_of_sum'] * 100:5.1f}% of sum"
             )
 
 

@@ -129,6 +129,115 @@ def bench_van(van: str, mbytes: float, rounds: int, engine: str = "python",
     }
 
 
+def bench_multistream(van: str, mbytes: float, rounds: int, n_clients: int,
+                      stripes: int, n_keys: int = 8) -> dict:
+    """The contended row (SCALING_r06 companion): N concurrent client
+    connections drive same-key sum rounds against ONE native server, so
+    every frame lands in the striped reducer plane under contention —
+    the shape where `BYTEPS_SERVER_STRIPES` is supposed to pay.  Run at
+    stripes=1 (single reducer) and stripes>=2 for the A/B."""
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.ps_client import PSClient
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.server.server import NativePSServer
+
+    os.environ["BYTEPS_VAN"] = van
+    os.environ["BYTEPS_SERVER_STRIPES"] = str(stripes)
+    os.environ["BYTEPS_NATIVE_CLIENT"] = "0"
+    sched = Scheduler(num_workers=n_clients, num_servers=1, host="127.0.0.1")
+    sched.start()
+    os.environ.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(sched.port),
+        "DMLC_NUM_WORKER": str(n_clients),
+        "DMLC_NUM_SERVER": "1",
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    cfg = Config.from_env()
+    srv = NativePSServer(cfg)
+    threading.Thread(target=srv.start, daemon=True).start()
+    clients = [PSClient(cfg, node_uid=f"ms{i}") for i in range(n_clients)]
+    cts = [threading.Thread(target=c.connect, daemon=True) for c in clients]
+    for t in cts:
+        t.start()
+    for t in cts:
+        t.join(30)
+
+    n = int(mbytes * 1e6) // 4 // n_keys
+    keys = list(range(1, n_keys + 1))
+    payload = np.random.default_rng(7).normal(size=n).astype(np.float32)
+    init_ts = [
+        threading.Thread(
+            target=lambda c=c: [c.init_tensor(k, n, 0) for k in keys],
+            daemon=True,
+        )
+        for c in clients
+    ]
+    for t in init_ts:
+        t.start()
+    for t in init_ts:
+        t.join(30)
+
+    def client_round(c, version):
+        done = threading.Event()
+        state = [2 * len(keys)]
+        lock = threading.Lock()
+
+        def dec(*_a):
+            with lock:
+                state[0] -= 1
+                if state[0] == 0:
+                    done.set()
+
+        for k in keys:
+            c.push(k, payload.data.cast("B"), 0, version, cb=dec)
+        for k in keys:
+            c.pull(k, version, dec)
+        if not done.wait(120):
+            raise RuntimeError("multistream round timed out")
+
+    def all_round(version):
+        errs = []
+
+        def runner(c):
+            try:
+                client_round(c, version)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=runner, args=(c,), daemon=True)
+              for c in clients]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(150)
+        if errs or any(t.is_alive() for t in ts):
+            raise RuntimeError(f"multistream round failed: {errs or 'hang'}")
+
+    for w in range(2):  # warmup
+        all_round(w + 1)
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        all_round(r + 3)
+    dt = time.perf_counter() - t0
+
+    for c in clients:
+        c.close()
+    srv.stop()
+    sched.stop()
+    mb = 2 * mbytes * n_clients * rounds  # every client pushes AND pulls
+    return {
+        "van": van,
+        "engine": "native",
+        "mode": f"multistream-{n_clients}c",
+        "stripes": stripes,
+        "keys": n_keys,
+        "mb_per_s": round(mb / dt, 1),
+        "round_ms": round(dt / rounds * 1e3, 2),
+        "mbytes_payload_per_client": mbytes,
+    }
+
+
 def bench_raw_socket(mbytes: float, rounds: int) -> dict:
     """Upper bound: the same payload ping-ponged over a bare loopback TCP
     socket with no framing, demux, or KV logic — how much of the wire the
@@ -219,6 +328,13 @@ def main() -> None:
                     help="split the payload across N keys")
     ap.add_argument("--streams", default="1",
                     help="comma list of BYTEPS_TCP_STREAMS values (tcp only)")
+    ap.add_argument("--multistream", type=int, default=0,
+                    help="ALSO run N concurrent client connections against "
+                    "one native server at each --multistream-stripes value "
+                    "(the striped-reducer contended row; VAN_BENCH_r06)")
+    ap.add_argument("--multistream-stripes", default="1,4",
+                    help="comma list of BYTEPS_SERVER_STRIPES values for "
+                    "the --multistream rows")
     args = ap.parse_args()
     if args.raw:
         print(json.dumps(bench_raw_socket(args.mbytes, args.rounds)))
@@ -273,6 +389,20 @@ def main() -> None:
                         streams=streams, n_keys=args.keys,
                         client_kind=client, contend=args.contend,
                     )))
+    if args.multistream > 0:
+        from byteps_tpu.native import HAVE_NATIVE
+
+        if not HAVE_NATIVE:
+            print(json.dumps({"mode": "multistream",
+                              "skipped": "lib not built"}))
+            return
+        for van in args.vans.split(","):
+            van = van.strip()
+            for stripes in (int(s.strip())
+                            for s in args.multistream_stripes.split(",")):
+                print(json.dumps(bench_multistream(
+                    van, args.mbytes, args.rounds, args.multistream, stripes,
+                )))
 
 
 if __name__ == "__main__":
