@@ -48,10 +48,13 @@ Targeting (one-sided failure rehearsal — docs/robustness.md "healing
 flow"; all three compose):
 
     BYTEPS_CHAOS_OPS          comma-separated op codes (transport.Op
-                              ints); only frames whose header op matches
-                              are faulted (RESYNC frames are ordinary
-                              frames: name 23/24 here to fault the
-                              recovery plane itself).  Empty = all ops.
+                              ints) or Op member names ("MIGRATE_STATE",
+                              case-insensitive); only frames whose
+                              header op matches are faulted (RESYNC and
+                              migration frames are ordinary frames: name
+                              23/24 or MIGRATE_STATE/WRONG_OWNER here to
+                              fault the recovery or resharding plane
+                              itself).  Empty = all ops.
     BYTEPS_CHAOS_TARGET_PORT  fault only connections dialed to — or
                               accepted by a listener bound at — this TCP
                               port (one server out of the fleet).  0 =
@@ -98,6 +101,26 @@ def _env_float(name: str, default: float) -> float:
     return float(v) if v not in (None, "") else default
 
 
+def _parse_op(tok: str) -> int:
+    """One BYTEPS_CHAOS_OPS token → wire op code.  Accepts the raw int
+    ("25") or the transport.Op member name ("MIGRATE_STATE",
+    case-insensitive) — deterministic tests naming the migration plane
+    shouldn't have to hardcode its op numbers."""
+    tok = tok.strip()
+    try:
+        return int(tok)
+    except ValueError:
+        from byteps_tpu.comm.transport import Op
+
+        try:
+            return int(Op[tok.upper()])
+        except KeyError:
+            raise ValueError(
+                f"BYTEPS_CHAOS_OPS token {tok!r} is neither an op code "
+                "nor a transport.Op name"
+            ) from None
+
+
 @dataclass(frozen=True)
 class ChaosParams:
     seed: int = 0
@@ -115,7 +138,7 @@ class ChaosParams:
     @staticmethod
     def from_env() -> "ChaosParams":
         ops = frozenset(
-            int(tok) for tok in
+            _parse_op(tok) for tok in
             os.environ.get("BYTEPS_CHAOS_OPS", "").split(",") if tok.strip()
         )
         return ChaosParams(

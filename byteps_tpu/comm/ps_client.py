@@ -433,6 +433,15 @@ class _NativeServerConn:
 
 
 class PSClient:
+    # class-level defaults for the elastic resharding surface: stub
+    # clients (tests build them with ``__new__``) and pre-resharding
+    # pickles route legacy without tripping AttributeError
+    reshard = False
+    map_epoch = 0
+    _ownership = None
+    _routing: tuple = ((), (), None)
+    _max_chases = 8
+
     def __init__(self, cfg: Config, node_uid: Optional[str] = None) -> None:
         self.cfg = cfg
         from byteps_tpu.common.config import resolve_node_uid
@@ -464,6 +473,24 @@ class PSClient:
         #: newest membership epoch seen in a scheduler book (eviction /
         #: adoption / resize broadcasts bump it; docs/robustness.md)
         self.membership_epoch = 0
+        # --- elastic resharding (docs/robustness.md "migration flow") ---
+        # ownership = epoch-stamped consistent-hash ring over server
+        # RANKS, adopted from books atomically with the connection list
+        # (one _routing snapshot: a key routes against the count/list/map
+        # it was hashed under, never a mixed pair).  A reply of
+        # Op.WRONG_OWNER means the server knows a newer map: the RPC
+        # waits (bounded) for its book, re-routes, and resends — the
+        # chase; journal replay and init retries chase the same way.
+        self.reshard = cfg.elastic_reshard
+        #: newest adopted ownership-map epoch; _map_cv is notified on
+        #: every adoption so redirect chases can wait for their book
+        self.map_epoch = 0
+        self._map_cv = threading.Condition()
+        self._ownership = None  # OwnershipMap or None (legacy routing)
+        #: (servers, ranks, ownership) swapped as ONE atomic snapshot
+        self._routing: tuple = ([], [], None)
+        #: WRONG_OWNER chases per RPC before surfacing the error
+        self._max_chases = 8
         # --- per-RPC deadline machinery (BYTEPS_RPC_DEADLINE_S) ---
         # token → (conn, expire_at); a scanner thread tears down the
         # connection of any RPC that blows its deadline — the drain then
@@ -553,6 +580,10 @@ class PSClient:
         self._server_addrs = [tuple(s) for s in book["servers"]]
         for host, port in self._server_addrs:
             self._servers.append(self._new_conn(host, port))
+        self._install_routing(
+            self._servers, book.get("server_ranks"),
+            self._ownership_from_book(book),
+        )
         # scheduler receiver for barrier responses
         t = threading.Thread(target=self._sched_recv_loop, daemon=True)
         t.start()
@@ -612,6 +643,75 @@ class PSClient:
             if ev.get(role):
                 counters().set_floor(name, int(ev[role]))
 
+    def _ownership_from_book(self, book: Optional[dict]):
+        """Build the book's OwnershipMap, or None (resharding off, or an
+        older scheduler whose books carry no map)."""
+        if not self.reshard or not book:
+            return None
+        ranks = book.get("server_ranks")
+        epoch = book.get("map_epoch")
+        if not ranks or epoch is None:
+            return None
+        from byteps_tpu.common.hashing import OwnershipMap
+
+        return OwnershipMap(
+            ranks, epoch=int(epoch), vnodes=self.cfg.ring_vnodes
+        )
+
+    def _install_routing(self, servers, ranks, omap) -> None:
+        """Swap the (connections, ranks, ownership) routing snapshot as
+        one atomic reference, and wake redirect chases waiting for the
+        map epoch the new book carries."""
+        self._routing = (servers, list(ranks or []), omap)
+        with self._map_cv:
+            self._ownership = omap
+            if omap is not None and omap.epoch > self.map_epoch:
+                self.map_epoch = omap.epoch
+            self._map_cv.notify_all()
+
+    def _wait_map_epoch(self, epoch: int, timeout: float) -> bool:
+        """Block until this client's adopted map epoch reaches ``epoch``
+        (the epoch a WRONG_OWNER redirect carried) or ``timeout`` —
+        chasing before the book lands would just re-route with the same
+        stale map."""
+        with self._map_cv:
+            return self._map_cv.wait_for(
+                lambda: self.map_epoch >= epoch or self._stop.is_set(),
+                timeout,
+            )
+
+    def request_resize(self, num_workers: Optional[int] = None,
+                       num_servers: Optional[int] = None) -> dict:
+        """Ask the scheduler to adopt a new expected topology from THIS
+        live worker — the wire shape of elastic ``resume(num_servers=±k)``
+        (a re-REGISTER carrying the new expectation) without tearing the
+        runtime down.  Blocks until the scheduler can answer (a scale-up
+        reply parks until the new server registers), adopts the returned
+        book, and returns it.  With BYTEPS_ELASTIC_RESHARD the resize is
+        a live migration: servers ship re-homed keys to the new owners
+        and no re-init barrier fires (docs/robustness.md "migration
+        flow")."""
+        payload = json.dumps({
+            "role": "worker", "host": "", "port": 0, "uid": self.node_uid,
+            "num_workers": int(num_workers or self.num_workers),
+            "num_servers": int(num_servers or self.num_servers),
+        }).encode()
+        resp = self._sched_request(Message(Op.REGISTER, payload=payload))
+        if resp.status != 0:
+            err = json.loads(resp.payload.decode()).get("error", "refused")
+            raise RuntimeError(f"scheduler refused resize: {err}")
+        book = json.loads(resp.payload.decode())
+        self.num_workers = book["num_workers"]
+        self._note_membership(book)
+        with self._sched_cb_lock:
+            self._book_token += 1
+            token = self._book_token
+        self._rebuild_servers(
+            book["num_servers"], [tuple(s) for s in book["servers"]],
+            token, book=book,
+        )
+        return book
+
     def barrier(self, group: int = GROUP_WORKERS) -> None:
         self._sched_request(Message(Op.BARRIER, flags=group))
 
@@ -670,11 +770,13 @@ class PSClient:
                     # in-flight apply).  Rebuild OFF this thread: connects
                     # can block/fail and must neither stall scheduler
                     # callback delivery nor kill this loop (→ _sched_dead)
-                    self._book_token += 1
+                    with self._sched_cb_lock:
+                        self._book_token += 1
+                        token = self._book_token
                     threading.Thread(
                         target=self._rebuild_servers,
-                        args=(book["num_servers"], new_addrs,
-                              self._book_token),
+                        args=(book["num_servers"], new_addrs, token),
+                        kwargs={"book": book},
                         daemon=True,
                     ).start()
                     continue
@@ -702,6 +804,7 @@ class PSClient:
         new_addrs: List[tuple],
         token: int = 1 << 62,
         retry_delay: float = 2.0,
+        book: Optional[dict] = None,
     ) -> None:
         """Adopt a resized server book live: connect to the new set, swap,
         then fail the old connections' in-flight requests (same path as a
@@ -724,8 +827,16 @@ class PSClient:
             if new_addrs == self._server_addrs:
                 # live set already matches this newest book (rollback
                 # racing a failed rebuild's retry): mark applied so older
-                # pending retries cancel, no reconnect churn
+                # pending retries cancel, no reconnect churn.  The book's
+                # ownership map still installs — rank identities can
+                # change under identical addresses (dead-slot adoption)
                 self.num_servers = num_servers
+                omap = self._ownership_from_book(book)
+                if omap is not None:
+                    self._install_routing(
+                        self._servers, (book or {}).get("server_ranks"),
+                        omap,
+                    )
                 self._applied_token = token
                 return
             fresh: List[_ServerConn] = []
@@ -763,7 +874,7 @@ class PSClient:
                                 return
                             self._rebuild_servers(
                                 num_servers, new_addrs, token,
-                                min(retry_delay * 2, 30.0),
+                                min(retry_delay * 2, 30.0), book=book,
                             )
 
                         threading.Thread(target=_retry, daemon=True).start()
@@ -778,7 +889,25 @@ class PSClient:
             old, self._servers = self._servers, fresh
             self._server_addrs = list(new_addrs)
             self.num_servers = num_servers
-            self.server_generation += 1
+            omap = self._ownership_from_book(book)
+            if self.reshard:
+                self._install_routing(
+                    fresh, (book or {}).get("server_ranks"), omap
+                )
+            else:
+                # legacy clients (and __new__-built test stubs) have no
+                # map condition variable; keep the snapshot coherent so
+                # _conn_for's identity check sees the fresh list
+                self._routing = (fresh, [], None)
+            if omap is None:
+                # legacy resize: keys re-home via the hash fns onto
+                # fresh stores — the engine re-runs every key's
+                # init-push barrier against the new owners
+                self.server_generation += 1
+            # else: live resharding — the servers migrate each re-homed
+            # key's state (store + ledger + init tokens) to its new
+            # owner, so the version sequence continues in place and NO
+            # re-init barrier fires (docs/robustness.md "migration flow")
             self._applied_token = token
         for sc in old:
             sc.close_all()  # recv loops exit → mark_dead fails pendings
@@ -1017,6 +1146,7 @@ class PSClient:
         abort_check: Optional[Callable[[], bool]] = None,
         precheck: Optional[Callable[[], bool]] = None,
         heal: bool = True,
+        chase: bool = True,
     ) -> None:
         """Send one async RPC with deadline + retry + revival.
 
@@ -1059,7 +1189,7 @@ class PSClient:
         # an anonymous bump of the flat total (docs/observability.md)
         try:
             sid = str(self.server_for(key))
-        except (ValueError, ZeroDivisionError, IndexError):
+        except (ValueError, ZeroDivisionError, IndexError, ConnectionError):
             sid = "?"
 
         def aborted_cleanup() -> bool:
@@ -1110,6 +1240,44 @@ class PSClient:
             # timer wheel, not threading.Timer: no per-retry thread churn
             self._timer_after(backoff.next_delay(), send_attempt)
 
+        def chase_redirect(msg: Message) -> None:
+            # Op.WRONG_OWNER: the server holds a NEWER ownership map —
+            # this key migrated (docs/robustness.md "migration flow").
+            # Wait (bounded) for the book that map rode in on, then
+            # resend: routing re-runs per attempt, so the resend lands on
+            # the new owner, whose migrated per-(worker, key) ledger
+            # dedupes anything the old owner already summed.  A chase
+            # does not consume the retry budget (the server answered;
+            # nothing failed) but is capped so a pathological ping-pong
+            # still surfaces an error instead of looping forever.
+            counters().bump("wrong_owner_redirect", labels={"server": sid})
+            if aborted_cleanup():
+                return
+            if not chase:
+                # fused frames never chase: the new map may scatter the
+                # pack's members across servers, so resending the intact
+                # frame just ping-pongs — the caller's error path (engine
+                # unfuse fallback) regroups into per-key RPCs that each
+                # chase on their own
+                fail()
+                return
+            state["chases"] = state.get("chases", 0) + 1
+            if self._stop.is_set() or state["chases"] > self._max_chases:
+                fail()
+                return
+            target = msg.version
+
+            def rechase() -> None:
+                if aborted_cleanup():
+                    return
+                self._wait_map_epoch(
+                    target, timeout=min(2.0, 0.25 * state["chases"])
+                )
+                send_attempt()
+
+            # off the recv thread: the map-epoch wait blocks
+            self._dispatch_retry(rechase)
+
         def send_attempt() -> None:
             if aborted_cleanup():
                 return
@@ -1130,6 +1298,8 @@ class PSClient:
                 self._deadline_clear(token_box[0])
                 if msg is None:
                     retry_later()
+                elif msg.op == Op.WRONG_OWNER:
+                    chase_redirect(msg)
                 elif aborted_cleanup():
                     pass  # late success on an abandoned op: cleanup only
                 else:
@@ -1179,7 +1349,7 @@ class PSClient:
         with this worker's emission history."""
         try:
             sid = str(self.server_for(key))
-        except (ValueError, ZeroDivisionError, IndexError):
+        except (ValueError, ZeroDivisionError, IndexError, ConnectionError):
             return False
         return self._heal_in_place(key, sid)
 
@@ -1267,7 +1437,7 @@ class PSClient:
         def owned(k: int) -> bool:
             try:
                 return str(self.server_for(k)) == sid
-            except (ValueError, ZeroDivisionError, IndexError):
+            except (ValueError, ZeroDivisionError, IndexError, ConnectionError):
                 return False
 
         keys = sorted(
@@ -1340,7 +1510,10 @@ class PSClient:
                     ),
                     f"resync replay failed for key {k}",
                 )
-                if ack is None or ack.status != 0:
+                if ack is None or ack.status != 0 or ack.op == Op.WRONG_OWNER:
+                    # a redirect mid-replay means the key moved AGAIN
+                    # (double migration race): fail this heal — the
+                    # give-up path re-runs once the new book lands
                     return False, replayed
                 counters().bump(
                     "resync_replayed_rounds", labels={"server": sid}
@@ -1374,10 +1547,12 @@ class PSClient:
         )
         try:
             sid = str(self.server_for(key))
-        except (ValueError, ZeroDivisionError, IndexError):
+        except (ValueError, ZeroDivisionError, IndexError, ConnectionError):
             sid = "?"
         last: Optional[BaseException] = None
-        for attempt in range(self.cfg.rpc_retries + 1):
+        attempt = 0
+        redirects = 0
+        while attempt <= self.cfg.rpc_retries:
             if attempt:
                 counters().bump("rpc_retry", labels={"server": sid})
                 if self._stop.wait(backoff.next_delay()):
@@ -1386,12 +1561,31 @@ class PSClient:
                 sc = self._conn_for(key, revive=attempt > 0)
             except (ConnectionError, OSError) as e:
                 last = e
+                attempt += 1
                 continue
             try:
-                return self._blocking_request(sc, make_msg, errmsg, deadline)
+                resp = self._blocking_request(sc, make_msg, errmsg, deadline)
             except ConnectionError as e:
                 last = e
+                attempt += 1
                 continue
+            if resp.op == Op.WRONG_OWNER:
+                # the key migrated (docs/robustness.md "migration flow"):
+                # wait for the redirect's book, re-route, resend.  Chases
+                # don't consume the retry budget (the server answered)
+                # but are capped against a pathological ping-pong.
+                if redirects >= self._max_chases:
+                    last = ConnectionError("wrong-owner chase exhausted")
+                    break
+                redirects += 1
+                counters().bump(
+                    "wrong_owner_redirect", labels={"server": sid}
+                )
+                self._wait_map_epoch(
+                    resp.version, min(2.0, 0.25 * redirects)
+                )
+                continue
+            return resp
         counters().bump("rpc_giveup")
         raise ConnectionError(errmsg) from last
 
@@ -1486,6 +1680,16 @@ class PSClient:
     # --- key routing -----------------------------------------------------
 
     def server_for(self, key: int) -> int:
+        """The key's owning server RANK.  Under live resharding this is
+        the adopted ownership map's owner; legacy routing hashes over the
+        server count (where rank == list index)."""
+        omap = self._ownership
+        if omap is not None:
+            return omap.owner(key)
+        if self.num_servers <= 0:
+            # transiently-empty book (eviction burst): retryable, unlike
+            # the hash fn's ValueError
+            raise ConnectionError("no servers in current book")
         return assign_server(
             key,
             self.num_servers,
@@ -1494,6 +1698,7 @@ class PSClient:
             mixed_mode=self.cfg.enable_mixed_mode,
             mixed_bound=self.cfg.mixed_mode_bound,
             num_workers=self.num_workers,
+            ring_vnodes=self.cfg.ring_vnodes,
         )
 
     def _conn_for(self, key: int, revive: bool = False) -> _ServerConn:
@@ -1508,15 +1713,38 @@ class PSClient:
         restart, deadline teardown) heals without scheduler involvement.
         """
         servers = self._servers
-        idx = assign_server(
-            key,
-            len(servers),
-            fn=self.cfg.key_hash_fn,
-            coef=self.cfg.built_in_hash_coef,
-            mixed_mode=self.cfg.enable_mixed_mode,
-            mixed_bound=self.cfg.mixed_mode_bound,
-            num_workers=self.num_workers,
+        if not servers:
+            # a burst of evictions can transiently empty the book;
+            # ConnectionError (not the hash fn's ValueError) keeps this
+            # on the retry path so the next book heals it
+            raise ConnectionError("no servers in current book")
+        routing = self._routing
+        # the ownership map routes only when its snapshot matches the
+        # live list (the two swap together; a mismatch means a rebuild is
+        # mid-swap or the client was built without a book — fall back to
+        # legacy count-hash routing, which the redirect chase corrects)
+        ranks, omap = (
+            (routing[1], routing[2]) if routing[0] is servers else ([], None)
         )
+        if omap is not None and ranks and len(ranks) == len(servers):
+            owner = omap.owner(key)
+            try:
+                idx = ranks.index(owner)
+            except ValueError:
+                raise ConnectionError(
+                    f"owner rank {owner} not in current book"
+                ) from None
+        else:
+            idx = assign_server(
+                key,
+                len(servers),
+                fn=self.cfg.key_hash_fn,
+                coef=self.cfg.built_in_hash_coef,
+                mixed_mode=self.cfg.enable_mixed_mode,
+                mixed_bound=self.cfg.mixed_mode_bound,
+                num_workers=self.num_workers,
+                ring_vnodes=self.cfg.ring_vnodes,
+            )
         sc = servers[idx]
         if revive and getattr(sc, "dead", False):
             sc = self._revive_conn(idx, sc)
@@ -1713,9 +1941,11 @@ class PSClient:
             on_error=on_error,
             abort_check=abort_check,
             precheck=lambda: self.server_generation == gen0,
-            # no frame-level heal: the fused error path is the unfuse
-            # fallback, whose per-key RPCs each carry their own heal
+            # no frame-level heal and no redirect chase: the fused error
+            # path is the unfuse fallback, whose per-key RPCs each carry
+            # their own heal (and chase WRONG_OWNER individually)
             heal=False,
+            chase=False,
         )
 
     def pull(

@@ -115,6 +115,24 @@ class Scheduler:
         #: (resize, dead-slot adoption, eviction) and carried in every
         #: address book
         self.epoch = 0
+        #: key→server OWNERSHIP map epoch (docs/robustness.md "migration
+        #: flow"): bumped only when the SERVER set changes (join, leave,
+        #: eviction, dead-slot adoption with a new address), so worker
+        #: churn never triggers key migration.  Carried in every book;
+        #: with BYTEPS_ELASTIC_RESHARD servers migrate re-homed keys and
+        #: workers chase WRONG_OWNER redirects stamped with it.
+        self.map_epoch = 0
+        self._map_sig: Optional[tuple] = None
+        #: elastic resharding policy (BYTEPS_ELASTIC_RESHARD): scale-down
+        #: then DRAINS dropped servers (they migrate their keys out and
+        #: stop themselves) instead of SHUTDOWN-ing them cold
+        self.reshard = os.environ.get(
+            "BYTEPS_ELASTIC_RESHARD", ""
+        ).lower() not in ("", "0", "false", "no", "off")
+        #: dropped servers awaiting their drain book (sent after the map
+        #: epoch bump in _complete_recovery, so the book they drain
+        #: against is the settled new topology)
+        self._pending_drains: List[_Node] = []
         #: cumulative evictions per role, shipped in books for telemetry
         self.eviction_totals: Dict[str, int] = {"worker": 0, "server": 0}
         self._sock, self.port = listen(host, port)
@@ -146,6 +164,11 @@ class Scheduler:
         from byteps_tpu.core.telemetry import MetricsRegistry
 
         self.metrics_agg = MetricsRegistry()
+        # the ownership map's version, scrapeable from the cluster
+        # aggregate so an operator (tools/bps_top.py) can watch a
+        # migration settle next to the per-server owned-key gauges the
+        # servers heartbeat in
+        self.metrics_agg.gauge_fn("cluster_map_epoch", lambda: self.map_epoch)
         self._metrics_http = None
 
     def start(self) -> None:
@@ -211,6 +234,9 @@ class Scheduler:
                     self.num_servers = max(0, self.num_servers - 1)
                 self.eviction_totals[role] += 1
             self.epoch += 1
+            # a server eviction re-homes its keys: new ownership epoch
+            # (worker evictions leave the map untouched)
+            self._bump_map_epoch_locked()
             # survivors adopt the shrunken topology (workers rebuild their
             # server set / adopt the worker count; servers complete
             # partial rounds) — the elastic recovery path, auto-triggered
@@ -234,6 +260,20 @@ class Scheduler:
             # FIN wakes a hung-but-alive node's control reader so it
             # learns it was expelled instead of waiting forever
             close_socket(n.conn)
+
+    def _bump_map_epoch_locked(self) -> bool:
+        """Advance the ownership-map epoch iff the server set actually
+        changed (identity: sorted (rank, host, port)).  Caller holds the
+        lock.  Worker-only membership events keep the map epoch — and
+        therefore key placement — untouched."""
+        sig = tuple(
+            sorted((n.rank, n.host, n.port) for n in self._nodes["server"])
+        )
+        if sig == self._map_sig:
+            return False
+        self._map_sig = sig
+        self.map_epoch += 1
+        return True
 
     def _release_satisfied_barriers_locked(self) -> None:
         """After a group shrinks, pending barriers may already be full —
@@ -400,6 +440,15 @@ class Scheduler:
                     self._nodes["server"] = keep
                     for n in dropped:
                         self._conn_ids.pop(n.conn, None)
+                        if self.reshard:
+                            # DRAIN, don't kill: the dropped server must
+                            # first migrate its keys to the new owners.
+                            # Its drain book is sent from
+                            # _complete_recovery, AFTER the map epoch
+                            # bump, so it drains against the settled
+                            # topology; it stops itself when done.
+                            self._pending_drains.append(n)
+                            continue
                         try:
                             send_message(
                                 n.conn, Message(Op.SHUTDOWN, seq=RESIZE_SEQ),
@@ -491,6 +540,7 @@ class Scheduler:
                 return
             if full and not self._addrbook_sent:
                 self._addrbook_sent = True
+                self._bump_map_epoch_locked()  # initial placement: epoch 1
                 for r in ("worker", "server"):
                     for node in self._nodes[r]:
                         self._send_addrbook_to(node.conn, node.send_lock, r, node.rank, 0)
@@ -511,8 +561,10 @@ class Scheduler:
         if resized or self._parked_regs or self._pending_broadcast:
             # topology-visible change (resize, dead-slot adoption, parked
             # flush): new membership epoch — stamp it into EVERY book sent
-            # below, the recovering node's included
+            # below, the recovering node's included.  The OWNERSHIP epoch
+            # advances only when the server set itself changed.
             self.epoch += 1
+            self._bump_map_epoch_locked()
         self._send_addrbook_to(conn, send_lock, role, rank, seq, recovery=True)
         parked, self._parked_regs = self._parked_regs, []
         for pconn, plock, prole, prank, pseq in parked:
@@ -528,8 +580,18 @@ class Scheduler:
                         self._send_addrbook_to(
                             node.conn, node.send_lock, r, node.rank, RESIZE_SEQ
                         )
+        # scale-down under resharding: each dropped server gets a DRAIN
+        # book (the new topology, its own rank excluded, drain flag set)
+        # so it migrates every key it owns to the new owners and then
+        # stops itself — the SHUTDOWN-cold path is the legacy behavior
+        drains, self._pending_drains = self._pending_drains, []
+        for n in drains:
+            self._send_addrbook_to(
+                n.conn, n.send_lock, "server", n.rank, RESIZE_SEQ, drain=True
+            )
 
-    def _send_addrbook_to(self, conn, send_lock, role, rank, seq, recovery=False) -> None:
+    def _send_addrbook_to(self, conn, send_lock, role, rank, seq,
+                          recovery=False, drain=False) -> None:
         servers = sorted(self._nodes["server"], key=lambda n: n.rank)
         book = {
             "role": role,
@@ -548,7 +610,17 @@ class Scheduler:
             "epoch": self.epoch,
             "evictions": dict(self.eviction_totals),
             "worker_ranks": sorted(n.rank for n in self._nodes["worker"]),
+            # ownership plane (docs/robustness.md "migration flow"):
+            # server RANKS parallel to the address list (ranks are stable
+            # identities — after an eviction the list is non-contiguous),
+            # plus the map epoch those ranks own the key space under.
+            # "drain": this book orders the receiving server to migrate
+            # every key out and stop (it is no longer in the rank list).
+            "server_ranks": [n.rank for n in servers],
+            "map_epoch": self.map_epoch,
         }
+        if drain:
+            book["drain"] = True
         try:
             send_message(
                 conn,

@@ -73,6 +73,17 @@ class Op(enum.IntEnum):
     # engines (the C++ server answers from its native ledger).
     RESYNC_QUERY = 23  # worker → server: {worker flag, keys of interest}
     RESYNC_STATE = 24  # server → worker: per-key {store_version, seen, ...}
+    # elastic resharding plane (docs/robustness.md "migration flow"): the
+    # key→server ownership map is versioned (consistent-hash ring,
+    # epoch-stamped like worker membership); when the server set changes
+    # the old owner ships each re-homed key's authoritative state —
+    # store + exactly-once ledger + init-token record — to the new owner,
+    # and answers stale-map requests with a redirect carrying the new map
+    # epoch.  Workers chase the redirect the way they chase RESYNC;
+    # the migrated ledger makes the handoff exactly-once.
+    MIGRATE_STATE = 25  # old owner → new owner: one key's full state
+    WRONG_OWNER = 26    # server → worker reply: {new owner rank};
+                        # header ``version`` carries the new map epoch
 
 
 class Message:
@@ -380,6 +391,75 @@ def decode_resync_state(payload: bytes) -> dict:
     if not isinstance(raw, dict) or not isinstance(raw.get("keys", {}), dict):
         raise ValueError("resync state body must be a JSON object")
     return {int(k): v for k, v in raw.get("keys", {}).items()}
+
+
+# --- resharding frames (Op.MIGRATE_STATE / Op.WRONG_OWNER) ----------------
+#
+# MIGRATE_STATE body: u32 json length + JSON metadata + raw store bytes +
+# raw accumulator bytes.  The metadata (key, map epoch, dtype, round
+# state, the per-(worker) exactly-once ledger ``push_seen``, the
+# init-token record ``init_done``, compressor kwargs) is JSON like the
+# RESYNC bodies — migration is a rare control-plane event and the state
+# is already proven byte-stable in that encoding; the two big arrays ride
+# raw after it so a multi-MB store pays no base64 tax.  The receiver acks
+# with an empty MIGRATE_STATE reply (nonzero status = refused: resharding
+# disabled, or an engine that cannot import state).
+#
+# WRONG_OWNER body: JSON {"owner": rank, "epoch": map_epoch}; the header
+# ``version`` field carries the epoch too so a worker can chase without
+# parsing the body.
+
+
+def encode_migrate_state(meta: dict, store: bytes = b"",
+                         accum: bytes = b"") -> bytes:
+    """Body of an Op.MIGRATE_STATE frame; ``meta`` must already carry
+    ``store_nbytes``/``accum_nbytes`` matching the raw tails."""
+    import json
+
+    head = json.dumps(meta).encode()
+    return struct.pack("!I", len(head)) + head + store + accum
+
+
+def decode_migrate_state(payload: bytes) -> Tuple[dict, bytes, bytes]:
+    """Inverse of :func:`encode_migrate_state` → (meta, store, accum);
+    raises ValueError on a malformed or truncated body."""
+    import json
+
+    if len(payload) < 4:
+        raise ValueError("migrate frame too short")
+    (hlen,) = struct.unpack_from("!I", payload, 0)
+    if 4 + hlen > len(payload):
+        raise ValueError("migrate frame truncated (header)")
+    meta = json.loads(payload[4 : 4 + hlen].decode())
+    if not isinstance(meta, dict):
+        raise ValueError("migrate metadata must be a JSON object")
+    off = 4 + hlen
+    sn = int(meta.get("store_nbytes", 0))
+    an = int(meta.get("accum_nbytes", 0))
+    if sn < 0 or an < 0 or off + sn + an > len(payload):
+        raise ValueError("migrate frame truncated (payload)")
+    return meta, payload[off : off + sn], payload[off + sn : off + sn + an]
+
+
+def encode_wrong_owner(epoch: int, owner: int) -> bytes:
+    """Body of an Op.WRONG_OWNER reply."""
+    import json
+
+    return json.dumps({"owner": int(owner), "epoch": int(epoch)}).encode()
+
+
+def decode_wrong_owner(payload: bytes) -> Tuple[int, int]:
+    """→ (map_epoch, owner_rank); tolerant of an empty body (the header
+    ``version`` field is the authoritative epoch) → (0, -1)."""
+    import json
+
+    try:
+        raw = json.loads(payload.decode()) if payload else {}
+    except (ValueError, UnicodeDecodeError):
+        raw = {}
+    if not isinstance(raw, dict):
+        raw = {}
+    return int(raw.get("epoch", 0)), int(raw.get("owner", -1))
 
 
 def close_socket(sock: Optional[socket.socket]) -> None:

@@ -144,6 +144,21 @@ class Config:
     # surface DegradedError immediately, the pre-recovery behavior
     resync_deadline_s: float = 5.0  # BYTEPS_RESYNC_DEADLINE_S
 
+    # --- elastic server resharding (docs/robustness.md "migration flow") ---
+    # live key migration on server join/leave: ownership is an
+    # epoch-stamped consistent-hash ring, old owners ship each re-homed
+    # key's state (store + exactly-once ledger + init tokens) to the new
+    # owner over Op.MIGRATE_STATE, and stale-map workers chase
+    # Op.WRONG_OWNER redirects — no cluster-wide re-init barrier.  Off
+    # (default): a server resize re-homes keys via the hash fns and
+    # forces the re-init barrier (the pre-resharding behavior).
+    elastic_reshard: bool = False  # BYTEPS_ELASTIC_RESHARD
+    # virtual nodes per server rank on the ownership ring (also fn="ring")
+    ring_vnodes: int = 64  # BYTEPS_RING_VNODES
+    # how long a new owner parks requests for a key whose migration is
+    # inbound before dropping them back to the caller's retry path
+    migrate_deadline_s: float = 10.0  # BYTEPS_MIGRATE_DEADLINE_S
+
     # --- transport (ps-lite van lanes) ---
     # parallel TCP connections per server, partitions striped across them
     # by key — the implementable analogue of the reference's RDMA/UCX
@@ -256,6 +271,11 @@ class Config:
             journal_bytes=max(1, _env_int("BYTEPS_JOURNAL_BYTES", 64 << 20)),
             resync_deadline_s=float(
                 os.environ.get("BYTEPS_RESYNC_DEADLINE_S", "5") or "5"
+            ),
+            elastic_reshard=_env_bool("BYTEPS_ELASTIC_RESHARD"),
+            ring_vnodes=max(1, _env_int("BYTEPS_RING_VNODES", 64)),
+            migrate_deadline_s=float(
+                os.environ.get("BYTEPS_MIGRATE_DEADLINE_S", "10") or "10"
             ),
             tcp_streams=max(1, _env_int("BYTEPS_TCP_STREAMS", 1)),
             native_client=_env_bool("BYTEPS_NATIVE_CLIENT"),

@@ -521,7 +521,14 @@ class PipelineEngine:
           the hash fns, so the init barrier re-runs against the new owners
           (their stores start fresh), compressor configs re-ship, and the
           version sequence restarts (the barrier reset server-side round
-          counters) with the round gate re-seeded to match.
+          counters) with the round gate re-seeded to match.  Under
+          BYTEPS_ELASTIC_RESHARD this path never fires for a resize: the
+          client does NOT bump server_generation when a book carries an
+          ownership map (ps_client._rebuild_servers), because the servers
+          migrate each re-homed key's state — store, exactly-once ledger,
+          init-token record — to its new owner (docs/robustness.md
+          "migration flow"), so the version sequence continues in place
+          and pushes simply chase WRONG_OWNER redirects to the new home.
         - Gate seeding is per ENGINE, not per ctx-init: the registry (and
           its version counters) outlive shutdown()/init() cycles, while
           each engine starts with a fresh ReadyTable — a reused tensor name

@@ -411,6 +411,7 @@ class MetricsRegistry:
         self._shipped_counts: Dict[str, int] = {}
         self._shipped_labeled: Dict[str, Dict[tuple, int]] = {}
         self._shipped_hists: Dict[Tuple[str, tuple], Tuple[List[int], float, int]] = {}
+        self._shipped_gauges: Dict[Tuple[str, tuple], float] = {}
 
     # --- registration / recording ---------------------------------------
 
@@ -788,6 +789,33 @@ class MetricsRegistry:
             self._shipped_hists[(name, lkey)] = (raw, vsum, count)
         if h_delta:
             out["h"] = h_delta
+        # gauges ship as CURRENT values when they changed (or appeared)
+        # since the last beat, plus removal markers for series a stopping
+        # source dropped — so the scheduler aggregate tracks e.g. each
+        # server's owned-key count through a migration without a dead
+        # rank's frozen gauge lingering (docs/observability.md)
+        with self._lock:
+            cur = dict(self._gauges)
+            for key, fn in self._gauge_fns.items():
+                try:
+                    cur[key] = float(fn())
+                except Exception:  # noqa: BLE001 — broken gauge ≠ broken beat
+                    continue
+        g_delta = [
+            {"n": name, "l": [list(kv) for kv in lkey], "v": v}
+            for (name, lkey), v in cur.items()
+            if self._shipped_gauges.get((name, lkey)) != v
+        ]
+        if g_delta:
+            out["g"] = g_delta
+        gone = [
+            {"n": name, "l": [list(kv) for kv in lkey]}
+            for (name, lkey) in self._shipped_gauges
+            if (name, lkey) not in cur
+        ]
+        if gone:
+            out["gr"] = gone
+        self._shipped_gauges = cur
         self._shipped_counts = flat
         self._shipped_labeled = labeled
         # fold back any delta whose heartbeat FAILED to send: its
@@ -806,6 +834,29 @@ class MetricsRegistry:
                 # merge_delta adds records independently, so duplicate
                 # (name, labels) entries in one payload sum correctly
                 out.setdefault("h", []).extend(old["h"])
+            # gauges are current-value: requeued records go FIRST so a
+            # fresher value of the same series in this beat wins — and a
+            # requeued record is DROPPED outright when this beat carries
+            # the opposite kind for the same series (the receiver applies
+            # all "g" then all "gr" per payload, so a stale requeued
+            # removal would otherwise delete a series that just
+            # reappeared, and a stale requeued value would resurrect one
+            # that was just removed)
+            fresh = {
+                field: {
+                    (r.get("n"), tuple(map(tuple, r.get("l") or ())))
+                    for r in out.get(field) or ()
+                }
+                for field in ("g", "gr")
+            }
+            for field, opposite in (("g", "gr"), ("gr", "g")):
+                keep = [
+                    r for r in old.get(field) or ()
+                    if (r.get("n"), tuple(map(tuple, r.get("l") or ())))
+                    not in fresh[opposite]
+                ]
+                if keep:
+                    out[field] = keep + list(out.get(field, []))
         return out
 
     def requeue_delta(self, delta: dict) -> None:
@@ -851,6 +902,29 @@ class MetricsRegistry:
                 )
             except (KeyError, ValueError, TypeError):
                 continue  # malformed delta: drop, never poison the scrape
+        # gauges: current values, node labels merged with the sender tag
+        # (so cluster_map_epoch sits next to each server's
+        # server_owned_keys{rank} in the bps_top view); "gr" drops series
+        # a stopping source removed (a drained server's owned-key gauge)
+        for rec in delta.get("g") or ():
+            try:
+                node_labels = dict(tuple(kv) for kv in rec.get("l") or ())
+                if labels:
+                    node_labels.update(labels)
+                self.gauge_set(
+                    str(rec["n"]), float(rec["v"]),
+                    labels=node_labels or None,
+                )
+            except (KeyError, ValueError, TypeError):
+                continue
+        for rec in delta.get("gr") or ():
+            try:
+                node_labels = dict(tuple(kv) for kv in rec.get("l") or ())
+                if labels:
+                    node_labels.update(labels)
+                self.gauge_remove(str(rec["n"]), labels=node_labels or None)
+            except (KeyError, ValueError, TypeError):
+                continue
 
 
 class MetricsHTTPServer:

@@ -138,6 +138,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             c.c_int32, c.POINTER(c.c_uint8), c.c_int32,
         ]
         lib.bps_native_server_set_live_workers.restype = None
+        # elastic resharding plane (docs/robustness.md "migration flow")
+        if hasattr(lib, "bps_native_server_set_ownership"):
+            lib.bps_native_server_set_ownership.argtypes = [
+                c.c_int32, c.c_int32, c.c_uint32, c.c_int32,
+                c.POINTER(c.c_uint64), c.POINTER(c.c_int32),
+            ]
+            lib.bps_native_server_set_ownership.restype = None
         lib.bps_wire_golden.argtypes = [c.c_void_p, c.c_uint64]
         lib.bps_wire_golden.restype = c.c_int64
         lib.bps_wire_fused_echo.argtypes = [
@@ -181,6 +188,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bps_native_server_stripe_queue_depths.restype = c.c_int32
         lib.bps_wire_key_stripe.argtypes = [c.c_uint64, c.c_int32]
         lib.bps_wire_key_stripe.restype = c.c_int32
+    if hasattr(lib, "bps_wire_ring_hash"):
+        lib.bps_wire_ring_hash.argtypes = [c.c_uint64]
+        lib.bps_wire_ring_hash.restype = c.c_uint64
     # native worker client data plane (ps_client.cc) — may be absent in a
     # stale .so; the pure-Python client covers every van without it
     if hasattr(lib, "bpsc_create"):
@@ -230,10 +240,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None  # corrupt/partial .so → pure-Python fallbacks
-    if not hasattr(lib, "bps_native_server_stripe_queue_depths") and autobuild:
+    if not hasattr(lib, "bps_native_server_set_ownership") and autobuild:
         # stale library from before the newest entry points (currently
-        # the key-striped reducer plane: stripe-depth feed + key→stripe
-        # shim — also the 56-byte SpanRec layout marker): rebuild, then
+        # the elastic resharding plane: ownership map + WRONG_OWNER
+        # replies): rebuild, then
         # load via a temp COPY — dlopen dedups by path/inode, so
         # reloading the original path can hand back the old mapping
         _try_build()
@@ -276,6 +286,7 @@ NATIVE_COUNTER_NAMES = (
     "native_resync_query",
     "native_zombie_reject",
     "native_span_drop",
+    "native_wrong_owner",
 )
 
 

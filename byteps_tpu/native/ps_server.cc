@@ -104,6 +104,7 @@ using bps_wire::kPing;
 using bps_wire::kShutdown;
 using bps_wire::kResyncQuery;
 using bps_wire::kResyncState;
+using bps_wire::kWrongOwner;
 using bps_wire::kTraceFlag;
 using bps_wire::pack_header;
 
@@ -120,6 +121,7 @@ enum NativeCounter {
   kCtrResyncQuery,    // Op.RESYNC_QUERY frames answered from the ledger
   kCtrZombieReject,   // pushes rejected by the live-rank fence
   kCtrSpanDrop,       // span records dropped on a full trace ring
+  kCtrWrongOwner,     // requests redirected by the ownership map
   kCtrCount,
 };
 
@@ -132,6 +134,7 @@ const char* const kCounterNames[kCtrCount] = {
     "native_wire_rpc",        "native_fused_frames",  "native_fused_keys",
     "native_push_dedup",      "native_init_replay_ack",
     "native_resync_query",    "native_zombie_reject", "native_span_drop",
+    "native_wrong_owner",
 };
 
 // ---------------------------------------------------------------------------
@@ -905,17 +908,31 @@ struct FusedReply {
   std::vector<std::vector<uint8_t>> slots;
   std::vector<uint8_t> filled;
   size_t remaining = 0;
+  // set when the frame was answered OUT of band (an ownership-map
+  // WRONG_OWNER redirect): later round publishes must not fill slots
+  // into a seq the worker already resolved — a second response on one
+  // seq would corrupt the client's demux (server.py _FusedReply parity)
+  bool aborted = false;
   std::mutex mu;
 
   // True exactly once — when this fill completed the frame (the caller
   // then sends the reply).  Duplicate publish race: first fill wins.
   bool fill(size_t slot, std::vector<uint8_t>&& payload, uint32_t version) {
     std::lock_guard<std::mutex> g(mu);
-    if (filled[slot]) return false;
+    if (aborted || filled[slot]) return false;
     filled[slot] = 1;
     slots[slot] = std::move(payload);
     versions[slot] = version;
     return --remaining == 0;
+  }
+
+  // True exactly once — the winner sends the out-of-band reply on this
+  // frame's seq (false once the normal reply already left).
+  bool abort_once() {
+    std::lock_guard<std::mutex> g(mu);
+    if (aborted || remaining == 0) return false;
+    aborted = true;
+    return true;
   }
 };
 using FusedReplyPtr = std::shared_ptr<FusedReply>;
@@ -1234,6 +1251,29 @@ class NativeServer {
     }
     fence_on_ = true;
     for (int32_t i = 0; i < n; ++i) live_.insert(flags[i]);
+  }
+
+  // Adopt an ownership map (docs/robustness.md "migration flow"): the
+  // Python wrapper ships each scheduler book's consistent-hash ring as
+  // precomputed sorted (point hash, rank) arrays.  n <= 0 disables
+  // (back to map-less serving — every key served, never redirected).
+  void set_ownership(int32_t my_rank, uint32_t epoch, int32_t n,
+                     const uint64_t* hashes, const int32_t* ranks) {
+    // build an IMMUTABLE snapshot and publish it with one atomic
+    // pointer swap: the redirect check on every stripe's reducer thread
+    // reads it lock-free (a shared mutex here would re-serialize the
+    // key-striped data path the multi-core engine exists to unshare)
+    std::shared_ptr<const OwnMap> next;
+    if (n > 0 && hashes && ranks && my_rank >= 0) {
+      auto m = std::make_shared<OwnMap>();
+      m->hashes.assign(hashes, hashes + n);
+      m->ranks.assign(ranks, ranks + n);
+      m->epoch = epoch;
+      m->rank = my_rank;
+      next = std::move(m);
+    }
+    std::atomic_store_explicit(&own_, next, std::memory_order_release);
+    own_set_.store(next != nullptr, std::memory_order_release);
   }
 
   // copy this instance's counters (NativeCounter order) into out
@@ -1794,8 +1834,13 @@ class NativeServer {
     Stripe& stripe = stripe_of(key);
     std::vector<InitWaiter> waiters;
     bool replay_ack = false;
+    uint32_t ro_epoch = 0;
+    int32_t ro_owner = -1;
+    bool redirect = false;
     {
       std::lock_guard<std::mutex> g(stripe.mu);
+      redirect = redirect_locked(stripe, key, &ro_epoch, &ro_owner);
+      if (!redirect) {
       KeyState& ks = key_state_locked(stripe, key);
       if (ks.store.empty()) {
         ks.dtype = (int32_t)dt;
@@ -1835,6 +1880,13 @@ class NativeServer {
         if (workers > 0 && (int)ks.init_waiters.size() >= workers)
           complete_init_barrier_locked(ks, &waiters);
       }
+      }  // !redirect
+    }
+    if (redirect) {
+      // the map homes this key elsewhere: the worker's init chases to
+      // the owner instead of planting a split-brain store here
+      send_wrong_owner(conn, seq, key, ro_epoch, ro_owner);
+      return true;
     }
     if (replay_ack) {
       send_msg(conn, kInit, seq, key, 0, nullptr, 0);
@@ -1892,6 +1944,44 @@ class NativeServer {
     if (!fence_on_ || live_.count(wid)) return false;
     ctr_[kCtrZombieReject].fetch_add(1, std::memory_order_relaxed);
     return true;
+  }
+
+  // Ownership redirect check (server.py _redirect_locked parity; caller
+  // holds st.mu so the verdict is atomic with the summation it gates).
+  // True → the caller replies kWrongOwner instead of serving.  A key
+  // this engine still HOLDS serves normally even when the map homes it
+  // elsewhere — the native engine never ships state, so it simply stays
+  // authoritative (the Python pre-ship-window rule, indefinitely).
+  bool redirect_locked(Stripe& st, uint64_t key, uint32_t* epoch,
+                       int32_t* owner) {
+    if (!own_set_.load(std::memory_order_relaxed)) return false;
+    std::shared_ptr<const OwnMap> m =
+        std::atomic_load_explicit(&own_, std::memory_order_acquire);
+    if (!m || m->hashes.empty() || m->rank < 0) return false;
+    auto it = std::upper_bound(m->hashes.begin(), m->hashes.end(),
+                               bps_wire::ring_key_hash(key));
+    size_t i = (size_t)(it - m->hashes.begin());
+    if (i >= m->hashes.size()) i = 0;  // wrap: past last point → first
+    int32_t o = m->ranks[i];
+    if (o == m->rank) return false;
+    auto kit = st.keys.find(key);
+    if (kit != st.keys.end() && !kit->second->store.empty())
+      return false;  // held here: stays authoritative
+    *epoch = m->epoch;
+    *owner = o;
+    return true;
+  }
+
+  void send_wrong_owner(const ConnPtr& conn, uint32_t seq, uint64_t key,
+                        uint32_t epoch, int32_t owner) {
+    ctr_[kCtrWrongOwner].fetch_add(1, std::memory_order_relaxed);
+    char body[64];
+    int n = snprintf(body, sizeof(body), "{\"owner\": %d, \"epoch\": %u}",
+                     (int)owner, (unsigned)epoch);
+    // header version carries the epoch too (transport.py contract: a
+    // worker can chase without parsing the body)
+    send_msg(conn, kWrongOwner, seq, key, epoch, (const uint8_t*)body,
+             (uint64_t)n);
   }
 
   // replay-dedupe check (caller holds ks.mu): true when this (worker,
@@ -1974,26 +2064,36 @@ class NativeServer {
            kSpanRecv, 0, sid);
     bool dedupe = false;
     double published = 0.0;
-    KeyState* ksp;
+    uint32_t ro_epoch = 0;
+    int32_t ro_owner = -1;
+    KeyState* ksp = nullptr;
     {
       std::lock_guard<std::mutex> g(st.mu);
-      KeyState& ks = key_state_locked(st, t.key);
-      ksp = &ks;
-      if (ks.store.empty()) return false;  // push before init → drop conn
-      dedupe = is_replayed_push_locked(ks, t.flags, t.version);
-      if (rtype == 1) {  // kRowSparsePushPull: scatter-sum rows
-        if (!dedupe &&
-            !handle_push_rowsparse_locked(ks, t.flags, t.version, t.payload,
-                                          &flush, &fused_done, &published))
-          return false;
-      } else {
-        bool compressed = (rtype == 2) && ks.codec != nullptr;
-        if (!dedupe &&
-            !sum_push_locked(ks, t.flags, t.version, t.payload.data(),
-                             t.payload.size(), compressed, &flush,
-                             &fused_done, &published))
-          return false;
+      // checked under st.mu so the verdict is atomic with the sum it
+      // gates; the reply goes out after the unlock (small + rare)
+      if (!redirect_locked(st, t.key, &ro_epoch, &ro_owner)) {
+        KeyState& ks = key_state_locked(st, t.key);
+        ksp = &ks;
+        if (ks.store.empty()) return false;  // push before init → drop conn
+        dedupe = is_replayed_push_locked(ks, t.flags, t.version);
+        if (rtype == 1) {  // kRowSparsePushPull: scatter-sum rows
+          if (!dedupe &&
+              !handle_push_rowsparse_locked(ks, t.flags, t.version, t.payload,
+                                            &flush, &fused_done, &published))
+            return false;
+        } else {
+          bool compressed = (rtype == 2) && ks.codec != nullptr;
+          if (!dedupe &&
+              !sum_push_locked(ks, t.flags, t.version, t.payload.data(),
+                               t.payload.size(), compressed, &flush,
+                               &fused_done, &published))
+            return false;
+        }
       }
+    }
+    if (ksp == nullptr) {  // ownership redirect: no state was touched
+      send_wrong_owner(t.conn, t.seq, t.key, ro_epoch, ro_owner);
+      return true;
     }
     ksp->size_hist.observe((double)t.payload.size());
     double t_summed = wall_now();
@@ -2180,27 +2280,43 @@ class NativeServer {
     double published = 0.0;
     bool dedupe = false;
     bool completed = false;
-    KeyState* ksp;
+    uint32_t ro_epoch = 0;
+    int32_t ro_owner = -1;
+    KeyState* ksp = nullptr;
     {
       std::lock_guard<std::mutex> g(st.mu);
-      KeyState& ks = key_state_locked(st, t.key);
-      ksp = &ks;
-      if (ks.store.empty()) return false;  // member before init → drop
-      bool compressed = (rtype == 2) && ks.codec != nullptr;
-      dedupe = is_replayed_push_locked(ks, t.flags, t.version);
-      if (!dedupe &&
-          !sum_push_locked(ks, t.flags, t.version, pay, t.len, compressed,
-                           &flush, &fused_done, &published))
-        return false;
-      // this member's pull half: answered now if its round is
-      // published (async mode always is), else parked on the key
-      if (async_ || t.version <= ks.store_version) {
-        if (t.freply->fill(t.slot, wire_payload_locked(ks, compressed),
-                           ks.store_version))
-          completed = true;
-      } else {
-        ks.fused_waiters.push_back({t.version, t.freply, t.slot, compressed});
+      if (!redirect_locked(st, t.key, &ro_epoch, &ro_owner)) {
+        KeyState& ks = key_state_locked(st, t.key);
+        ksp = &ks;
+        if (ks.store.empty()) return false;  // member before init → drop
+        bool compressed = (rtype == 2) && ks.codec != nullptr;
+        dedupe = is_replayed_push_locked(ks, t.flags, t.version);
+        if (!dedupe &&
+            !sum_push_locked(ks, t.flags, t.version, pay, t.len, compressed,
+                             &flush, &fused_done, &published))
+          return false;
+        // this member's pull half: answered now if its round is
+        // published (async mode always is), else parked on the key
+        if (async_ || t.version <= ks.store_version) {
+          if (t.freply->fill(t.slot, wire_payload_locked(ks, compressed),
+                             ks.store_version))
+            completed = true;
+        } else {
+          ks.fused_waiters.push_back({t.version, t.freply, t.slot,
+                                      compressed});
+        }
       }
+    }
+    if (ksp == nullptr) {
+      // ownership redirect: abandon the FRAME — members already summed
+      // by earlier stripes are in the exactly-once ledger, so the
+      // worker's unfuse-fallback replay re-sums nothing.  abort_once()
+      // fences the reply so fused_waiters parked by earlier members can
+      // never answer the resolved seq (server.py _handle_fused parity).
+      if (t.freply->abort_once())
+        send_wrong_owner(t.freply->conn, t.freply->seq, t.freply->route_key,
+                         ro_epoch, ro_owner);
+      return true;
     }
     ksp->size_hist.observe((double)t.len);
     double t_m1 = wall_now();
@@ -2368,27 +2484,37 @@ class NativeServer {
       span(t.trace_id, t.span_id, t.key, t.t_enq, t_start - t.t_enq,
            kSpanRecv, 0, sid);
     std::vector<uint8_t> data;
-    uint32_t ver;
+    uint32_t ver = 0;
+    uint32_t ro_epoch = 0;
+    int32_t ro_owner = -1;
+    bool redirect = false;
     {
       std::lock_guard<std::mutex> g(st.mu);
-      KeyState& ks = key_state_locked(st, t.key);
-      if (ks.store.empty()) return false;  // pull before init → drop conn
-      bool ready = async_ || t.version <= ks.store_version;
-      if (!ready) {
-        // parked: the round publish answers it; the worker-side PULL
-        // span keeps the wait attributable — no park span (server.py
-        // parity)
-        ks.pending.push_back({t.version, t.conn, t.seq, rtype == 2,
-                              rtype == 1 ? t.payload
-                                         : std::vector<uint8_t>{}});
-        return true;
+      redirect = redirect_locked(st, t.key, &ro_epoch, &ro_owner);
+      if (!redirect) {
+        KeyState& ks = key_state_locked(st, t.key);
+        if (ks.store.empty()) return false;  // pull before init → drop conn
+        bool ready = async_ || t.version <= ks.store_version;
+        if (!ready) {
+          // parked: the round publish answers it; the worker-side PULL
+          // span keeps the wait attributable — no park span (server.py
+          // parity)
+          ks.pending.push_back({t.version, t.conn, t.seq, rtype == 2,
+                                rtype == 1 ? t.payload
+                                           : std::vector<uint8_t>{}});
+          return true;
+        }
+        if (rtype == 1) {
+          if (!rs_gather_locked(ks, t.payload, &data)) return false;
+        } else {
+          data = wire_payload_locked(ks, rtype == 2);
+        }
+        ver = ks.store_version;
       }
-      if (rtype == 1) {
-        if (!rs_gather_locked(ks, t.payload, &data)) return false;
-      } else {
-        data = wire_payload_locked(ks, rtype == 2);
-      }
-      ver = ks.store_version;
+    }
+    if (redirect) {
+      send_wrong_owner(t.conn, t.seq, t.key, ro_epoch, ro_owner);
+      return true;
     }
     double t_ready = t.trace_id ? wall_now() : 0.0;
     send_msg(t.conn, kPull, t.seq, t.key, ver, data.data(), data.size());
@@ -2421,6 +2547,26 @@ class NativeServer {
   std::mutex live_mu_;
   bool fence_on_ = false;
   std::set<uint8_t> live_;
+  // elastic resharding ownership (docs/robustness.md "migration flow"):
+  // the consistent-hash ring's sorted (point, rank) arrays, shipped by
+  // the Python wrapper from each scheduler book
+  // (bps_native_server_set_ownership).  The data path pays ONE relaxed
+  // atomic load while no map is set; with a map, a request for a key
+  // this engine neither owns (per the map) nor holds (no store) gets a
+  // kWrongOwner reply carrying the map epoch, so stale-map workers
+  // re-route instead of splitting the key's sums across two servers.
+  // State migration itself stays Python-engine-only: kMigrateState
+  // falls through to the clean status=1 unknown-op echo.
+  struct OwnMap {
+    std::vector<uint64_t> hashes;  // sorted ring point hashes
+    std::vector<int32_t> ranks;    // parallel owner ranks
+    uint32_t epoch = 0;
+    int32_t rank = -1;             // this engine's server rank
+  };
+  std::atomic<bool> own_set_{false};
+  // immutable snapshot, swapped whole on book adoption; readers use
+  // atomic_load so the per-request check stays lock-free across stripes
+  std::shared_ptr<const OwnMap> own_;
   // observability counters (NativeCounter order; read via
   // bps_native_server_counters so GIL-free runs aren't metrics-blind)
   std::atomic<uint64_t> ctr_[kCtrCount] = {};
@@ -2524,6 +2670,21 @@ void bps_native_server_set_live_workers(int32_t port, const uint8_t* flags,
   if (it != g_servers.end()) it->second->set_live_workers(flags, n);
 }
 
+// Adopt an ownership map for the elastic resharding plane (docs/
+// robustness.md "migration flow"): sorted consistent-hash ring points
+// (hashes) with their owning server ranks, this instance's own rank,
+// and the map epoch stamped into kWrongOwner redirects.  n <= 0
+// disables the check.
+void bps_native_server_set_ownership(int32_t port, int32_t my_rank,
+                                     uint32_t epoch, int32_t n,
+                                     const uint64_t* hashes,
+                                     const int32_t* ranks) {
+  std::lock_guard<std::mutex> g(g_server_mu);
+  auto it = g_servers.find(port);
+  if (it != g_servers.end())
+    it->second->set_ownership(my_rank, epoch, n, hashes, ranks);
+}
+
 // Toggle an instance's span plane (NativePSServer pushes cfg.trace_on
 // && cfg.trace_spans; the engine's own default comes from the env).
 void bps_native_server_set_trace(int32_t port, int32_t on) {
@@ -2577,6 +2738,13 @@ int32_t bps_native_server_stripe_queue_depths(int32_t port, uint64_t* out,
 // key → reducer stripe through the LIVE mapping (wire.h key_stripe) —
 // lets tests pick keys that do (or don't) share a stripe, and pins the
 // hash so a silent remapping can't invalidate committed benchmarks.
+// Golden shim: the LIVE ring-coordinate hash the engine's ownership
+// redirect uses — tests pin it bit-identical to Python
+// hashing.ring_key_hash (elastic resharding plane).
+uint64_t bps_wire_ring_hash(uint64_t key) {
+  return bps_wire::ring_key_hash(key);
+}
+
 int32_t bps_wire_key_stripe(uint64_t key, int32_t n_stripes) {
   if (n_stripes <= 0) return -1;
   return (int32_t)bps_wire::key_stripe(key, (uint32_t)n_stripes);

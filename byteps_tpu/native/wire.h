@@ -11,6 +11,7 @@
 #include <endian.h>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 
 namespace bps_wire {
@@ -33,6 +34,15 @@ enum Opcode : uint8_t {
   // recovery plane (docs/robustness.md "healing flow")
   kResyncQuery = 23,
   kResyncState = 24,
+  // elastic resharding plane (docs/robustness.md "migration flow").
+  // The native engine REPLIES kWrongOwner for keys the adopted
+  // ownership map homes elsewhere, but cannot import or export key
+  // state — kMigrateState is listed for documentation and deliberately
+  // falls through to the clean unknown-op status=1 echo, so a Python
+  // old owner's shipment is refused (it rolls back and stays
+  // authoritative) instead of silently dropped.
+  kMigrateState = 25,
+  kWrongOwner = 26,
 };
 
 #pragma pack(push, 1)
@@ -96,6 +106,22 @@ inline uint32_t key_stripe(uint64_t key, uint32_t n_stripes) {
   z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
   z ^= z >> 31;
   return (uint32_t)(z % n_stripes);
+}
+
+// A tensor key's ownership-ring coordinate (elastic resharding plane):
+// splitmix64-finalized djb2 of the key's DECIMAL STRING — bit-identical
+// to Python hashing.ring_key_hash, pinned via bps_wire_ring_hash.  The
+// finalizer matters: raw djb2 of short decimal strings clusters near
+// the bottom of the u64 space and would hand one rank the whole ring.
+inline uint64_t ring_key_hash(uint64_t key) {
+  char buf[24];
+  int n = snprintf(buf, sizeof(buf), "%llu", (unsigned long long)key);
+  uint64_t z = 5381;
+  for (int i = 0; i < n; ++i) z = (z << 5) + z + (uint64_t)(uint8_t)buf[i];
+  z += 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
 }
 
 }  // namespace bps_wire

@@ -31,7 +31,7 @@ import json
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -83,6 +83,8 @@ class _KeyState:
         "pull_version",
         "raw_payload",
         "raw_version",
+        "migrated_to",
+        "migrate_epoch",
         "lock",
     )
 
@@ -129,6 +131,13 @@ class _KeyState:
         self.pull_version = -1
         self.raw_payload: Optional[bytes] = None   # round-cached raw bytes
         self.raw_version = -1
+        # elastic resharding tombstone (docs/robustness.md "migration
+        # flow"): rank this key's state was shipped to (None = lives
+        # here), and the map epoch of the last migration event in either
+        # direction — stamped into WRONG_OWNER redirects so a stale-map
+        # worker knows which book to wait for before chasing
+        self.migrated_to: Optional[int] = None
+        self.migrate_epoch = 0
         self.lock = threading.Lock()
 
     def wire_payload(self, compressed: bool, async_mode: bool = False) -> bytes:
@@ -172,7 +181,7 @@ class _FusedReply:
 
     __slots__ = (
         "conn", "send_lock", "seq", "route_key", "keys", "slots",
-        "versions", "remaining", "lock",
+        "versions", "remaining", "aborted", "lock",
     )
 
     def __init__(self, conn, send_lock, seq: int, route_key: int,
@@ -185,18 +194,32 @@ class _FusedReply:
         self.slots: List[Optional[bytes]] = [None] * len(keys)
         self.versions = [0] * len(keys)
         self.remaining = len(keys)
+        # set when the frame was answered OUT of band (WRONG_OWNER
+        # redirect / migration park): later round publishes must not fill
+        # slots into a seq the worker already resolved — a second
+        # response on one seq would corrupt the client's demux
+        self.aborted = False
         self.lock = threading.Lock()
 
     def fill(self, slot: int, payload: bytes, version: int) -> bool:
         """Record one member's merged round; True exactly once — when this
         fill completed the frame (the caller then queues the send)."""
         with self.lock:
-            if self.slots[slot] is not None:
-                return False  # duplicate publish race: first fill wins
+            if self.aborted or self.slots[slot] is not None:
+                return False  # aborted frame / duplicate publish race
             self.slots[slot] = payload
             self.versions[slot] = version
             self.remaining -= 1
             return self.remaining == 0
+
+    def abort(self) -> bool:
+        """Mark the frame as answered out of band; True exactly once
+        (the winner sends the out-of-band reply on this seq)."""
+        with self.lock:
+            if self.aborted or self.remaining == 0:
+                return False  # already aborted, or the reply already left
+            self.aborted = True
+            return True
 
     def send(self) -> None:
         from byteps_tpu.comm.transport import encode_fused_reply
@@ -274,6 +297,21 @@ class PSServer:
         self._live_worker_flags: Optional[set] = None
         self._sched_conn: Optional[socket.socket] = None
         self._reducer = _make_reducer()
+        # --- elastic resharding (docs/robustness.md "migration flow") ---
+        # ownership = epoch-stamped consistent-hash ring over server
+        # RANKS, adopted from scheduler books.  On a map change this
+        # server ships every re-homed key's state to its new owner
+        # (Op.MIGRATE_STATE) and answers stale-map requests with
+        # Op.WRONG_OWNER; requests for keys whose migration is inbound
+        # park until the state lands (bounded by BYTEPS_MIGRATE_DEADLINE_S).
+        self.reshard = cfg.elastic_reshard
+        self._ownership = None       # current OwnershipMap (or None)
+        self._prev_ownership = None  # the map before the last adoption
+        self._own_lock = threading.Lock()
+        self._peer_addrs: Dict[int, Tuple[str, int]] = {}
+        self._awaiting: Dict[int, List] = {}  # key → parked (t, msg, conn, lock)
+        self._awaiting_lock = threading.Lock()
+        self._awaiting_sweeper: Optional[threading.Thread] = None
         import os
 
         from byteps_tpu.common.config import resolve_node_uid
@@ -327,6 +365,15 @@ class PSServer:
         if self._metrics_http is not None:
             self._metrics_http.close()
             self._metrics_http = None
+        if self.reshard and self.rank is not None:
+            # ownership gauges describe a live server only — drop the
+            # series (in-process fleets reuse the registry across
+            # instances; a dead rank's frozen gauge would mislead)
+            from byteps_tpu.core.telemetry import metrics
+
+            labels = {"rank": str(self.rank)}
+            metrics().gauge_remove("server_owned_keys", labels=labels)
+            metrics().gauge_remove("server_map_epoch", labels=labels)
         self.tracer.flush()
         try:
             self._sock.close()  # listener: no peer to FIN
@@ -370,6 +417,7 @@ class PSServer:
         self.rank = book["rank"]
         self.num_workers = book["num_workers"]
         self._adopt_worker_ranks(book)
+        self._adopt_book(book)  # initial ownership map (no keys yet)
         # cross-process span identity (getattr keeps borrowed use safe;
         # both PSServer and NativePSServer carry a tracer — the native
         # wrapper's is fed by the engine's span-ring drain)
@@ -395,6 +443,9 @@ class PSServer:
                 book = json.loads(msg.payload.decode())
                 self.update_num_workers(book["num_workers"])
                 self._adopt_worker_ranks(book)
+                # ownership adoption LAST: a drain book's migration wave
+                # (and eventual stop) must see the settled worker count
+                self._adopt_book(book)
                 return
             if msg.op == Op.SHUTDOWN:
                 # elastic scale-down dropped this server from the book;
@@ -453,6 +504,503 @@ class PSServer:
             else None
         )
 
+    # --- elastic resharding (docs/robustness.md "migration flow") --------
+
+    def _adopt_book(self, book: dict) -> None:
+        """Adopt a book's ownership map.  A NEWER map epoch starts a
+        migration wave: every key this server holds whose new owner is
+        another rank is shipped there (store + exactly-once ledger +
+        init-token record) over Op.MIGRATE_STATE.  A ``drain`` book
+        (scale-down) excludes this server from the rank list, so the wave
+        empties the whole store and then stops the server."""
+        if not self.reshard or self.rank is None:
+            return
+        epoch = book.get("map_epoch")
+        ranks = book.get("server_ranks")
+        if epoch is None or not ranks:
+            return
+        drain = bool(book.get("drain"))
+        servers = [tuple(s) for s in (book.get("servers") or [])]
+        from byteps_tpu.common.hashing import OwnershipMap
+
+        with self._own_lock:
+            cur = self._ownership
+            if cur is not None and int(epoch) <= cur.epoch and not drain:
+                return  # stale or repeated book
+            new_map = OwnershipMap(
+                ranks, epoch=int(epoch), vnodes=self.cfg.ring_vnodes
+            )
+            self._prev_ownership = cur
+            self._ownership = new_map
+            self._peer_addrs = {
+                int(r): servers[i]
+                for i, r in enumerate(ranks)
+                if i < len(servers)
+            }
+        self._update_owned_gauge()
+        # the wave dials peers and ships payloads: off the control thread
+        threading.Thread(
+            target=self._migrate_wave, args=(new_map, drain),
+            name="ps-migrate", daemon=True,
+        ).start()
+
+    def _migrate_wave(self, new_map, drain: bool) -> None:
+        """Ship every re-homed key to its new owner.  Keys are shipped
+        one at a time over a per-destination connection; each key's
+        requests are served normally until the instant its state is
+        snapshotted (atomically with the tombstone, under the key lock),
+        redirected afterwards — the handoff window per key is one RPC,
+        not a cluster barrier.  Failed shipments RETRY with backoff —
+        on scale-up the destination is typically still coming up when
+        the book lands (its listener binds before it registers, but the
+        book beats its accept loop by a beat), and giving up would
+        strand the key: the new owner parks requests for a migration
+        that never comes until the degraded fallback re-creates the key
+        from scratch, split-braining it against this server's stale
+        copy.  A scale-up wave stops retrying when a newer map
+        supersedes it; a drain wave (scale-down book) retries until the
+        store is empty, and only then stops the server: stopping with
+        unshipped keys would LOSE their state, so a server that cannot
+        drain stays up — off the book, still authoritative — until an
+        operator (or a later book) resolves it."""
+        from byteps_tpu.common import logging as bpslog
+
+        total_moved = 0
+        for attempt in range(120 if drain else 40):
+            conns: Dict[int, Any] = {}
+            moved = failed = 0
+            try:
+                with self._keys_lock:
+                    keys = sorted(self._keys)
+                for key in keys:
+                    if self._stop.is_set():
+                        return
+                    if self._ownership is not new_map and not drain:
+                        return  # superseded: the newer map's wave owns truth
+                    with self._keys_lock:
+                        ks = self._keys.get(key)
+                    if ks is None:
+                        continue
+                    owner = (self._ownership or new_map).owner(key)
+                    if owner == self.rank:
+                        continue
+                    ok = self._migrate_key(key, ks, owner, new_map.epoch, conns)
+                    if ok:
+                        moved += 1
+                    elif ok is False:
+                        failed += 1
+            finally:
+                for sock in conns.values():
+                    close_socket(sock)
+            total_moved += moved
+            self._update_owned_gauge()
+            if moved or failed:
+                bpslog.warning(
+                    "server rank=%s migration wave (epoch %d): "
+                    "moved=%d failed=%d",
+                    self.rank, new_map.epoch, moved, failed,
+                )
+            if not failed:
+                break
+            # retry: the destination was unreachable (still coming up,
+            # or itself mid-rebuild) — back off and re-ship
+            if self._stop.wait(min(2.0, 0.25 * (attempt + 1))):
+                return
+        if drain and not self._stop.is_set():
+            if failed:
+                bpslog.warning(
+                    "server rank=%s drain INCOMPLETE (%d keys stuck) — "
+                    "staying up to preserve their state",
+                    self.rank, failed,
+                )
+                return
+            bpslog.warning(
+                "server rank=%s drained (%d keys shipped) — stopping",
+                self.rank, total_moved,
+            )
+            self.stop()
+
+    def _migrate_key(self, key: int, ks: _KeyState, owner: int,
+                     epoch: int, conns: Dict[int, Any]):
+        """Ship ONE key's authoritative state to ``owner``.  Returns True
+        (moved), False (failed — this server stays authoritative), or
+        None (nothing to ship).  The snapshot and the redirect tombstone
+        are taken in one lock section, so every push either lands before
+        the snapshot (and ships inside it) or redirects after — no sum is
+        ever lost in the window."""
+        import struct as _struct
+
+        from byteps_tpu.comm.transport import encode_migrate_state
+        from byteps_tpu.core.telemetry import counters, metrics
+
+        addr = self._peer_addrs.get(owner)
+        with ks.lock:
+            if ks.migrated_to is not None:
+                return None  # already shipped by an earlier wave
+            pend, ks.pending_pulls = ks.pending_pulls, []
+            fusedw, ks.fused_waiters = ks.fused_waiters, []
+            initw, ks.init_waiters = ks.init_waiters, []
+            if ks.store is None:
+                # no state to ship (key never completed an init barrier
+                # here) — just strand-proof the parked waiters: their
+                # workers chase to the new owner and init THERE
+                self._redirect_waiters(key, epoch, owner, pend, fusedw, initw)
+                return None
+            if addr is None:
+                ks.pending_pulls, ks.fused_waiters, ks.init_waiters = (
+                    pend, fusedw, initw
+                )
+                counters().bump("migration_failed")
+                return False
+            meta = {
+                "key": int(key),
+                "epoch": int(epoch),
+                "dtype": str(ks.dtype),
+                "store_version": int(ks.store_version),
+                "recv_count": int(ks.recv_count),
+                "pushed_total": int(ks.pushed_total),
+                "push_seen": {str(w): int(v) for w, v in ks.push_seen.items()},
+                "init_done": {str(w): int(v) for w, v in ks.init_done.items()},
+                "compressor_kwargs": dict(ks.compressor_kwargs),
+            }
+            store_b = ks.store.tobytes()
+            accum_b = ks.accum.tobytes() if ks.recv_count else b""
+            meta["store_nbytes"] = len(store_b)
+            meta["accum_nbytes"] = len(accum_b)
+            # tombstone BEFORE the wire hop: requests from here on get
+            # WRONG_OWNER, so no push can mutate state already serialized
+            ks.migrated_to = owner
+            ks.migrate_epoch = epoch
+        # parked waiters chase to the new owner like any stale-map request
+        self._redirect_waiters(key, epoch, owner, pend, fusedw, initw)
+        t0 = time.time()
+        ok = False
+        try:
+            sock = conns.get(owner)
+            if sock is None:
+                sock = connect(addr[0], addr[1],
+                               timeout=self.cfg.migrate_deadline_s)
+                sock.settimeout(max(1.0, self.cfg.migrate_deadline_s))
+                conns[owner] = sock
+            send_message(sock, Message(
+                Op.MIGRATE_STATE, key=key, version=epoch,
+                payload=encode_migrate_state(meta, store_b, accum_b),
+            ))
+            resp = recv_message(sock)
+            # status 3 = "already authoritative at destination" (an
+            # earlier attempt landed but its ack was lost, or the key
+            # was re-created there): the key is home — drop our copy
+            ok = resp.op == Op.MIGRATE_STATE and resp.status in (0, 3)
+        except (ConnectionError, OSError, ValueError, _struct.error) as e:
+            from byteps_tpu.common import logging as bpslog
+
+            bpslog.warning(
+                "server rank=%s: shipping key %d to rank %s failed: %s",
+                self.rank, key, owner, e,
+            )
+            sock = conns.pop(owner, None)
+            close_socket(sock)
+        if not ok:
+            # roll back: this server stays authoritative (workers that
+            # already chased will bounce back through their retry path);
+            # a later wave re-attempts the shipment
+            with ks.lock:
+                ks.migrated_to = None
+            counters().bump("migration_failed")
+            return False
+        with ks.lock:
+            # keep the tombstone, free the bulk
+            ks.store = None
+            ks.accum = None
+            ks.push_seen = {}
+            ks.init_done = {}
+            ks.pull_payload = None
+            ks.pull_version = -1
+            ks.raw_payload = None
+            ks.raw_version = -1
+            ks.compressor = None
+        counters().bump("migration_keys_moved")
+        metrics().observe("migration_key_seconds", time.time() - t0)
+        return True
+
+    def _redirect_waiters(self, key: int, epoch: int, owner: int,
+                          pending_pulls=(), fused_waiters=(),
+                          init_waiters=()) -> None:
+        """Answer parked requests of a migrating key with WRONG_OWNER so
+        their workers chase to the new owner instead of waiting on state
+        that just left this server."""
+        from byteps_tpu.comm.transport import encode_wrong_owner
+
+        payload = encode_wrong_owner(epoch, owner)
+        for _v, pconn, plock, pseq, _c, _rs in pending_pulls:
+            try:
+                send_message(pconn, Message(
+                    Op.WRONG_OWNER, key=key, seq=pseq, version=epoch,
+                    payload=payload,
+                ), plock)
+            except (ConnectionError, OSError):
+                continue
+        seen: set = set()
+        for _v, reply, _slot, _c in fused_waiters:
+            if id(reply) in seen:
+                continue
+            seen.add(id(reply))
+            if reply.abort():
+                try:
+                    send_message(reply.conn, Message(
+                        Op.WRONG_OWNER, key=reply.route_key, seq=reply.seq,
+                        version=epoch, payload=payload,
+                    ), reply.send_lock)
+                except (ConnectionError, OSError):
+                    pass
+        for _wid, wconn, wlock, wseq, _tok in init_waiters:
+            try:
+                send_message(wconn, Message(
+                    Op.WRONG_OWNER, key=key, seq=wseq, version=epoch,
+                    payload=payload,
+                ), wlock)
+            except (ConnectionError, OSError):
+                continue
+
+    def _redirect_locked(self, key: int, ks: Optional[_KeyState]):
+        """(epoch, owner) when this server must redirect a request for
+        ``key``, else None.  Caller holds ``ks.lock`` (the check must be
+        atomic with the summation it gates — the migration wave takes the
+        same lock for its snapshot+tombstone).
+
+        A key this server still HOLDS serves normally even when the new
+        map re-homes it (the pre-ship window): the wave's snapshot will
+        carry those sums.  Redirects fire for shipped keys (tombstone)
+        and for keys this server never held under a map that homes them
+        elsewhere (a stale-map worker)."""
+        if not self.reshard:
+            return None
+        if ks is not None and ks.migrated_to is not None:
+            return (ks.migrate_epoch, ks.migrated_to)
+        omap = self._ownership
+        if omap is None or self.rank is None:
+            return None
+        owner = omap.owner(key)
+        if owner == self.rank:
+            return None
+        if ks is not None and ks.store is not None:
+            return None  # pre-ship window: still authoritative
+        return (omap.epoch, owner)
+
+    def _send_wrong_owner(self, conn, send_lock, msg: Message, ro) -> None:
+        from byteps_tpu.comm.transport import encode_wrong_owner
+        from byteps_tpu.core.telemetry import counters
+
+        epoch, owner = ro
+        counters().bump("wrong_owner_served")
+        send_message(conn, Message(
+            Op.WRONG_OWNER, key=msg.key, seq=msg.seq, version=epoch,
+            payload=encode_wrong_owner(epoch, owner),
+        ), send_lock)
+
+    def _should_park(self, key: int) -> bool:
+        """True when a request for an uninitialized key should PARK: the
+        current map homes the key here and its previous owner is alive,
+        so a migration is (or will be) inbound.  False when the previous
+        owner was evicted — nothing will ever arrive, and the worker's
+        re-init path must own the key's rebirth."""
+        if not self.reshard or self.rank is None:
+            return False
+        omap = self._ownership
+        if omap is None or omap.owner(key) != self.rank:
+            return False
+        prev = self._prev_ownership
+        if prev is not None:
+            old = prev.owner(key)
+            if old != self.rank and old not in omap.ranks:
+                return False  # old owner crashed out: state is gone
+        return True
+
+    def _park_awaiting(self, key: int, msg: Message, conn, send_lock) -> None:
+        """Park one request until the key's migration lands (re-enqueued
+        by _handle_migrate) or BYTEPS_MIGRATE_DEADLINE_S expires (the
+        sweeper drops the connection back to the worker's retry path)."""
+        with self._awaiting_lock:
+            self._awaiting.setdefault(key, []).append(
+                (time.monotonic(), msg, conn, send_lock)
+            )
+            if self._awaiting_sweeper is None:
+                t = threading.Thread(
+                    target=self._awaiting_sweep_loop,
+                    name="ps-migrate-park", daemon=True,
+                )
+                self._awaiting_sweeper = t
+                t.start()
+
+    def _awaiting_sweep_loop(self) -> None:
+        while not self._stop.wait(0.25):
+            cutoff = time.monotonic() - max(0.5, self.cfg.migrate_deadline_s)
+            doomed: List = []
+            with self._awaiting_lock:
+                for key in list(self._awaiting):
+                    keep = []
+                    for entry in self._awaiting[key]:
+                        (doomed if entry[0] < cutoff else keep).append(entry)
+                    if keep:
+                        self._awaiting[key] = keep
+                    else:
+                        del self._awaiting[key]
+            for _t, _msg, conn, _sl in doomed:
+                # migration never landed: hand the request back to the
+                # worker's retry/heal path via a dropped connection
+                close_socket(conn)
+
+    def _handle_migrate(self, msg: Message, conn, send_lock) -> None:
+        """Op.MIGRATE_STATE: install one key's authoritative state from
+        its old owner, ack, and wake any requests parked on the key.
+        Idempotent under sender retry (a same-epoch duplicate with an
+        older store_version acks without clobbering newer local state),
+        and ordered by MIGRATION EPOCH across events: a newer-epoch
+        shipment installs over tombstoned remains — store_version
+        counters are NOT comparable across init generations (a key
+        re-created from scratch restarts its numbering), so cross-event
+        ordering rides the epoch, while an older-epoch straggler never
+        clobbers newer state or clears a newer tombstone.  A key that
+        is already LIVE here is refused-as-complete (status 3) — see
+        the inline comment."""
+        import struct as _struct
+
+        from byteps_tpu.comm.transport import decode_migrate_state
+        from byteps_tpu.core.telemetry import counters
+
+        if not self.reshard:
+            send_message(conn, Message(
+                Op.MIGRATE_STATE, key=msg.key, seq=msg.seq, status=1,
+            ), send_lock)
+            return
+        try:
+            meta, store_b, accum_b = decode_migrate_state(msg.payload)
+            key = int(meta["key"])
+            epoch = int(meta.get("epoch", msg.version))
+            dtype = np.dtype(str(meta["dtype"]))
+            store_version = int(meta.get("store_version", 0))
+        except (KeyError, ValueError, TypeError, UnicodeDecodeError,
+                _struct.error):
+            close_socket(conn)  # malformed control frame: drop, like resync
+            return
+        omap = self._ownership
+        if (omap is not None and self.rank is not None
+                and omap.epoch > epoch and omap.owner(key) != self.rank):
+            # the sender's map is OLDER than ours and the key belongs
+            # elsewhere under the current one: refuse — the sender's next
+            # wave (it will adopt our epoch's book too) re-ships it to
+            # the right owner, instead of us installing state we would
+            # immediately have to forward
+            send_message(conn, Message(
+                Op.MIGRATE_STATE, key=key, seq=msg.seq, status=2,
+            ), send_lock)
+            return
+        ks = self._key_state(key)
+        already_home = False
+        with ks.lock:
+            if ks.store is not None and ks.migrated_to is None:
+                # the key is already LIVE here.  In every in-order
+                # migration the receiver holds nothing or a tombstone —
+                # live state means this shipment is a duplicate (the
+                # first attempt landed but its ack was lost/slow), a
+                # late chaos-delayed frame, or a stale copy trying to
+                # resurrect itself over a key the degraded fallback
+                # re-created here (whose version numbering restarted, so
+                # store_version comparisons against it are meaningless —
+                # installing would serve stale rounds to every pull).
+                # Refuse-as-complete: status 3 tells the sender the key
+                # is home — drop your copy, keep your tombstone.
+                already_home = True
+            else:
+                self._install_migrated_locked(
+                    ks, epoch, dtype, store_version, meta, store_b, accum_b
+                )
+        if already_home:
+            send_message(conn, Message(
+                Op.MIGRATE_STATE, key=key, seq=msg.seq, status=3,
+            ), send_lock)
+            return
+        counters().bump("migration_keys_received")
+        send_message(conn, Message(
+            Op.MIGRATE_STATE, key=key, seq=msg.seq,
+        ), send_lock)
+        with self._awaiting_lock:
+            parked = self._awaiting.pop(key, [])
+        for _t, m, c, sl in parked:
+            self._enqueue(m, c, sl)
+        self._update_owned_gauge()
+
+    def _install_migrated_locked(self, ks: _KeyState, epoch: int, dtype,
+                                 store_version: int, meta: dict,
+                                 store_b: bytes, accum_b: bytes) -> None:
+        """Install one migrated key state under ``ks.lock`` (split out of
+        :meth:`_handle_migrate` so the reply never rides inside the key
+        lock).  Ordering rules in the caller's docstring."""
+        prev_epoch = ks.migrate_epoch
+        if epoch < prev_epoch:
+            # straggling duplicate of an OLDER migration event: ack (the
+            # sender's retry completes) but leave newer local state —
+            # and any newer tombstone — untouched
+            return
+        ks.migrated_to = None  # the key lives here now
+        ks.migrate_epoch = epoch
+        if (ks.store is None or epoch > prev_epoch
+                or store_version >= ks.store_version):
+            ks.dtype = dtype
+            store = np.frombuffer(store_b, dtype=dtype).copy()
+            ks.store = store
+            ks.accum = (
+                np.frombuffer(accum_b, dtype=dtype).copy()
+                if accum_b else np.zeros_like(store)
+            )
+            ks.store_version = store_version
+            ks.recv_count = int(meta.get("recv_count", 0))
+            ks.pushed_total = int(meta.get("pushed_total", 0))
+            ks.push_seen = {
+                int(w): int(v)
+                for w, v in (meta.get("push_seen") or {}).items()
+            }
+            ks.init_done = {
+                int(w): int(v)
+                for w, v in (meta.get("init_done") or {}).items()
+            }
+            ks.compressor_kwargs = {
+                str(k): str(v)
+                for k, v in (meta.get("compressor_kwargs") or {}).items()
+            }
+            ks.compressor = None
+            if ks.compressor_kwargs:
+                from byteps_tpu.compression.registry import create_compressor
+
+                ks.compressor = create_compressor(
+                    ks.compressor_kwargs, store.size, server=True
+                )
+                _apply_lr_to_chain(ks.compressor, self._ef_lr)
+            ks.pull_payload = None
+            ks.pull_version = -1
+            ks.raw_payload = None
+            ks.raw_version = -1
+
+    def _update_owned_gauge(self) -> None:
+        """``server_owned_keys`` / ``server_map_epoch`` gauges, labeled
+        by rank — heartbeat deltas carry them to the scheduler aggregate
+        so tools/bps_top.py can watch a migration settle."""
+        if not self.reshard or self.rank is None:
+            return
+        from byteps_tpu.core.telemetry import metrics
+
+        with self._keys_lock:
+            states = list(self._keys.values())
+        n = sum(
+            1 for ks in states
+            if ks.store is not None and ks.migrated_to is None
+        )
+        labels = {"rank": str(self.rank)}
+        metrics().gauge_set("server_owned_keys", n, labels=labels)
+        omap = self._ownership
+        if omap is not None:
+            metrics().gauge_set("server_map_epoch", omap.epoch, labels=labels)
+
     # --- connection plane ------------------------------------------------
 
     def _accept_loop(self) -> None:
@@ -496,6 +1044,11 @@ class PSServer:
                     # a read-mostly snapshot of the exactly-once ledger,
                     # and the asking worker is stalled on it
                     self._handle_resync(msg, conn, send_lock)
+                elif msg.op == Op.MIGRATE_STATE:
+                    # resharding plane: a peer server ships one key's
+                    # authoritative state — installed inline (the sender
+                    # blocks on the ack, and parked requests wake here)
+                    self._handle_migrate(msg, conn, send_lock)
                 elif msg.op == Op.REGISTER_COMPRESSOR and msg.flags & 1:
                     # lr update for every EF chain (flag bit 0; payload =
                     # big-endian f64) — the wire replacement for the
@@ -621,8 +1174,11 @@ class PSServer:
         ks = self._key_state(msg.key)
         wid = msg.flags
         token = msg.version
+        created = False
         with ks.lock:
-            if ks.store is None:
+            redirect = self._redirect_locked(msg.key, ks)
+            if redirect is None and ks.store is None:
+                created = True
                 dtype = to_numpy_dtype(DataType(dtype_id))
                 ks.dtype = dtype
                 ks.store = np.zeros(n, dtype=dtype)
@@ -633,7 +1189,10 @@ class PSServer:
             # record.  Parking it would strand the worker: its peers were
             # released and will never re-init this key, so the barrier
             # stays short until the retry budget dies.
-            if wid and token and ks.init_done.get(wid) == token:
+            if redirect is not None:
+                replay_ack = False
+                waiters = None
+            elif wid and token and ks.init_done.get(wid) == token:
                 from byteps_tpu.core.telemetry import counters
 
                 counters().bump("init_replay_ack")
@@ -656,6 +1215,13 @@ class PSServer:
                 else:
                     ks.init_waiters.append(entry)
                 waiters = self._complete_init_barrier_locked(ks)
+        if redirect is not None:
+            # the map homes this key elsewhere: the worker's init chases
+            # to the new owner (state, if any, migrated there)
+            self._send_wrong_owner(conn, send_lock, msg, redirect)
+            return
+        if created:
+            self._update_owned_gauge()
         if replay_ack:
             send_message(
                 conn, Message(Op.INIT, key=msg.key, seq=msg.seq), send_lock
@@ -862,13 +1428,22 @@ class PSServer:
         dedupe = False
         published = 0.0
         with ks.lock:
-            if ks.store is None:
+            redirect = self._redirect_locked(msg.key, ks)
+            if redirect is None and ks.store is None:
+                if self._should_park(msg.key):
+                    # migration inbound: hold the push until the state
+                    # lands (re-enqueued by _handle_migrate), bounded by
+                    # the park sweeper's deadline
+                    self._park_awaiting(msg.key, msg, conn, send_lock)
+                    return
                 # RuntimeError (not ConnectionError): the engine loop's
                 # generic handler DROPS the connection so the worker errors
                 # out instead of waiting forever for an ack (matches the
                 # native server's return-false-drop)
                 raise RuntimeError(f"push for uninitialized key {msg.key}")
-            if self._is_replayed_push_locked(ks, msg):
+            if redirect is not None:
+                pass  # replied below, outside the lock
+            elif self._is_replayed_push_locked(ks, msg):
                 dedupe = True  # ack-only (below): the original was summed
             else:
                 self._sum_push_locked(ks, msg, compressed, arr)
@@ -877,6 +1452,9 @@ class PSServer:
                     p0 = time.time()
                     flush.extend(self._publish_round_locked(ks, compressed))
                     published = time.time() - p0
+        if redirect is not None:
+            self._send_wrong_owner(conn, send_lock, msg, redirect)
+            return
         t_summed = time.time()
         sum_dur = (t_summed - t_start) - published
         metrics().observe("server_sum_seconds", max(0.0, sum_dur))
@@ -953,32 +1531,59 @@ class PSServer:
             flush: List = []
             dedupe = False
             published = 0.0
+            park = False
             t_m0 = time.time()
             with ks.lock:
-                if ks.store is None:
-                    raise RuntimeError(f"push for uninitialized key {key}")
-                if self._is_replayed_push_locked(ks, sub):
-                    dedupe = True
-                else:
-                    self._sum_push_locked(ks, sub, compressed, arr)
-                    if (not self.cfg.enable_async
-                            and ks.recv_count >= self.num_workers):
-                        p0 = time.time()
-                        flush.extend(
-                            self._publish_round_locked(ks, compressed)
+                redirect = self._redirect_locked(key, ks)
+                if redirect is None and ks.store is None:
+                    if self._should_park(key):
+                        park = True
+                    else:
+                        raise RuntimeError(
+                            f"push for uninitialized key {key}"
                         )
-                        published = time.time() - p0
-                # this member's pull half: answered now if its round is
-                # published (async mode always is), else parked on the key
-                if self.cfg.enable_async or version <= ks.store_version:
-                    if reply.fill(
-                        slot,
-                        ks.wire_payload(compressed, self.cfg.enable_async),
-                        ks.store_version,
-                    ):
-                        flush.append(reply)
-                else:
-                    ks.fused_waiters.append((version, reply, slot, compressed))
+                if redirect is None and not park:
+                    if self._is_replayed_push_locked(ks, sub):
+                        dedupe = True
+                    else:
+                        self._sum_push_locked(ks, sub, compressed, arr)
+                        if (not self.cfg.enable_async
+                                and ks.recv_count >= self.num_workers):
+                            p0 = time.time()
+                            flush.extend(
+                                self._publish_round_locked(ks, compressed)
+                            )
+                            published = time.time() - p0
+                    # this member's pull half: answered now if its round is
+                    # published (async mode always is), else parked on the key
+                    if self.cfg.enable_async or version <= ks.store_version:
+                        if reply.fill(
+                            slot,
+                            ks.wire_payload(compressed, self.cfg.enable_async),
+                            ks.store_version,
+                        ):
+                            flush.append(reply)
+                    else:
+                        ks.fused_waiters.append(
+                            (version, reply, slot, compressed)
+                        )
+            if redirect is not None or park:
+                # abandon the FRAME: members already summed are in the
+                # exactly-once ledger, so the worker's unfuse-fallback
+                # replay (or the frame's later re-enqueue) re-sums
+                # nothing — the handoff stays exactly-once per member.
+                # abort() fences the reply so fused_waiters parked by
+                # earlier members can never answer the resolved seq —
+                # and only the abort WINNER answers it out of band (the
+                # migration wave's _redirect_waiters races this path for
+                # the same frame; a loser sending too would put two
+                # responses on one seq and corrupt the client's demux).
+                if reply.abort():
+                    if redirect is not None:
+                        self._send_wrong_owner(conn, send_lock, msg, redirect)
+                    else:
+                        self._park_awaiting(key, msg, conn, send_lock)
+                return
             t_m1 = time.time()
             sum_dur = max(0.0, (t_m1 - t_m0) - published)
             metrics().observe("server_sum_seconds", sum_dur)
@@ -1013,46 +1618,62 @@ class PSServer:
         every worker aggregate to zero for that round."""
         flush: List = []
         with ks.lock:
-            if ks.store is None:
+            redirect = self._redirect_locked(msg.key, ks)
+            if redirect is None and ks.store is None:
+                if self._should_park(msg.key):
+                    self._park_awaiting(msg.key, msg, conn, send_lock)
+                    return
                 raise RuntimeError(f"push for uninitialized key {msg.key}")
-            nrows, row_len, idx, vals = self._parse_rowsparse(
-                msg.payload, ks.dtype, with_values=True
-            )
-            if row_len == 0 or ks.store.size % row_len:
-                raise RuntimeError(
-                    f"rowsparse row_len {row_len} does not divide "
-                    f"store size {ks.store.size} (key {msg.key})"
-                )
-            total_rows = ks.store.size // row_len
-            if nrows and int(idx.max()) >= total_rows:
-                raise RuntimeError(
-                    f"rowsparse index {int(idx.max())} >= {total_rows} rows"
-                )
-            if self._is_replayed_push_locked(ks, msg):
-                pass  # ack-only: the original scatter-sum already landed
-            elif self.cfg.enable_async:
-                # async parameter store: scatter deltas in place
-                np.add.at(ks.store.reshape(total_rows, row_len), idx, vals)
-                ks.store_version += 1
-                ks.pushed_total += 1
-                self._record_push_locked(ks, msg)
+            if redirect is not None:
+                pass  # replied below, outside the lock
             else:
-                if ks.recv_count == 0:
-                    # sparse COPY_FIRST: rows this worker does NOT touch
-                    # must start the round at zero, not last round's sum
-                    ks.accum[:] = 0
-                # np.add.at accumulates duplicate indices correctly
-                np.add.at(ks.accum.reshape(total_rows, row_len), idx, vals)
-                ks.recv_count += 1
-                ks.pushed_total += 1
-                self._record_push_locked(ks, msg)
-                if ks.recv_count >= self.num_workers:
-                    flush.extend(self._publish_round_locked(ks, False))
+                self._sum_rowsparse_locked(ks, msg, flush)
+        if redirect is not None:
+            self._send_wrong_owner(conn, send_lock, msg, redirect)
+            return
         send_message(
             conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version),
             send_lock,
         )
         self._flush_pulls(msg.key, flush)
+
+    def _sum_rowsparse_locked(self, ks, msg: Message, flush: List) -> None:
+        """One row-sparse push's summation under ``ks.lock`` (split out of
+        :meth:`_handle_push_rowsparse` so the resharding redirect check
+        can gate it like the dense path)."""
+        nrows, row_len, idx, vals = self._parse_rowsparse(
+            msg.payload, ks.dtype, with_values=True
+        )
+        if row_len == 0 or ks.store.size % row_len:
+            raise RuntimeError(
+                f"rowsparse row_len {row_len} does not divide "
+                f"store size {ks.store.size} (key {msg.key})"
+            )
+        total_rows = ks.store.size // row_len
+        if nrows and int(idx.max()) >= total_rows:
+            raise RuntimeError(
+                f"rowsparse index {int(idx.max())} >= {total_rows} rows"
+            )
+        if self._is_replayed_push_locked(ks, msg):
+            pass  # ack-only: the original scatter-sum already landed
+        elif self.cfg.enable_async:
+            # async parameter store: scatter deltas in place
+            np.add.at(ks.store.reshape(total_rows, row_len), idx, vals)
+            ks.store_version += 1
+            ks.pushed_total += 1
+            self._record_push_locked(ks, msg)
+        else:
+            if ks.recv_count == 0:
+                # sparse COPY_FIRST: rows this worker does NOT touch
+                # must start the round at zero, not last round's sum
+                ks.accum[:] = 0
+            # np.add.at accumulates duplicate indices correctly
+            np.add.at(ks.accum.reshape(total_rows, row_len), idx, vals)
+            ks.recv_count += 1
+            ks.pushed_total += 1
+            self._record_push_locked(ks, msg)
+            if ks.recv_count >= self.num_workers:
+                flush.extend(self._publish_round_locked(ks, False))
 
     def _rowsparse_gather(self, ks: "_KeyState", req_payload: bytes) -> bytes:
         """Serve an RS pull: gather the requested rows from the store."""
@@ -1196,10 +1817,21 @@ class PSServer:
             self._child_span(msg.trace, msg.key, "recv", t_enq,
                              t_start - t_enq)
         with ks.lock:
-            if ks.store is None:
+            redirect = self._redirect_locked(msg.key, ks)
+            if redirect is None and ks.store is None:
+                if self._should_park(msg.key):
+                    self._park_awaiting(msg.key, msg, conn, send_lock)
+                    return
                 raise RuntimeError(f"pull for uninitialized key {msg.key}")
-            ready = self.cfg.enable_async or msg.version <= ks.store_version
-            if ready:
+            if redirect is not None:
+                ready = False  # replied below (never parked on this key)
+            else:
+                ready = (
+                    self.cfg.enable_async or msg.version <= ks.store_version
+                )
+            if redirect is not None:
+                pass
+            elif ready:
                 payload = (
                     self._rowsparse_gather(ks, msg.payload)
                     if rowsparse
@@ -1215,6 +1847,9 @@ class PSServer:
                      msg.payload if rowsparse else None)
                 )
                 return
+        if redirect is not None:
+            self._send_wrong_owner(conn, send_lock, msg, redirect)
+            return
         t_ready = time.time()
         send_message(
             conn, Message(Op.PULL, key=msg.key, payload=payload, seq=msg.seq, version=ver), send_lock
@@ -1472,6 +2107,50 @@ class NativePSServer:
         arr = (_ct.c_uint8 * max(1, len(flags)))(*sorted(flags))
         self._lib.bps_native_server_set_live_workers(
             self._id, arr, len(flags)
+        )
+
+    def _adopt_book(self, book: dict) -> None:
+        """Ship a book's ownership map into the C++ engine (docs/
+        robustness.md "migration flow"): the ring's sorted (point, rank)
+        arrays plus this server's rank and the map epoch.  The engine
+        then answers WRONG_OWNER for keys the map homes elsewhere — the
+        split-brain guard for map-epoch skew — but it cannot export or
+        import key state, so a drain book (scale-down) is REFUSED loudly:
+        stopping would lose every held key, and elastically resharded
+        fleets should run Python-engine servers (ROADMAP)."""
+        if not self.cfg.elastic_reshard or self.rank is None:
+            return
+        epoch = book.get("map_epoch")
+        ranks = book.get("server_ranks")
+        if epoch is None or not ranks:
+            return
+        from byteps_tpu.common import logging as bpslog
+
+        if book.get("drain"):
+            bpslog.warning(
+                "native server rank=%s received a DRAIN book but cannot "
+                "migrate state — staying up to preserve it (use "
+                "Python-engine servers with BYTEPS_ELASTIC_RESHARD)",
+                self.rank,
+            )
+            return
+        if not hasattr(self._lib, "bps_native_server_set_ownership"):
+            bpslog.warning(
+                "native lib predates the resharding plane; ownership "
+                "map not adopted (rebuild byteps_tpu/native)"
+            )
+            return
+        import ctypes as _ct
+
+        from byteps_tpu.common.hashing import HashRing
+
+        pts = HashRing(ranks, vnodes=self.cfg.ring_vnodes).points()
+        n = len(pts)
+        hashes = (_ct.c_uint64 * n)(*[h for h, _ in pts])
+        rks = (_ct.c_int32 * n)(*[r for _, r in pts])
+        self._lib.bps_native_server_set_ownership(
+            self._id, int(self.rank), int(epoch) & 0xFFFFFFFF, n,
+            hashes, rks,
         )
 
     def start(self, register: bool = True) -> None:

@@ -53,6 +53,9 @@ _REQUIRED_SYMBOLS = (
     # the live key→stripe mapping shim (also marks the 56-byte SpanRec)
     "bps_native_server_stripe_queue_depths",
     "bps_wire_key_stripe",
+    # elastic resharding plane (ISSUE 8): ownership map adoption (the
+    # engine's WRONG_OWNER redirect feed)
+    "bps_native_server_set_ownership",
 )
 
 

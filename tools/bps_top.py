@@ -110,6 +110,35 @@ def render(url: str, cur: Sample, prev: Sample, dt: float) -> str:
             for srv, i, v in sorted(depths)
         )
         lines.append(f"  stripe queue depth   : {cells}")
+    # elastic resharding ownership (docs/robustness.md "migration flow"):
+    # the scheduler aggregate carries the cluster map epoch plus each
+    # server's heartbeat-shipped owned-key count and adopted epoch, so a
+    # migration is watchable as keys draining from one rank's cell into
+    # another's; a rank still on an older epoch is marked with '*'.
+    map_epoch = None
+    owned: Dict[int, float] = {}
+    srv_epoch: Dict[int, float] = {}
+    for (name, lbl), v in cur.items():
+        if name == "byteps_cluster_map_epoch":
+            map_epoch = int(v)
+        elif name in ("byteps_server_owned_keys", "byteps_server_map_epoch"):
+            rm = re.search(r'rank="(-?\d+)"', lbl)
+            if rm is None:
+                continue
+            dst = owned if name.endswith("owned_keys") else srv_epoch
+            dst[int(rm.group(1))] = v
+    if map_epoch is not None or owned:
+        cells = " ".join(
+            f"r{r}={int(v)}"
+            + ("*" if map_epoch is not None
+               and srv_epoch.get(r, map_epoch) < map_epoch else "")
+            for r, v in sorted(owned.items())
+        )
+        head = f"epoch {map_epoch}" if map_epoch is not None else "epoch ?"
+        lines.append(
+            f"  ownership map        : {head}"
+            + (f" | owned keys {cells}" if cells else "")
+        )
     # latency families
     rows = _histo_rows(cur)
     if rows:

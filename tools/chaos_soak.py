@@ -54,6 +54,7 @@ def run_soak(
     crash_at: int = -1,
     dim: int = 1024,
     one_sided: bool = False,
+    reshard: bool = False,
 ) -> dict:
     """Run the soak in-process; returns a result dict (raises on any
     invariant violation).  Env mutations are process-wide — run via the
@@ -71,6 +72,9 @@ def run_soak(
     if one_sided and servers < 2:
         raise ValueError("--one-sided needs --servers >= 2 (one victim, "
                          "one healthy control)")
+    if reshard and servers < 2:
+        raise ValueError("--reshard needs --servers >= 2 (keys must have "
+                         "somewhere to migrate)")
     os.environ.update(
         {
             "BYTEPS_VAN": "chaos:tcp",
@@ -95,6 +99,9 @@ def run_soak(
             "BYTEPS_HEARTBEAT_INTERVAL": "0.1",
             "BYTEPS_DEAD_NODE_TIMEOUT_S": "0.8",
             "BYTEPS_FORCE_DISTRIBUTED": "1",
+            # live migration instead of re-init barriers on server-set
+            # changes (docs/robustness.md "migration flow")
+            "BYTEPS_ELASTIC_RESHARD": "1" if reshard else "0",
             "DMLC_NUM_WORKER": "1",
             "DMLC_NUM_SERVER": str(servers),
             "DMLC_PS_ROOT_URI": "127.0.0.1",
@@ -148,31 +155,84 @@ def run_soak(
         os.environ["BYTEPS_CHAOS_DROP"] = str(max(drop, 0.4))
         reset_fault_budget()  # re-read BYTEPS_CHAOS_FAULT_BUDGET lazily
 
+    import time as _time
+
     import byteps_tpu as bps
 
     rng = np.random.default_rng(seed)
-    w = rng.standard_normal(dim).astype(np.float32)
-    loss0 = float(w @ w)
+    # --reshard trains several NAMED shards so the consistent-hash ring
+    # re-homes a real subset of keys on every server-set change (one
+    # tensor = one key could land on an unmoved ring segment)
+    n_shards = 8 if reshard else 1
+    sdim = max(4, dim // n_shards)
+    ws = [rng.standard_normal(sdim).astype(np.float32)
+          for _ in range(n_shards)]
+    loss0 = float(sum(w @ w for w in ws))
     lr = 0.05
+    up_at, down_at = max(1, steps // 3), max(2, (2 * steps) // 3)
+    extra = None
+    drained_ok = True
     try:
         bps.init()
+        client = None
+        if reshard:
+            from byteps_tpu.core.state import get_state
+
+            client = get_state().engine.client
         for step in range(steps):
-            grad = 2.0 * w  # d/dw ||w||²
-            agg = np.asarray(
-                bps.push_pull(grad, name="chaos_soak.w", average=True)
-            )
-            # 1 worker ⇒ the averaged sum IS the gradient, bitwise; a
-            # double-summed replay or dropped contribution breaks this
-            np.testing.assert_array_equal(agg, grad)
-            w = w - lr * agg
+            for i in range(n_shards):
+                grad = 2.0 * ws[i]  # d/dw ||w||²
+                agg = np.asarray(
+                    bps.push_pull(grad, name=f"chaos_soak.w{i}", average=True)
+                )
+                # 1 worker ⇒ the averaged sum IS the gradient, bitwise; a
+                # double-summed replay or dropped contribution breaks this
+                np.testing.assert_array_equal(agg, grad)
+                ws[i] = ws[i] - lr * agg
             if step == crash_at and servers > 1:
                 fleet[-1].stop()  # involuntary: eviction must heal it
-        loss1 = float(w @ w)
+            if reshard and step == up_at:
+                # live scale-UP: declare the bigger topology from the
+                # live worker (the scheduler parks the reply until the
+                # joiner registers), then start the joiner — old owners
+                # migrate each re-homed key's state, NO re-init barrier
+                os.environ["DMLC_NUM_SERVER"] = str(servers + 1)
+                rt = threading.Thread(
+                    target=client.request_resize,
+                    kwargs={"num_servers": servers + 1}, daemon=True,
+                )
+                rt.start()
+                deadline = _time.monotonic() + 10
+                while _time.monotonic() < deadline:
+                    with sched._lock:
+                        if sched.num_servers == servers + 1:
+                            break
+                    _time.sleep(0.05)
+                extra = PSServer(Config.from_env())
+                threading.Thread(target=extra.start, daemon=True).start()
+                rt.join(timeout=30)
+                if rt.is_alive():
+                    raise RuntimeError("scale-up resize never completed")
+            if reshard and step == down_at and extra is not None:
+                # live scale-DOWN: the highest-ranked server (the joiner)
+                # gets a drain book, ships every key out, stops itself
+                client.request_resize(num_servers=servers)
+        if reshard and extra is not None:
+            # the drained joiner must stop ITSELF once its store empties
+            deadline = _time.monotonic() + 15
+            while (not extra._stop.is_set()
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.1)
+            drained_ok = extra._stop.is_set()
+        loss1 = float(sum(w @ w for w in ws))
         snap = bps.get_robustness_counters()
+        resize_gen = getattr(client, "server_generation", 0) if reshard else 0
     finally:
         bps.shutdown()
         for srv in fleet:
             srv.stop()
+        if extra is not None:
+            extra.stop()
         sched.stop()
 
     assert loss1 < loss0, f"loss did not decrease: {loss0} -> {loss1}"
@@ -188,6 +248,20 @@ def run_soak(
         )
     if crash_at >= 0 and servers > 1:
         assert snap.get("server_evicted", 0) >= 1, f"no eviction seen: {snap}"
+    if reshard:
+        # both resizes were LIVE migrations: keys moved between owners
+        # with their ledgers, every pull above stayed bitwise, and the
+        # client never bumped its server generation (no re-init barrier
+        # fired for migrated keys — docs/robustness.md "migration flow")
+        assert snap.get("migration_keys_moved", 0) > 0, (
+            f"reshard schedule moved no keys: {snap}"
+        )
+        assert snap.get("migration_keys_received", 0) > 0, snap
+        assert resize_gen == 0, (
+            f"a re-init barrier fired during live resharding "
+            f"(server_generation={resize_gen})"
+        )
+        assert drained_ok, "drained server never stopped itself"
     return {
         "steps": steps,
         "loss0": loss0,
@@ -212,6 +286,11 @@ def main() -> int:
                     help="target seeded drops at the single worker→owner-"
                          "server connection so the in-place heal (resync "
                          "+ journal replay) is exercised end-to-end")
+    ap.add_argument("--reshard", action="store_true",
+                    help="live elastic resharding rehearsal: add a server "
+                         "mid-run, then remove one — keys migrate with "
+                         "their ledgers (BYTEPS_ELASTIC_RESHARD), every "
+                         "pull stays bitwise, no re-init barrier fires")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="watchdog: the soak must finish within this")
     args = ap.parse_args()
@@ -227,7 +306,7 @@ def main() -> int:
                     drop=args.drop, delay=args.delay,
                     disconnect=args.disconnect, truncate=args.truncate,
                     corrupt=args.corrupt, crash_at=args.crash_at,
-                    one_sided=args.one_sided,
+                    one_sided=args.one_sided, reshard=args.reshard,
                 )
             )
         except BaseException as e:  # noqa: BLE001
