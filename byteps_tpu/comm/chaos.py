@@ -72,6 +72,19 @@ frames stays reproducible per (seed, connection index) regardless of
 surrounding traffic.  Every injected fault bumps a ``chaos_*``
 robustness counter (core/telemetry.py), so tests can assert the
 schedule actually fired.
+
+Scheduler link (docs/robustness.md "Control-plane recovery"): with
+``BYTEPS_CHAOS_SCHED=1`` under a chaos van, the CONTROL plane is
+faulted too — node→scheduler dials wrap their socket
+(:func:`wrap_control`) and the scheduler wraps accepted connections,
+so ``BYTEPS_CHAOS_TARGET_PORT=<scheduler port>`` plus symbolic
+``BYTEPS_CHAOS_OPS`` names (``REGISTER``/``PING``/``ADDRBOOK``/
+``BARRIER``) make scheduler-link faults deterministically injectable.
+Control connections draw from a SEPARATE connection-index counter, so
+arming the flag never shifts the data plane's per-connection RNG
+streams (existing seeded schedules replay unchanged).  Off (default):
+the scheduler link is never faulted and control wire behavior is
+byte-identical to a chaos-less run.
 """
 
 from __future__ import annotations
@@ -90,10 +103,46 @@ from byteps_tpu.comm.van import CHAOS_PREFIX  # single source of the prefix
 _conn_counter = itertools.count()
 _conn_counter_lock = threading.Lock()
 
+#: SEPARATE index stream for control-plane (scheduler) connections:
+#: arming BYTEPS_CHAOS_SCHED must not shift the data-plane sockets'
+#: (seed, index)-keyed RNG streams, or every existing seeded schedule
+#: would silently change.  Offset keeps the two streams' derived seeds
+#: disjoint.
+_ctrl_conn_counter = itertools.count(1 << 16)
+
 
 def _next_conn_index() -> int:
     with _conn_counter_lock:
         return next(_conn_counter)
+
+
+def _next_ctrl_conn_index() -> int:
+    with _conn_counter_lock:
+        return next(_ctrl_conn_counter)
+
+
+def control_chaos_enabled() -> bool:
+    """True when the process opted the scheduler link into fault
+    injection: a chaos van is selected AND ``BYTEPS_CHAOS_SCHED=1``."""
+    return (
+        os.environ.get("BYTEPS_VAN", "").startswith("chaos:")
+        and os.environ.get("BYTEPS_CHAOS_SCHED", "0").lower()
+        not in ("", "0", "false", "no", "off")
+    )
+
+
+def wrap_control(sock, peer_port: int):
+    """Chaos-wrap one control-plane (node→scheduler) socket when
+    :func:`control_chaos_enabled`; pass-through otherwise.  Targeting
+    composes: ``BYTEPS_CHAOS_TARGET_PORT=<scheduler port>`` faults only
+    the scheduler link, and ``BYTEPS_CHAOS_OPS`` can name the control
+    ops (REGISTER/PING/ADDRBOOK/BARRIER)."""
+    if not control_chaos_enabled():
+        return sock
+    return ChaosSocket(
+        sock, ChaosParams.from_env(), _next_ctrl_conn_index(),
+        peer_port=peer_port,
+    )
 
 
 def _env_float(name: str, default: float) -> float:

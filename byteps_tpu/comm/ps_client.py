@@ -441,6 +441,13 @@ class PSClient:
     _ownership = None
     _routing: tuple = ((), (), None)
     _max_chases = 8
+    #: highest scheduler incarnation seen in a book (zombie fence;
+    #: docs/robustness.md "Control-plane recovery")
+    sched_incarnation = 0
+    _sched_reconnecting = False
+    _sched_terminal = False
+    _seen_map_epoch = 0
+    _reconnect_token = 0
 
     def __init__(self, cfg: Config, node_uid: Optional[str] = None) -> None:
         self.cfg = cfg
@@ -456,6 +463,25 @@ class PSClient:
         self._sched_cb_lock = threading.Lock()
         self._sched_seq = 0
         self._sched_dead = False  # set when the scheduler recv loop exits
+        # --- control-plane recovery (docs/robustness.md) ---
+        # scheduler-link loss no longer latches this node dead: the recv
+        # loop's exit hands off to a reconnect state machine that redials
+        # the scheduler address with bounded backoff and re-REGISTERs
+        # (uid + last-known rank + epochs), while the DATA plane keeps
+        # training on the last-adopted book — control_plane_degraded
+        # mode.  _sched_up is set while the link is healthy; _sched_
+        # terminal marks a reconnect give-up (the legacy latch) so
+        # waiters (barrier retries) fail instead of parking forever.
+        self.sched_incarnation = 0
+        self._sched_up = threading.Event()
+        self._sched_terminal = False
+        self._sched_reconnecting = False
+        #: ownership generation of the ACTIVE reconnect machine: under
+        #: repeated link chaos a machine's cleanup can race the next
+        #: machine spawned by the recv loop it itself started — only the
+        #: holder of the current token may clear flags or latch terminal
+        self._reconnect_token = 0
+        self._seen_map_epoch = 0
         self._servers: List[_ServerConn] = []
         self._server_addrs: List[tuple] = []
         #: bumped whenever the server list is rebuilt (elastic server
@@ -547,7 +573,11 @@ class PSClient:
     def connect(self) -> None:
         """Register with the scheduler and connect to every server
         (GetOrInitPS, global.cc:283-297)."""
-        self._sched = connect(self.cfg.ps_root_uri, self.cfg.ps_root_port)
+        from byteps_tpu.comm.transport import connect_control
+
+        self._sched = connect_control(
+            self.cfg.ps_root_uri, self.cfg.ps_root_port
+        )
         send_message(
             self._sched,
             Message(
@@ -576,7 +606,12 @@ class PSClient:
         self.num_workers = book["num_workers"]
         self.num_servers = book["num_servers"]
         self.is_recovery = book.get("is_recovery", False)
+        self._fence_book(book)  # learn the scheduler's incarnation
         self._note_membership(book)
+        self._sched_up.set()
+        # the degraded-state gauge exists from bring-up so bps_top can
+        # count healthy (0) vs degraded (1) nodes in the aggregate
+        metrics().gauge_set("control_plane_degraded", 0)
         self._server_addrs = [tuple(s) for s in book["servers"]]
         for host, port in self._server_addrs:
             self._servers.append(self._new_conn(host, port))
@@ -612,10 +647,13 @@ class PSClient:
         close_socket(self._sched)
         self._servers = []
 
-    def _sched_request(self, msg: Message) -> Message:
+    def _sched_request(self, msg: Message,
+                       timeout: Optional[float] = None) -> Message:
         """Send a scheduler request and wait for its seq-matched response.
         Raises ConnectionError if the scheduler link is dead or dies while
-        waiting."""
+        waiting — or, with ``timeout``, when no response arrives in time
+        (a chaos-dropped control frame would otherwise park the caller
+        forever on a healthy connection; heartbeats pass one)."""
         with self._sched_cb_lock:
             if self._sched_dead:
                 raise ConnectionError("scheduler connection lost")
@@ -626,10 +664,29 @@ class PSClient:
             self._sched_cbs[seq] = (ev, box)
         msg.seq = seq
         send_message(self._sched, msg, self._sched_lock)
-        ev.wait()
+        if not ev.wait(timeout):
+            with self._sched_cb_lock:
+                self._sched_cbs.pop(seq, None)
+            raise ConnectionError("scheduler request timed out")
         if not box:
             raise ConnectionError("scheduler connection lost")
         return box[0]
+
+    def _fence_book(self, book: dict) -> bool:
+        """Incarnation fence (docs/robustness.md "Control-plane
+        recovery"): refuse a book stamped with an OLDER scheduler
+        incarnation than one this node already acted on — a zombie
+        scheduler racing its restarted successor must not roll the
+        topology back (the control-plane twin of the zombie-worker
+        fence).  Adopts a newer incarnation on accept.  Books without
+        the stamp (older schedulers) always pass."""
+        inc = int(book.get("sched_incarnation", 0) or 0)
+        if inc and self.sched_incarnation and inc < self.sched_incarnation:
+            counters().bump("sched_stale_book")
+            return False
+        if inc > self.sched_incarnation:
+            self.sched_incarnation = inc
+        return True
 
     def _note_membership(self, book: dict) -> None:
         """Track the scheduler's membership epoch + cumulative eviction
@@ -637,6 +694,13 @@ class PSClient:
         epoch = book.get("epoch")
         if epoch is not None and epoch > self.membership_epoch:
             self.membership_epoch = epoch
+        # newest map epoch SEEN in any book — tracked independently of
+        # the resharding feature (which only adopts maps when on), so a
+        # rejoin re-REGISTER always reports what this node observed and
+        # a reborn scheduler fences above it
+        me = book.get("map_epoch")
+        if me is not None and int(me) > self._seen_map_epoch:
+            self._seen_map_epoch = int(me)
         ev = book.get("evictions") or {}
         for role, name in (("worker", "worker_evicted"),
                            ("server", "server_evicted")):
@@ -701,6 +765,8 @@ class PSClient:
             err = json.loads(resp.payload.decode()).get("error", "refused")
             raise RuntimeError(f"scheduler refused resize: {err}")
         book = json.loads(resp.payload.decode())
+        if not self._fence_book(book):
+            raise ConnectionError("resize book from a stale scheduler incarnation")
         self.num_workers = book["num_workers"]
         self._note_membership(book)
         with self._sched_cb_lock:
@@ -713,7 +779,32 @@ class PSClient:
         return book
 
     def barrier(self, group: int = GROUP_WORKERS) -> None:
-        self._sched_request(Message(Op.BARRIER, flags=group))
+        """Scheduler barrier.  Rides through a scheduler crash: a wait
+        broken by link loss re-arms against the successor once the
+        reconnect machine rejoins (the restarted scheduler's barrier
+        table starts empty, and every surviving participant re-sends, so
+        pairing stays correct).  Raises ConnectionError only once the
+        reconnect machine has terminally given up."""
+        while True:
+            try:
+                self._sched_request(Message(Op.BARRIER, flags=group))
+                return
+            except ConnectionError:
+                if self._stop.is_set() or not self._await_control_plane():
+                    raise
+
+    def _await_control_plane(self, poll: float = 0.25) -> bool:
+        """Block until the scheduler link is healthy again (True) or the
+        reconnect machine gave up / the client closed (False).  The wait
+        is bounded by the reconnect machine itself: it either rejoins or
+        sets the terminal latch within its retry budget."""
+        while not self._stop.is_set():
+            if self._sched_up.wait(poll):
+                return True
+            with self._sched_cb_lock:
+                if self._sched_terminal and not self._sched_reconnecting:
+                    return False
+        return False
 
     def query_cluster(self) -> dict:
         """Heartbeat ages per node from the scheduler (failure detection,
@@ -723,9 +814,26 @@ class PSClient:
         return decode_liveness(self._sched_request(Message(Op.QUERY)).payload)
 
     def _heartbeat_loop(self, interval: float) -> None:
+        beat_incarnation = None
         while not self._stop.is_set():
             if self._stop.wait(interval):
                 return
+            with self._sched_cb_lock:
+                if self._sched_dead:
+                    # control_plane_degraded: the reconnect machine owns
+                    # the link — keep ticking (a single send failure must
+                    # never permanently end all future beats; the fix for
+                    # the terminal-return latch, docs/robustness.md)
+                    continue
+            inc = self.sched_incarnation
+            if inc != beat_incarnation:
+                # first beat to a NEW scheduler incarnation ships the
+                # FULL metric history, not a delta against baselines the
+                # dead scheduler took to its grave — the successor's
+                # aggregate starts empty.  reship_for is idempotent per
+                # incarnation (in-process fleets share one registry).
+                metrics().reship_for(inc)
+                beat_incarnation = inc
             # piggyback this process's metric DELTAS on the beat: the
             # scheduler folds them into its cluster-wide aggregate
             # registry (served on its own BYTEPS_METRICS_PORT), so one
@@ -734,13 +842,23 @@ class PSClient:
             delta = metrics().delta_snapshot()
             try:
                 payload = json.dumps(delta).encode() if delta else b""
-                self._sched_request(Message(Op.PING, payload=payload))
+                # bounded wait: a chaos-dropped PING on a healthy link
+                # must cost one beat, not park this thread forever
+                self._sched_request(
+                    Message(Op.PING, payload=payload),
+                    timeout=max(2.0, 4 * interval),
+                )
             except (ConnectionError, OSError):
                 # the delta was consumed from the shipped baselines but
-                # never delivered — give it back for the next beat (or a
-                # successor control plane) instead of losing increments
+                # may never have been delivered — give it back for the
+                # next beat (or a successor control plane).  Delivery
+                # toward the aggregate is AT-LEAST-ONCE by design
+                # (docs/observability.md): a timed-out beat whose
+                # request actually landed re-ships its increments, a
+                # deliberate over-count bias — losing increments would
+                # silently understate degradation, which is worse.
                 metrics().requeue_delta(delta)
-                return
+                continue
 
     def _sched_recv_loop(self) -> None:
         try:
@@ -757,6 +875,10 @@ class PSClient:
                     # engine re-inits keys on their new owners
                     # (server_generation bump)
                     book = json.loads(msg.payload.decode())
+                    if not self._fence_book(book):
+                        # zombie scheduler racing its restarted
+                        # successor: refuse the stale-incarnation book
+                        continue
                     self.num_workers = book["num_workers"]
                     self._note_membership(book)
                     new_addrs = [tuple(s) for s in book["servers"]]
@@ -793,10 +915,203 @@ class PSClient:
             # of registering callbacks nobody will ever drain
             with self._sched_cb_lock:
                 self._sched_dead = True
+                self._sched_up.clear()
                 pending = list(self._sched_cbs.values())
                 self._sched_cbs.clear()
+                spawn_reconnect = (
+                    not self._stop.is_set()
+                    and not self._sched_reconnecting
+                )
+                latch_terminal = False
+                token = 0
+                if spawn_reconnect:
+                    if self.cfg.sched_reconnect_retries > 0:
+                        self._sched_reconnecting = True
+                        self._reconnect_token += 1
+                        token = self._reconnect_token
+                    else:
+                        # legacy terminal latch (BYTEPS_SCHED_RECONNECT_
+                        # RETRIES=0): degraded forever, waiters fail fast
+                        self._sched_terminal = True
+                        latch_terminal = True
+                        spawn_reconnect = False
             for ev, _ in pending:
                 ev.set()
+            if latch_terminal:
+                # the gauge must still report the outage even though no
+                # reconnect machine will run
+                metrics().gauge_set("control_plane_degraded", 1)
+            if spawn_reconnect:
+                # hand off to the reconnect state machine instead of
+                # latching dead: the data plane keeps training on the
+                # last-adopted book while this node redials the
+                # scheduler address (control_plane_degraded mode,
+                # docs/robustness.md "Control-plane recovery")
+                metrics().gauge_set("control_plane_degraded", 1)
+                threading.Thread(
+                    target=self._sched_reconnect_loop, args=(token,),
+                    name="bps-sched-reconnect", daemon=True,
+                ).start()
+
+    # --- control-plane reconnect state machine ---------------------------
+    #
+    # docs/robustness.md "Control-plane recovery".  Scheduler-link loss
+    # used to latch `_sched_dead` terminally: one `kill -9` of the
+    # scheduler and the job could never resize, evict, reshard, or
+    # aggregate metrics again — even though the worker↔server data plane
+    # was perfectly healthy.  Instead the node enters control_plane_
+    # degraded mode (data plane trains on the last-adopted book) while
+    # this machine redials the scheduler address with bounded backoff
+    # and re-REGISTERs carrying its uid, last-known rank, and the
+    # membership/map epochs it acted under — a restarted scheduler
+    # rebuilds its registration table from exactly these reports.
+
+    def _sched_reconnect_loop(self, token: int = 0) -> None:
+        from byteps_tpu.comm.retry import Backoff
+
+        from byteps_tpu.common import logging as bpslog
+
+        backoff = Backoff(
+            base=max(0.05, self.cfg.sched_reconnect_backoff_s), cap=10.0
+        )
+        attempts = 0
+        try:
+            while not self._stop.is_set():
+                if attempts >= self.cfg.sched_reconnect_retries:
+                    bpslog.warning(
+                        "scheduler reconnect gave up after %d attempts — "
+                        "control plane is down for good (data plane "
+                        "continues on the last book)", attempts,
+                    )
+                    with self._sched_cb_lock:
+                        if self._reconnect_token == token:
+                            self._sched_terminal = True
+                    return
+                attempts += 1
+                counters().bump("sched_reconnect")
+                sock = None
+                try:
+                    sock, book = self._sched_re_register()
+                except (ConnectionError, OSError, RuntimeError, ValueError):
+                    if sock is not None:
+                        close_socket(sock)
+                    if self._stop.wait(backoff.next_delay()):
+                        return
+                    continue
+                if book is None:
+                    # register answered by a STALE incarnation (zombie
+                    # scheduler still bound to the address): refuse and
+                    # redial — the successor will win the port
+                    close_socket(sock)
+                    if self._stop.wait(backoff.next_delay()):
+                        return
+                    continue
+                self._adopt_rejoin(sock, book)
+                return
+        finally:
+            latch = False
+            with self._sched_cb_lock:
+                if self._reconnect_token == token and self._sched_reconnecting:
+                    # loop exiting WITHOUT a successful adopt (give-up,
+                    # stop, or an unexpected error unwinding this
+                    # thread): latch terminal so barrier retries fail
+                    # instead of polling a machine that no longer
+                    # exists.  The token gate matters: a successful
+                    # _adopt_rejoin hands ownership to the recv loop it
+                    # spawns, and if THAT loop already died and spawned
+                    # the next machine (token advanced), this exiting
+                    # one must not clear the successor's flag or latch
+                    # terminal over its live retry budget.
+                    self._sched_reconnecting = False
+                    if self._sched_dead:
+                        self._sched_terminal = True
+                        latch = True
+            if latch:
+                metrics().gauge_set("control_plane_degraded", 1)
+
+    def _sched_re_register(self):
+        """One redial + re-REGISTER attempt → (socket, book).  The book
+        is None when a zombie (stale-incarnation) scheduler answered.
+        Blocks in recv until the scheduler replies — a RESTARTED
+        scheduler parks the reply until its population completes or its
+        rejoin grace window expires, and this thread is the right place
+        to wait that out."""
+        from byteps_tpu.comm.transport import connect_control
+
+        sock = connect_control(self.cfg.ps_root_uri, self.cfg.ps_root_port)
+        try:
+            payload = json.dumps({
+                "role": "worker", "host": "", "port": 0,
+                "uid": self.node_uid,
+                # LIVE topology expectation, not the launch-time config:
+                # the cluster may have been resized since
+                "num_workers": self.num_workers,
+                "num_servers": self.num_servers,
+                # state-reconstruction report for a reborn scheduler
+                "last_rank": self.rank,
+                "epoch": self.membership_epoch,
+                "map_epoch": max(self.map_epoch, self._seen_map_epoch),
+                # control-plane reconnect, NOT a process restart: the
+                # runtime is live and connect()'s re-init barrier will
+                # not run, so the scheduler must not arm the
+                # recovered-conn barrier bypass for this conn
+                "reconnect": True,
+            }).encode()
+            send_message(sock, Message(Op.REGISTER, payload=payload))
+            resp = recv_message(sock)
+            if resp.status != 0:
+                err = json.loads(resp.payload.decode()).get(
+                    "error", "register refused"
+                )
+                raise RuntimeError(f"scheduler refused rejoin: {err}")
+            book = json.loads(resp.payload.decode())
+            if not self._fence_book(book):
+                return sock, None
+            return sock, book
+        except BaseException:
+            close_socket(sock)
+            raise
+
+    def _adopt_rejoin(self, sock, book: dict) -> None:
+        """Install a successful rejoin: swap the control socket in, adopt
+        the book (rank is stable — the scheduler honored the uid/rank
+        report), restart the receiver, and wake barrier retries."""
+        self.rank = book["rank"]
+        self.num_workers = book["num_workers"]
+        self.is_recovery = True
+        self._note_membership(book)
+        counters().bump("sched_rejoin")
+        with self._sched_cb_lock:
+            old, self._sched = self._sched, sock
+            self._sched_dead = False
+            # hand the NEXT reconnect cycle to the recv loop we are about
+            # to spawn: if the rejoined link dies again (likely under
+            # scheduler-link chaos), its finally must see reconnecting
+            # False and start a fresh machine rather than assume this
+            # (exiting) one still owns the link
+            self._sched_reconnecting = False
+            self._book_token += 1
+            token = self._book_token
+        close_socket(old)  # the dead link's fd must not outlive the rejoin
+        threading.Thread(target=self._sched_recv_loop, daemon=True).start()
+        # adopt the book's server set/ownership map like a RESIZE_SEQ
+        # broadcast — when nothing changed (the common crash-restart
+        # case) this is the no-op path: no reconnect churn, no
+        # generation bump, the version sequence continues bitwise
+        self._rebuild_servers(
+            book["num_servers"], [tuple(s) for s in book["servers"]],
+            token, book=book,
+        )
+        with self._sched_cb_lock:
+            # only mark the link up if it is STILL up: under repeated
+            # chaos the fresh socket can die during the rebuild above,
+            # and re-setting the event then would make barrier retries
+            # busy-spin against a dead link until the next rejoin
+            alive = not self._sched_dead
+            if alive:
+                self._sched_up.set()
+        if alive:
+            metrics().gauge_set("control_plane_degraded", 0)
 
     def _rebuild_servers(
         self,

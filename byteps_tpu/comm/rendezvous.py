@@ -25,6 +25,20 @@ message from a registered node refreshes its last-seen stamp; nodes ping
 every ``BYTEPS_HEARTBEAT_INTERVAL`` seconds and Op.QUERY returns per-node
 heartbeat ages.
 
+Crash recovery (docs/robustness.md "Control-plane recovery"): the
+scheduler is stateless-restartable.  Every instance mints an
+**incarnation id** stamped into every book; nodes refuse books from an
+older incarnation (a zombie scheduler racing its successor).  A
+restarted scheduler rebuilds its registration table from the survivors'
+re-REGISTERs — each carries the node's persisted uid, last-known rank
+(honored when free), membership epoch, and ownership-map epoch — and
+fences its first books ABOVE the maximum reported epochs, so a reborn
+control plane can never hand out state older than what a live node
+already acted on.  ``BYTEPS_SCHED_REJOIN_WINDOW_S`` bounds how long the
+rebirth waits for every previously-reported rank before adopting the
+partial population (no books ship, and therefore no eviction can fire,
+until then — slow reconnectors are not mass-evicted).
+
 Liveness POLICY (docs/robustness.md): with ``BYTEPS_DEAD_NODE_TIMEOUT_S``
 set (> heartbeat interval), a monitor thread EVICTS any registered node
 whose heartbeat age exceeds the threshold — a crashed node stops
@@ -87,9 +101,39 @@ class Scheduler:
         host: str = "0.0.0.0",
         port: int = 0,
         dead_node_timeout: Optional[float] = None,
+        incarnation: Optional[int] = None,
+        rejoin_window: Optional[float] = None,
     ):
         self.num_workers = num_workers
         self.num_servers = num_servers
+        #: incarnation id (docs/robustness.md "Control-plane recovery"):
+        #: a fresh value per scheduler PROCESS lifetime, stamped into
+        #: every book.  Nodes track the highest value seen and refuse
+        #: books from an older incarnation — the zombie-scheduler fence,
+        #: the control-plane twin of the zombie-worker fence.  Wall-clock
+        #: ns: strictly increasing across restarts on one host, and a
+        #: successor on another host still compares correctly to NTP
+        #: skew precision (injectable for deterministic tests).
+        self.incarnation = (
+            int(incarnation) if incarnation is not None else time.time_ns()
+        )
+        #: rejoin grace (BYTEPS_SCHED_REJOIN_WINDOW_S): how long a
+        #: RESTARTED scheduler waits for every previously-reported rank
+        #: to re-REGISTER before adopting the partial population.  Armed
+        #: lazily by the first registrant that reports a prior
+        #: incarnation (``last_rank`` in its payload) — a fresh first
+        #: boot never starts the timer, so bring-up behavior is
+        #: unchanged.
+        if rejoin_window is None:
+            rejoin_window = float(
+                os.environ.get("BYTEPS_SCHED_REJOIN_WINDOW_S", "15") or "15"
+            )
+        self.rejoin_window = rejoin_window
+        #: registrants that reported a prior incarnation (rejoiners);
+        #: nonzero marks this instance as a REBIRTH — its first books
+        #: fence epochs above every report and carry is_recovery
+        self._rejoin_reports = 0
+        self._grace_thread: Optional[threading.Thread] = None
         # liveness policy threshold; None → BYTEPS_DEAD_NODE_TIMEOUT_S
         # (0 disables eviction: ages stay observable via Op.QUERY only)
         if dead_node_timeout is None:
@@ -169,7 +213,39 @@ class Scheduler:
         # migration settle next to the per-server owned-key gauges the
         # servers heartbeat in
         self.metrics_agg.gauge_fn("cluster_map_epoch", lambda: self.map_epoch)
+        # control-plane recovery surface (docs/robustness.md): the
+        # incarnation an operator's bps_top is watching, and how many
+        # expected nodes have NOT yet re-registered with this instance
+        # (nonzero only during a rebirth's rejoin window)
+        self.metrics_agg.gauge_fn(
+            "cluster_sched_incarnation", lambda: self.incarnation
+        )
+        self.metrics_agg.gauge_fn(
+            "cluster_rejoining_nodes", self._rejoining_count
+        )
         self._metrics_http = None
+        # scheduler-link fault injection (BYTEPS_CHAOS_SCHED under a
+        # chaos van): accepted control connections get the same
+        # send-side fault layer the data plane's listeners wrap with,
+        # so scheduler→node frames (ADDRBOOK, barrier releases, PING
+        # acks) are chaos-targetable too
+        self._chaos_params = None
+        from byteps_tpu.comm.chaos import control_chaos_enabled
+
+        if control_chaos_enabled():
+            from byteps_tpu.comm.chaos import ChaosParams
+
+            self._chaos_params = ChaosParams.from_env()
+
+    def _rejoining_count(self) -> int:
+        """Expected-but-absent node count while the registration table
+        is being rebuilt (0 once books have shipped).  Lock-free reads:
+        exposition-time gauge sampling may run under the registry lock,
+        and int/len reads are GIL-atomic."""
+        if self._addrbook_sent:
+            return 0
+        present = len(self._nodes["worker"]) + len(self._nodes["server"])
+        return max(0, self.num_workers + self.num_servers - present)
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, name="sched-accept", daemon=True)
@@ -275,6 +351,14 @@ class Scheduler:
         self.map_epoch += 1
         return True
 
+    def _scrub_barrier_waiters_locked(self, dead_conn) -> None:
+        """Drop every parked barrier waiter registered on ``dead_conn``
+        (a connection its node has abandoned).  Caller holds the lock."""
+        for key_waiters in self._barriers.values():
+            key_waiters[:] = [
+                w for w in key_waiters if w[0] is not dead_conn
+            ]
+
     def _release_satisfied_barriers_locked(self) -> None:
         """After a group shrinks, pending barriers may already be full —
         release them or every survivor hangs.  Caller holds the lock."""
@@ -304,6 +388,23 @@ class Scheduler:
         except OSError:
             pass
 
+    def crash(self) -> None:
+        """Die abruptly — the in-process equivalent of ``kill -9``: every
+        fd closes with no goodbye frame, exactly what the kernel does to
+        a SIGKILLed scheduler (peers observe FIN/RST, nothing else).  No
+        drain, no books, no SHUTDOWNs.  Chaos/tests helper: a successor
+        constructed on the same (host, port) rebuilds its registration
+        table from the survivors' re-REGISTERs (docs/robustness.md
+        "Control-plane recovery")."""
+        self.stop()
+        with self._lock:
+            conns = [
+                n.conn for role in ("worker", "server")
+                for n in self._nodes[role]
+            ]
+        for conn in conns:
+            close_socket(conn)
+
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -311,6 +412,20 @@ class Scheduler:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._chaos_params is not None:
+                # scheduler-side half of BYTEPS_CHAOS_SCHED: faults on
+                # the response direction (ADDRBOOK drops etc.), drawn
+                # from the control-plane index stream so data-plane
+                # schedules never shift
+                from byteps_tpu.comm.chaos import (
+                    ChaosSocket,
+                    _next_ctrl_conn_index,
+                )
+
+                conn = ChaosSocket(
+                    conn, self._chaos_params, _next_ctrl_conn_index(),
+                    peer_port=self.port,
+                )
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             )
@@ -406,9 +521,52 @@ class Scheduler:
         # would alias every worker to the first entry.  Servers without a
         # uid fall back to their (stable) listen address.
         uid = info.get("uid") or f"{info['host']}:{info['port']}"
+        # Control-plane recovery (docs/robustness.md): a node that
+        # survived a scheduler crash re-REGISTERs carrying its last-known
+        # rank plus the membership/map epochs it acted under.  The rank
+        # hint keeps identities stable across the rebirth; the epoch
+        # reports floor this instance's counters so the first books it
+        # emits fence strictly ABOVE anything a live node already saw.
+        hint: Optional[int] = None
+        if info.get("last_rank") is not None:
+            try:
+                hint = int(info["last_rank"])
+            except (TypeError, ValueError):
+                hint = None
+            if hint is not None and hint < 0:
+                hint = None
+        rejoiner = info.get("last_rank") is not None
+        # a control-plane RECONNECT (the node's reconnect machine, not a
+        # process restart): the client did not tear its runtime down and
+        # will NOT run connect()'s re-init barrier — so its conn must not
+        # arm the recovered-conn barrier bypass, or its next TRAINING
+        # barrier releases unpaired and desyncs it from its peers
+        reconnect = bool(info.get("reconnect"))
+        rep_epoch = int(info.get("epoch", 0) or 0)
+        rep_map = int(info.get("map_epoch", 0) or 0)
         recovery = False
         resized = False
         with self._lock:
+            if rep_epoch > self.epoch:
+                self.epoch = rep_epoch
+            if rep_map > self.map_epoch:
+                self.map_epoch = rep_map
+            if rejoiner:
+                self._rejoin_reports += 1
+                # rebirth detected: bound how long the remaining ranks
+                # may take to re-register before the partial population
+                # is adopted (no-op on a live scheduler — the book is
+                # already out)
+                self._arm_rejoin_grace_locked()
+                if not self._addrbook_sent and role == "worker":
+                    # the cluster may have been resized since this
+                    # scheduler's env was written; the survivors know
+                    # the live topology — adopt their expectation
+                    nw_r, ns_r = info.get("num_workers"), info.get("num_servers")
+                    if nw_r:
+                        self.num_workers = int(nw_r)
+                    if ns_r:
+                        self.num_servers = int(ns_r)
             # Elastic world-size change (ReDeclareTensor + resume(num_workers,
             # num_servers), operations.cc:96-119): a worker re-registering
             # with a DIFFERENT expected topology updates the cluster's
@@ -465,11 +623,18 @@ class Scheduler:
                 # drop the dead connection's identity so its stray bytes
                 # can't refresh the rejoined node's liveness stamp
                 self._conn_ids.pop(node.conn, None)
+                # scrub the dead connection's parked barrier waiters: the
+                # rejoiner's barrier() RETRY re-sends on the new conn, and
+                # a stale entry would double-count this rank — releasing
+                # the barrier without its peers and skewing the round
+                # counter (the same hazard eviction scrubs for)
+                self._scrub_barrier_waiters_locked(node.conn)
                 nodes[nodes.index(node)] = _Node(
                     rank, info["host"], info["port"], conn, send_lock, uid
                 )
                 recovery = True
-                self._recovered_conns.add(conn)
+                if not reconnect:
+                    self._recovered_conns.add(conn)
             elif self._addrbook_sent:
                 # Unknown uid joining a full cluster: a process-level restart
                 # lost its uuid (BYTEPS_NODE_UID unset), or a scale-up added
@@ -503,6 +668,21 @@ class Scheduler:
                     # servers' zombie fence) must learn the new member's
                     # rank is legitimate — broadcast like an adoption
                     resized = True
+                elif hint is not None and hint not in {n.rank for n in nodes}:
+                    # late reconnector arriving AFTER a rejoin-window
+                    # partial adoption shrank the expectation: its rank
+                    # is provably unclaimed, so grow the expectation
+                    # back and re-admit it rather than refusing a member
+                    # that merely reconnected slowly
+                    rank = hint
+                    nodes.append(
+                        _Node(rank, info["host"], info["port"], conn, send_lock, uid)
+                    )
+                    if role == "worker":
+                        self.num_workers += 1
+                    else:
+                        self.num_servers += 1
+                    resized = True
                 else:
                     err = {
                         "error": f"cluster full: no dead {role} slot to adopt; "
@@ -523,9 +703,37 @@ class Scheduler:
                         pass
                     return
                 recovery = True  # mid-training join: immediate book +
-                self._recovered_conns.add(conn)  # barrier bypass
+                if not reconnect:  # barrier bypass (restarts only)
+                    self._recovered_conns.add(conn)
+            elif existing:
+                # same uid RE-registering during the initial fill: its
+                # first REGISTER's reply is parked (population short) and
+                # that conn died, so the reconnect machine redialed.
+                # REPLACE the entry — appending would create a ghost that
+                # steals the node's own rank hint, inflates the
+                # population count (tripping `full`/the grace adoption
+                # early), and swallows one of the first books on a dead
+                # socket.
+                node = existing[0]
+                rank = node.rank
+                self._conn_ids.pop(node.conn, None)
+                self._scrub_barrier_waiters_locked(node.conn)
+                nodes[nodes.index(node)] = _Node(
+                    rank, info["host"], info["port"], conn, send_lock, uid
+                )
             else:
-                rank = len(nodes)
+                # initial fill.  A rejoiner's rank hint is honored when
+                # free (rank-stable rebirth: keys, ledgers, and barrier
+                # group sizing all depend on stable rank identities);
+                # fresh first-boot registrants carry no hint and keep
+                # the arrival-order assignment.
+                used = {n.rank for n in nodes}
+                if hint is not None and hint not in used:
+                    rank = hint
+                else:
+                    rank = next(
+                        r for r in range(len(nodes) + 1) if r not in used
+                    )
                 nodes.append(
                     _Node(rank, info["host"], info["port"], conn, send_lock, uid)
                 )
@@ -539,11 +747,85 @@ class Scheduler:
                 self._complete_recovery(conn, send_lock, role, rank, msg.seq, resized)
                 return
             if full and not self._addrbook_sent:
-                self._addrbook_sent = True
-                self._bump_map_epoch_locked()  # initial placement: epoch 1
-                for r in ("worker", "server"):
-                    for node in self._nodes[r]:
-                        self._send_addrbook_to(node.conn, node.send_lock, r, node.rank, 0)
+                self._emit_initial_books_locked()
+
+    def _emit_initial_books_locked(self) -> None:
+        """Ship this incarnation's first address books (population
+        complete, or the rejoin grace window adopted a partial one).
+        Caller holds the lock.
+
+        A REBORN scheduler — any registrant reported a prior incarnation
+        — fences both epochs strictly above everything reported (the
+        counters were floored to the maxima at registration; the bumps
+        land above them), so a zombie's last book can never outrank the
+        successor's first.  Liveness stamps are refreshed at emission:
+        nodes cannot heartbeat while their registration is parked, and
+        with a rejoin window longer than BYTEPS_DEAD_NODE_TIMEOUT_S the
+        stale stamps would otherwise mass-evict the whole fleet the
+        moment eviction re-arms."""
+        self._addrbook_sent = True
+        recovery = self._rejoin_reports > 0
+        if recovery:
+            self.epoch += 1
+        self._bump_map_epoch_locked()  # initial placement: above any report
+        now = time.monotonic()
+        for r in ("worker", "server"):
+            for node in self._nodes[r]:
+                self._last_seen[(r, node.rank)] = now
+                self._send_addrbook_to(
+                    node.conn, node.send_lock, r, node.rank, 0,
+                    recovery=recovery,
+                )
+
+    def _arm_rejoin_grace_locked(self) -> None:
+        """Start the rebirth grace timer (once): when it expires before
+        the full previously-reported population returned, the present
+        subset is adopted as the truth.  Caller holds the lock."""
+        if (self._grace_thread is not None or self._addrbook_sent
+                or self.rejoin_window <= 0):
+            return
+        deadline = time.monotonic() + self.rejoin_window
+
+        def _expire() -> None:
+            while not self._stop.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                if self._stop.wait(min(remaining, 0.2)):
+                    return
+            self._adopt_partial_population()
+
+        self._grace_thread = threading.Thread(
+            target=_expire, name="sched-rejoin-grace", daemon=True
+        )
+        self._grace_thread.start()
+
+    def _adopt_partial_population(self) -> None:
+        """Rejoin window expired with ranks still missing: adopt the
+        re-registered subset as the new expected population and emit
+        books — the alternative is stranding every survivor forever on
+        a member that died with (or during) the old scheduler.  A
+        missing rank that reconnects later is re-admitted (expectation
+        grows back; see the late-reconnector branch in
+        ``_handle_register``)."""
+        with self._lock:
+            if self._addrbook_sent or self._stop.is_set():
+                return
+            nw = len(self._nodes["worker"])
+            ns = len(self._nodes["server"])
+            if nw + ns == 0:
+                return  # nobody rejoined; nothing to adopt
+            from byteps_tpu.common import logging as bpslog
+
+            bpslog.warning(
+                "rejoin window (%.1fs) expired with %d/%d workers and "
+                "%d/%d servers re-registered — adopting the partial "
+                "population", self.rejoin_window, nw, self.num_workers,
+                ns, self.num_servers,
+            )
+            self.num_workers = nw
+            self.num_servers = ns
+            self._emit_initial_books_locked()
 
     def _complete_recovery(self, conn, send_lock, role, rank, seq, resized) -> None:
         """Reply to a mid-training (re)registration — parking worker
@@ -618,6 +900,10 @@ class Scheduler:
             # every key out and stop (it is no longer in the rank list).
             "server_ranks": [n.rank for n in servers],
             "map_epoch": self.map_epoch,
+            # zombie-scheduler fence (docs/robustness.md "Control-plane
+            # recovery"): nodes track the highest incarnation seen and
+            # refuse books stamped with an older one
+            "sched_incarnation": self.incarnation,
         }
         if drain:
             book["drain"] = True

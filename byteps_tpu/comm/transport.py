@@ -233,6 +233,24 @@ def connect(host: str, port: int, timeout: float = 30.0) -> socket.socket:
     return van_for_address(host).connect(host, port, timeout=timeout)
 
 
+def connect_control(host: str, port: int, timeout: float = 30.0) -> socket.socket:
+    """Dial the scheduler (control plane).  When the process runs a
+    chaos van AND ``BYTEPS_CHAOS_SCHED=1``, the connection is wrapped in
+    the client-side fault layer so scheduler-link faults are
+    deterministically injectable — ``BYTEPS_CHAOS_TARGET_PORT`` set to
+    the scheduler port and symbolic ``BYTEPS_CHAOS_OPS`` names
+    (REGISTER/PING/ADDRBOOK) compose (docs/robustness.md
+    "Control-plane recovery").  Otherwise identical to :func:`connect`."""
+    sock = connect(host, port, timeout=timeout)
+    import os
+
+    if os.environ.get("BYTEPS_VAN", "").startswith("chaos:"):
+        from byteps_tpu.comm.chaos import wrap_control
+
+        sock = wrap_control(sock, port)
+    return sock
+
+
 # --- multi-key fusion frames (Op.FUSED) ----------------------------------
 #
 # Request body (network byte order):

@@ -110,6 +110,22 @@ class Config:
     # Op.QUERY, the pre-policy behavior)
     dead_node_timeout_s: float = 0.0  # BYTEPS_DEAD_NODE_TIMEOUT_S
 
+    # --- control-plane recovery (docs/robustness.md "Control-plane
+    # recovery") ---
+    # scheduler-link loss no longer latches the node dead: a reconnect
+    # state machine redials DMLC_PS_ROOT_URI:PORT this many times
+    # (after the first loss) while the data plane keeps training on the
+    # last-adopted book.  0 restores the legacy terminal latch.
+    sched_reconnect_retries: int = 20  # BYTEPS_SCHED_RECONNECT_RETRIES
+    # exponential-backoff base between redials (full jitter, capped 10s)
+    sched_reconnect_backoff_s: float = 0.5  # BYTEPS_SCHED_RECONNECT_BACKOFF_S
+    # scheduler-side rejoin grace: a RESTARTED scheduler (one whose
+    # registrants report a prior incarnation) waits this long for every
+    # previously-reported rank to re-REGISTER before adopting the
+    # partial population and emitting books — slow reconnectors are not
+    # mass-evicted at rebirth.  Irrelevant on a fresh first boot.
+    sched_rejoin_window_s: float = 15.0  # BYTEPS_SCHED_REJOIN_WINDOW_S
+
     # --- per-RPC deadlines + idempotent retry (self-healing data plane) ---
     # attempts AFTER the first before a push/pull/init surfaces its error
     rpc_retries: int = 2  # BYTEPS_RPC_RETRIES; 0 restores fail-fast
@@ -253,6 +269,16 @@ class Config:
             ),
             dead_node_timeout_s=float(
                 os.environ.get("BYTEPS_DEAD_NODE_TIMEOUT_S", "0") or "0"
+            ),
+            sched_reconnect_retries=max(
+                0, _env_int("BYTEPS_SCHED_RECONNECT_RETRIES", 20)
+            ),
+            sched_reconnect_backoff_s=float(
+                os.environ.get("BYTEPS_SCHED_RECONNECT_BACKOFF_S", "0.5")
+                or "0.5"
+            ),
+            sched_rejoin_window_s=float(
+                os.environ.get("BYTEPS_SCHED_REJOIN_WINDOW_S", "15") or "15"
             ),
             rpc_retries=max(0, _env_int("BYTEPS_RPC_RETRIES", 2)),
             rpc_deadline_s=float(

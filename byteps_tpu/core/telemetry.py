@@ -412,6 +412,10 @@ class MetricsRegistry:
         self._shipped_labeled: Dict[str, Dict[tuple, int]] = {}
         self._shipped_hists: Dict[Tuple[str, tuple], Tuple[List[int], float, int]] = {}
         self._shipped_gauges: Dict[Tuple[str, tuple], float] = {}
+        # the consumer token of the last reship_for() — a new scheduler
+        # incarnation rebases the delta baselines exactly once even when
+        # several beat loops share this registry (in-process fleets)
+        self._reship_token = None
 
     # --- registration / recording ---------------------------------------
 
@@ -607,6 +611,8 @@ class MetricsRegistry:
             self._shipped_counts.clear()
             self._shipped_labeled.clear()
             self._shipped_hists.clear()
+            self._shipped_gauges = {}
+            self._reship_token = None
         self.counters.reset()
 
     # --- snapshots -------------------------------------------------------
@@ -858,6 +864,34 @@ class MetricsRegistry:
                 if keep:
                     out[field] = keep + list(out.get(field, []))
         return out
+
+    def reship_for(self, token) -> bool:
+        """Re-arm the delta baselines so the NEXT :meth:`delta_snapshot`
+        ships the FULL history (counters, labeled slices, histograms)
+        and re-registers every gauge — called when the heartbeat
+        consumer changed identity (a new scheduler incarnation whose
+        aggregate started empty; the dead one took the old baselines'
+        aggregate to its grave, docs/robustness.md "Control-plane
+        recovery").
+
+        Idempotent per ``token``: in-process test fleets run several
+        beat loops (worker + servers) against ONE shared registry, and
+        only the first loop to observe the new incarnation may rebase —
+        a second rebase would re-ship increments the first full
+        snapshot already delivered, double-counting them in the new
+        aggregate.  Returns True when the rebase actually happened.
+        Requeued failed-send deltas are dropped (their increments are
+        subsumed by the full re-ship)."""
+        with self._delta_lock:
+            if token == self._reship_token:
+                return False
+            self._reship_token = token
+            self._requeued.clear()
+            self._shipped_counts.clear()
+            self._shipped_labeled.clear()
+            self._shipped_hists.clear()
+            self._shipped_gauges = {}
+            return True
 
     def requeue_delta(self, delta: dict) -> None:
         """Give back a delta whose send failed; the next

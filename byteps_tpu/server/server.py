@@ -296,6 +296,15 @@ class PSServer:
         # sized for the shrunken membership.
         self._live_worker_flags: Optional[set] = None
         self._sched_conn: Optional[socket.socket] = None
+        # control-plane recovery state (docs/robustness.md): newest
+        # scheduler incarnation / membership epoch seen (reported back
+        # on rejoin re-REGISTER), the last-adopted map epoch, and the
+        # deliberate-shutdown flag that stops the reconnect machine from
+        # chasing a scheduler that ORDERED this server to stop
+        self.sched_incarnation = 0
+        self.membership_epoch = 0
+        self._map_epoch = 0
+        self._sched_shutdown = False
         self._reducer = _make_reducer()
         # --- elastic resharding (docs/robustness.md "migration flow") ---
         # ownership = epoch-stamped consistent-hash ring over server
@@ -393,39 +402,14 @@ class PSServer:
 
     def _register_with_scheduler(self) -> None:
         """ps::StartPS + barrier equivalent (server.cc:500-509)."""
-        conn = connect(self.cfg.ps_root_uri, self.cfg.ps_root_port)
-        self._sched_conn = conn
-        send_message(
-            conn,
-            Message(
-                Op.REGISTER,
-                payload=json.dumps(
-                    {
-                        "role": "server",
-                        "host": self.host,
-                        "port": self.port,
-                        "uid": self.node_uid,
-                    }
-                ).encode(),
-            ),
-        )
-        resp = recv_message(conn)
-        if resp.status != 0:
-            err = json.loads(resp.payload.decode()).get("error", "register refused")
-            raise RuntimeError(f"scheduler refused registration: {err}")
-        book = json.loads(resp.payload.decode())
-        self.rank = book["rank"]
-        self.num_workers = book["num_workers"]
-        self._adopt_worker_ranks(book)
-        self._adopt_book(book)  # initial ownership map (no keys yet)
-        # cross-process span identity (getattr keeps borrowed use safe;
-        # both PSServer and NativePSServer carry a tracer — the native
-        # wrapper's is fed by the engine's span-ring drain)
-        tracer = getattr(self, "tracer", None)
-        if tracer is not None:
-            tracer.process_name = f"server{self.rank}"
-            tracer.local_rank = f"server{self.rank}"
-        # global barrier before serving (server.cc:506)
+        conn = self._sched_register_once(initial=True)
+        # degraded-state gauge exists from bring-up (docs/robustness.md)
+        from byteps_tpu.core.telemetry import metrics
+
+        metrics().gauge_set("control_plane_degraded", 0)
+        # global barrier before serving (server.cc:506) — initial
+        # bring-up only; a REJOIN after scheduler restart / link loss
+        # must not barrier (the cluster is mid-training, nobody pairs)
         send_message(conn, Message(Op.BARRIER, flags=GROUP_ALL))
         recv_message(conn)
         # This thread owns the scheduler connection from here on: periodic
@@ -435,39 +419,169 @@ class PSServer:
         # with heartbeats disabled (BYTEPS_HEARTBEAT_INTERVAL=0), and
         # promptly (a book parked until the next heartbeat tick would keep
         # the zombie fence / worker count stale for a whole interval).
-        hb = self.cfg.heartbeat_interval
+        threading.Thread(
+            target=self._control_plane_loop, args=(conn,),
+            name="ps-heartbeat", daemon=True,
+        ).start()
+
+    def _sched_register_once(self, initial: bool = True):
+        """Dial the scheduler and REGISTER; adopt the reply book and
+        return the connected control socket.  ``initial=False`` is the
+        control-plane recovery path (docs/robustness.md): the payload
+        additionally reports this server's last-known rank and the
+        membership/map epochs it acted under, so a RESTARTED scheduler
+        can reconstruct its registration table and fence its first
+        books above everything this node already saw."""
+        from byteps_tpu.comm.transport import connect_control
+
+        conn = connect_control(self.cfg.ps_root_uri, self.cfg.ps_root_port)
+        try:
+            payload = {
+                "role": "server",
+                "host": self.host,
+                "port": self.port,
+                "uid": self.node_uid,
+            }
+            if not initial:
+                omap = getattr(self, "_ownership", None)
+                payload.update({
+                    "last_rank": self.rank,
+                    "epoch": self.membership_epoch,
+                    "map_epoch": max(
+                        int(omap.epoch) if omap is not None else 0,
+                        int(getattr(self, "_map_epoch", 0) or 0),
+                    ),
+                    # live reconnect: no bring-up barrier follows, so no
+                    # recovered-conn barrier bypass may be armed
+                    "reconnect": True,
+                })
+            send_message(
+                conn, Message(Op.REGISTER, payload=json.dumps(payload).encode())
+            )
+            resp = recv_message(conn)
+            if resp.status != 0:
+                err = json.loads(resp.payload.decode()).get(
+                    "error", "register refused"
+                )
+                raise RuntimeError(f"scheduler refused registration: {err}")
+            book = json.loads(resp.payload.decode())
+            if not self._fence_book(book):
+                # a zombie scheduler still bound to the address answered;
+                # redial — its restarted successor owns the port
+                raise ConnectionError("book from a stale scheduler incarnation")
+        except BaseException:
+            close_socket(conn)
+            raise
+        if self._sched_conn is not None and self._sched_conn is not conn:
+            close_socket(self._sched_conn)  # dead link's fd: don't leak it
+        self._sched_conn = conn
+        self.rank = book["rank"]
+        if initial:
+            self.num_workers = book["num_workers"]
+        else:
+            # rejoin mid-training: a stale worker count must complete
+            # partial rounds / release now-full barriers, same as a
+            # RESIZE book would
+            self.update_num_workers(book["num_workers"])
+        self._adopt_worker_ranks(book)
+        self._adopt_book(book)  # initial ownership map (no keys yet)
+        self._note_book(book)
+        # cross-process span identity (getattr keeps borrowed use safe;
+        # both PSServer and NativePSServer carry a tracer — the native
+        # wrapper's is fed by the engine's span-ring drain)
+        tracer = getattr(self, "tracer", None)
+        if tracer is not None:
+            tracer.process_name = f"server{self.rank}"
+            tracer.local_rank = f"server{self.rank}"
+        return conn
+
+    def _fence_book(self, book: dict) -> bool:
+        """Incarnation fence (docs/robustness.md "Control-plane
+        recovery"): refuse a book from an OLDER scheduler incarnation
+        than one already acted on — a zombie scheduler racing its
+        restarted successor must not roll the topology back.  Adopts a
+        newer incarnation on accept; unstamped books (older schedulers)
+        always pass."""
+        from byteps_tpu.core.telemetry import counters
+
+        inc = int(book.get("sched_incarnation", 0) or 0)
+        known = int(getattr(self, "sched_incarnation", 0) or 0)
+        if inc and known and inc < known:
+            counters().bump("sched_stale_book")
+            return False
+        if inc > known:
+            self.sched_incarnation = inc
+        return True
+
+    def _note_book(self, book: dict) -> None:
+        """Track the newest membership AND map epochs seen — reported
+        back on a rejoin re-REGISTER so a reborn scheduler fences above
+        them.  The map epoch is tracked independently of the resharding
+        feature: even a reshard-off server has OBSERVED the epoch, and
+        the successor must never re-emit it."""
+        epoch = book.get("epoch")
+        if epoch is not None and int(epoch) > getattr(self, "membership_epoch", 0):
+            self.membership_epoch = int(epoch)
+        me = book.get("map_epoch")
+        if me is not None and int(me) > getattr(self, "_map_epoch", 0):
+            self._map_epoch = int(me)
+
+    def _handle_control(self, conn, msg) -> None:
         from byteps_tpu.comm.rendezvous import RESIZE_SEQ
 
-        def handle_control(msg) -> None:
-            if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
-                book = json.loads(msg.payload.decode())
-                self.update_num_workers(book["num_workers"])
-                self._adopt_worker_ranks(book)
-                # ownership adoption LAST: a drain book's migration wave
-                # (and eventual stop) must see the settled worker count
-                self._adopt_book(book)
-                return
-            if msg.op == Op.SHUTDOWN:
-                # elastic scale-down dropped this server from the book;
-                # stop serving (stop() joins threads — run it off-thread)
-                threading.Thread(target=self.stop, daemon=True).start()
-                raise ConnectionError("scheduler requested shutdown")
-            # PING responses and anything else: drained, no action
+        if msg.op == Op.ADDRBOOK and msg.seq == RESIZE_SEQ:
+            book = json.loads(msg.payload.decode())
+            if not self._fence_book(book):
+                return  # stale-incarnation book refused (zombie fence)
+            self._note_book(book)
+            self.update_num_workers(book["num_workers"])
+            self._adopt_worker_ranks(book)
+            # ownership adoption LAST: a drain book's migration wave
+            # (and eventual stop) must see the settled worker count
+            self._adopt_book(book)
+            return
+        if msg.op == Op.SHUTDOWN:
+            # elastic scale-down dropped this server from the book;
+            # stop serving (stop() joins threads — run it off-thread).
+            # Flag first: the ConnectionError below must read as a
+            # deliberate exit, not a link loss to reconnect from.
+            self._sched_shutdown = True
+            threading.Thread(target=self.stop, daemon=True).start()
+            raise ConnectionError("scheduler requested shutdown")
+        # PING responses and anything else: drained, no action
 
-        def control_loop() -> None:
-            """Heartbeat + prompt control-message delivery on one thread:
-            select() waits for control traffic between beats, so RESIZE
-            books apply within ~0.3s instead of a heartbeat interval."""
-            import select as _select
+    def _control_plane_loop(self, conn) -> None:
+        """Heartbeat + prompt control-message delivery on one thread:
+        select() waits for control traffic between beats, so RESIZE
+        books apply within ~0.3s instead of a heartbeat interval.
 
-            from byteps_tpu.core.telemetry import metrics
+        Link loss hands off to :meth:`_sched_reconnect` instead of
+        exiting — control_plane_degraded mode (docs/robustness.md): the
+        data plane keeps serving on the last-adopted book while this
+        thread redials and re-REGISTERs, and the first beat to a NEW
+        scheduler incarnation ships the FULL metric history (the dead
+        scheduler took the delta baselines' aggregate to its grave)."""
+        import select as _select
 
+        from byteps_tpu.core.telemetry import metrics
+
+        hb = self.cfg.heartbeat_interval
+        beat_incarnation = None
+        while not self._stop.is_set():
             next_beat = time.monotonic() + hb if hb > 0 else None
             delta: dict = {}
             try:
                 while not self._stop.is_set():
                     now = time.monotonic()
                     if next_beat is not None and now >= next_beat:
+                        inc = getattr(self, "sched_incarnation", 0)
+                        if inc != beat_incarnation:
+                            # new consumer: re-arm the delta baselines so
+                            # this beat carries everything (idempotent
+                            # per incarnation — in-process fleets share
+                            # one registry across several beat loops)
+                            metrics().reship_for(inc)
+                            beat_incarnation = inc
                         # metric deltas piggyback on the beat — the
                         # scheduler aggregates them cluster-wide
                         # (docs/observability.md), same as the workers
@@ -484,16 +598,52 @@ class PSServer:
                         next_beat = now + hb
                     readable, _, _ = _select.select([conn], [], [], 0.3)
                     if readable:
-                        handle_control(recv_message(conn))
+                        self._handle_control(conn, recv_message(conn))
             except (ConnectionError, OSError, ValueError):
                 # a delta consumed but not delivered rides the next
                 # successful beat instead of vanishing
                 metrics().requeue_delta(delta)
-                return
+                if self._stop.is_set() or getattr(self, "_sched_shutdown", False):
+                    return
+                conn = self._sched_reconnect()
+                if conn is None:
+                    return  # terminal: data plane continues on last book
 
-        threading.Thread(
-            target=control_loop, name="ps-heartbeat", daemon=True,
-        ).start()
+    def _sched_reconnect(self):
+        """Redial + re-REGISTER with bounded backoff
+        (BYTEPS_SCHED_RECONNECT_RETRIES/_BACKOFF_S); returns the fresh
+        control socket, or None once the budget is spent (the legacy
+        terminal behavior — the data plane keeps serving)."""
+        from byteps_tpu.comm.retry import Backoff
+        from byteps_tpu.common import logging as bpslog
+        from byteps_tpu.core.telemetry import counters, metrics
+
+        metrics().gauge_set("control_plane_degraded", 1)
+        if self.cfg.sched_reconnect_retries <= 0:
+            return None  # reconnect disabled: scheduler-link loss is final
+        backoff = Backoff(
+            base=max(0.05, self.cfg.sched_reconnect_backoff_s), cap=10.0
+        )
+        for _ in range(self.cfg.sched_reconnect_retries):
+            if self._stop.is_set():
+                return None
+            counters().bump("sched_reconnect")
+            try:
+                conn = self._sched_register_once(initial=False)
+            except (ConnectionError, OSError, RuntimeError, ValueError):
+                if self._stop.wait(backoff.next_delay()):
+                    return None
+                continue
+            counters().bump("sched_rejoin")
+            metrics().gauge_set("control_plane_degraded", 0)
+            return conn
+        bpslog.warning(
+            "server rank=%s: scheduler reconnect gave up after %d "
+            "attempts — control plane down for good (data plane "
+            "continues on the last book)",
+            self.rank, self.cfg.sched_reconnect_retries,
+        )
+        return None
 
     def _adopt_worker_ranks(self, book: dict) -> None:
         """Refresh the zombie fence from a scheduler book.  Books without
@@ -532,6 +682,7 @@ class PSServer:
             )
             self._prev_ownership = cur
             self._ownership = new_map
+            self._map_epoch = new_map.epoch
             self._peer_addrs = {
                 int(r): servers[i]
                 for i, r in enumerate(ranks)
@@ -1947,6 +2098,13 @@ class NativePSServer:
         self._live_worker_flags: Optional[set] = None
         self._stop = threading.Event()
         self._sched_conn: Optional[socket.socket] = None
+        # control-plane recovery state (docs/robustness.md) — same
+        # surface as PSServer; the borrowed control-plane methods below
+        # read/write these
+        self.sched_incarnation = 0
+        self.membership_epoch = 0
+        self._map_epoch = 0
+        self._sched_shutdown = False
         self._metrics_http = None
         from byteps_tpu.common.config import resolve_node_uid
 
@@ -2152,6 +2310,20 @@ class NativePSServer:
             self._id, int(self.rank), int(epoch) & 0xFFFFFFFF, n,
             hashes, rks,
         )
+        if int(epoch) > self._map_epoch:
+            self._map_epoch = int(epoch)  # reported on rejoin re-REGISTER
+
+    # control-plane machinery shared with the Python server — this class
+    # is a wrapper around the C++ engine, not a PSServer subclass, so the
+    # reconnect/fence/register helpers are borrowed as unbound methods
+    # (they only touch the state surface both classes carry)
+    _register_with_scheduler = PSServer._register_with_scheduler
+    _sched_register_once = PSServer._sched_register_once
+    _control_plane_loop = PSServer._control_plane_loop
+    _sched_reconnect = PSServer._sched_reconnect
+    _handle_control = PSServer._handle_control
+    _fence_book = PSServer._fence_book
+    _note_book = PSServer._note_book
 
     def start(self, register: bool = True) -> None:
         # scrape surface with the C++ data plane: the process-global
@@ -2163,7 +2335,7 @@ class NativePSServer:
             self._metrics_http = serve_metrics(self.cfg.metrics_port)
         if register:
             # identical control-plane bring-up to the Python server
-            PSServer._register_with_scheduler(self)  # type: ignore[arg-type]
+            self._register_with_scheduler()
             # the scheduler's address book wins over launch-time env
             # (PSServer adopts book["num_workers"]; mirror it in the engine)
             self._lib.bps_native_server_set_num_workers(self._id, self.num_workers)
@@ -2253,6 +2425,7 @@ def run_server() -> None:
         sched = Scheduler(
             cfg.num_worker, cfg.num_server, port=cfg.ps_root_port,
             dead_node_timeout=cfg.dead_node_timeout_s,
+            rejoin_window=cfg.sched_rejoin_window_s,
         )
         sched.start()
         _serve_until_signaled(sched)

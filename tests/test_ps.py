@@ -518,16 +518,24 @@ class TestServerDeath:
 
 
 class TestSchedulerDeath:
-    def test_data_plane_survives_control_plane_errors(self, monkeypatch):
+    def test_data_plane_survives_and_rejoins_restarted_scheduler(self, monkeypatch):
         """SIGKILL the scheduler subprocess mid-job: the data plane rides
         direct worker↔server connections and must keep aggregating, while
-        control-plane calls (query_cluster) must raise ConnectionError —
-        including calls made AFTER the link died, which previously
-        registered waiters nobody would ever wake."""
+        control-plane calls (query_cluster) raise ConnectionError for as
+        long as the node is in control_plane_degraded mode — including
+        calls made AFTER the link died, which previously registered
+        waiters nobody would ever wake.  The death is no longer terminal
+        (docs/robustness.md "Control-plane recovery"): once a successor
+        scheduler binds the same address, the reconnect machine
+        re-registers and control-plane calls work again."""
         port_probe = __import__("socket").socket()
         port_probe.bind(("127.0.0.1", 0))
         port = port_probe.getsockname()[1]
         port_probe.close()
+        # fast redials so the rejoin half of the test stays quick
+        monkeypatch.setenv("BYTEPS_SCHED_RECONNECT_BACKOFF_S", "0.1")
+        monkeypatch.setenv("BYTEPS_SCHED_RECONNECT_RETRIES", "100")
+        monkeypatch.setenv("BYTEPS_CONNECT_RETRY_S", "0.2")
         env = {
             **os.environ,
             "DMLC_PS_ROOT_URI": "127.0.0.1",
@@ -582,13 +590,39 @@ class TestSchedulerDeath:
             out2 = bps.push_pull(x, name="sched.chaos", average=False)
             np.testing.assert_allclose(np.asarray(out2), x)
 
-            # control plane: fail fast, even well after the death
+            # control plane: fail fast while degraded, even well after
+            # the death (no waiter may park on a dead link)
             from byteps_tpu.core.state import require_state
 
             client = require_state().ps_client
             for _ in range(3):
                 with pytest.raises(ConnectionError):
                     client.query_cluster()
+
+            # the latch is no longer terminal: restart the scheduler on
+            # the SAME address — the reconnect machine re-registers and
+            # the control plane comes back
+            sched_proc = subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"],
+                env=env,
+                cwd="/root/repo",
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            deadline = time.time() + 60
+            live = None
+            while time.time() < deadline:
+                try:
+                    live = client.query_cluster()
+                    break
+                except ConnectionError:
+                    time.sleep(0.5)
+            assert live is not None, "control plane never rejoined"
+            assert 0 in live["worker"] and 0 in live["server"]
+            # data plane still exact through the whole episode
+            out3 = bps.push_pull(x, name="sched.chaos", average=False)
+            np.testing.assert_allclose(np.asarray(out3), x)
         finally:
             bps.shutdown()
             if sched_proc.poll() is None:

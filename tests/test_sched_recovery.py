@@ -1,0 +1,738 @@
+"""Scheduler crash recovery: restartable control plane with incarnation
+fencing and live rejoin (docs/robustness.md "Control-plane recovery").
+
+The scheduler used to be the job's single point of failure: one
+``kill -9`` and every node latched ``_sched_dead``, the heartbeat loop
+exited permanently, and the cluster could never resize, evict, reshard,
+or aggregate metrics again — even though the worker↔server data plane
+was perfectly healthy.  These tests pin the recovery contract:
+
+- scheduler-link loss puts a node in ``control_plane_degraded`` mode
+  (data plane keeps training on the last-adopted book) while a
+  reconnect state machine redials with bounded backoff;
+- a restarted scheduler mints a new incarnation, rebuilds its
+  registration table from the survivors' re-REGISTERs (uid + last-known
+  rank + epochs), and fences its first books strictly ABOVE every
+  reported epoch;
+- nodes refuse books from an older incarnation (zombie scheduler);
+- pending barriers re-arm across the restart instead of stranding;
+- the first heartbeat to a new incarnation ships the FULL metric
+  history, not a delta against baselines the dead scheduler took with
+  it;
+- scheduler-link faults are deterministically injectable
+  (``BYTEPS_CHAOS_SCHED`` + ``BYTEPS_CHAOS_OPS=PING/ADDRBOOK`` +
+  ``BYTEPS_CHAOS_TARGET_PORT``).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.comm.rendezvous import GROUP_WORKERS, Scheduler
+from byteps_tpu.comm.transport import Message, Op, recv_message, send_message
+from byteps_tpu.core.telemetry import counters
+
+
+def _set_env(env: dict) -> dict:
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    return old
+
+
+def _restore_env(old: dict) -> None:
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+#: fast-recovery knobs shared by the e2e tests below
+_FAST = {
+    "DMLC_PS_ROOT_URI": "127.0.0.1",
+    "BYTEPS_FORCE_DISTRIBUTED": "1",
+    "BYTEPS_HEARTBEAT_INTERVAL": "0.1",
+    "BYTEPS_SCHED_RECONNECT_RETRIES": "80",
+    "BYTEPS_SCHED_RECONNECT_BACKOFF_S": "0.05",
+    "BYTEPS_SCHED_REJOIN_WINDOW_S": "5",
+    "BYTEPS_CONNECT_RETRY_S": "0.2",
+}
+
+
+def _roundtrip(client, key, value, version, n=64):
+    done = threading.Event()
+    box = []
+    payload = np.full(n, value, np.float32).tobytes()
+    client.push(key, payload, 0, version, cb=lambda: done.set())
+    assert done.wait(10)
+    got = threading.Event()
+    client.pull(key, version, lambda p: (box.append(p), got.set()))
+    assert got.wait(10)
+    return np.frombuffer(box[0], np.float32)
+
+
+def _register_raw(port: int, payload: dict, timeout: float = 5.0):
+    """One raw-socket REGISTER → (socket, reply Message).  The caller
+    owns the socket (keep it open: closing tells the scheduler the node
+    died)."""
+    sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    sock.settimeout(timeout)
+    send_message(sock, Message(Op.REGISTER, payload=json.dumps(payload).encode()))
+    return sock, recv_message(sock)
+
+
+class TestIncarnationFence:
+    def test_client_refuses_older_incarnation_book(self):
+        from byteps_tpu.comm.ps_client import PSClient
+
+        pc = PSClient.__new__(PSClient)
+        pc.sched_incarnation = 0
+        counters().reset()
+        assert pc._fence_book({"sched_incarnation": 5})
+        assert pc.sched_incarnation == 5
+        # zombie scheduler racing its successor: older incarnation refused
+        assert not pc._fence_book({"sched_incarnation": 3})
+        assert pc.sched_incarnation == 5
+        assert counters().get("sched_stale_book") == 1
+        # same incarnation and unstamped (legacy) books pass
+        assert pc._fence_book({"sched_incarnation": 5})
+        assert pc._fence_book({})
+
+    def test_server_refuses_older_incarnation_book(self):
+        from byteps_tpu.server.server import PSServer
+
+        srv = PSServer.__new__(PSServer)
+        srv.sched_incarnation = 0
+        counters().reset()
+        assert srv._fence_book({"sched_incarnation": 9})
+        assert srv.sched_incarnation == 9
+        assert not srv._fence_book({"sched_incarnation": 8})
+        assert counters().get("sched_stale_book") == 1
+        assert srv._fence_book({"sched_incarnation": 10})
+        assert srv.sched_incarnation == 10
+
+    def test_resize_book_from_zombie_is_not_applied(self):
+        """A stale-incarnation RESIZE book on the control connection is
+        dropped BEFORE any topology field is adopted."""
+        from byteps_tpu.server.server import PSServer
+
+        srv = PSServer.__new__(PSServer)
+        srv.sched_incarnation = 7
+        srv.membership_epoch = 4
+        srv._map_epoch = 0
+        srv.num_workers = 2
+        calls = []
+        srv.update_num_workers = lambda n: calls.append(n)
+        book = {"sched_incarnation": 6, "num_workers": 99, "epoch": 9,
+                "worker_ranks": [0]}
+        from byteps_tpu.comm.rendezvous import RESIZE_SEQ
+
+        msg = Message(Op.ADDRBOOK, seq=RESIZE_SEQ,
+                      payload=json.dumps(book).encode())
+        srv._handle_control(None, msg)
+        assert calls == [] and srv.num_workers == 2
+        assert srv.membership_epoch == 4  # stale book noted nothing
+
+
+class TestRestartedSchedulerFencesEpochs:
+    def test_first_book_fences_above_reported_epochs_and_honors_rank(self):
+        """A reborn scheduler must never emit a map epoch <= one any
+        rejoining node reported, and must give a rejoiner its last-known
+        rank back (ledgers, key placement, and barrier sizing all key on
+        rank identity)."""
+        sched = Scheduler(num_workers=2, num_servers=0, host="127.0.0.1",
+                          rejoin_window=30.0)
+        sched.start()
+        try:
+            s0, _b = None, None
+            # rejoiner reporting rank 1 and epochs it acted under
+            s1 = socket.create_connection(("127.0.0.1", sched.port), timeout=5)
+            s1.settimeout(10)
+            send_message(s1, Message(Op.REGISTER, payload=json.dumps({
+                "role": "worker", "host": "", "port": 0, "uid": "fence-w1",
+                "num_workers": 2, "num_servers": 0,
+                "last_rank": 1, "epoch": 3, "map_epoch": 7,
+            }).encode()))
+            # second rejoiner completes the population → books emit
+            s0, resp0 = _register_raw(sched.port, {
+                "role": "worker", "host": "", "port": 0, "uid": "fence-w0",
+                "num_workers": 2, "num_servers": 0,
+                "last_rank": 0, "epoch": 3, "map_epoch": 7,
+            }, timeout=10)
+            book0 = json.loads(resp0.payload.decode())
+            book1 = json.loads(recv_message(s1).payload.decode())
+            assert book1["rank"] == 1 and book0["rank"] == 0
+            assert book0["map_epoch"] > 7, book0
+            assert book0["epoch"] > 3, book0
+            assert book0["sched_incarnation"] == sched.incarnation
+            assert book0["is_recovery"] is True
+            assert sched.map_epoch > 7
+            s0.close()
+            s1.close()
+        finally:
+            sched.stop()
+
+
+class TestSchedulerRestartRejoin:
+    def test_crash_restart_full_rejoin_traffic_bitwise(self):
+        """The acceptance e2e: SIGKILL-equivalent scheduler crash +
+        restart on the same address.  The data plane trains bitwise
+        THROUGH the outage, every node rejoins the new incarnation with
+        zero evictions and stable ranks, heartbeats resume, and the
+        rebuilt cluster aggregate holds the FULL metric history."""
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.server.server import PSServer
+
+        old = _set_env({**_FAST, "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1"})
+        counters().reset()
+        sched = Scheduler(1, 1, host="127.0.0.1")
+        sched.start()
+        os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+        old.setdefault("DMLC_PS_ROOT_PORT", None)
+        sched2 = None
+        try:
+            cfg = Config.from_env()
+            srv = PSServer(cfg)
+            threading.Thread(target=srv.start, daemon=True).start()
+            w = PSClient(cfg, node_uid="rej-w0")
+            w.connect()
+            w.init_tensor(5, 64, 0)
+            np.testing.assert_array_equal(_roundtrip(w, 5, 1.5, 1), 1.5)
+
+            inc0, map0, port = sched.incarnation, sched.map_epoch, sched.port
+            sched.crash()
+            time.sleep(0.3)
+            # degraded-mode survival: the data plane must not notice
+            np.testing.assert_array_equal(_roundtrip(w, 5, 2.5, 2), 2.5)
+            assert w._sched_dead  # control plane really was down
+
+            sched2 = Scheduler(1, 1, host="127.0.0.1", port=port)
+            sched2.start()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if (w.sched_incarnation > inc0 and not w._sched_dead
+                        and sched2._addrbook_sent):
+                    break
+                time.sleep(0.1)
+            assert w.sched_incarnation > inc0, "worker never rejoined"
+            assert sched2._addrbook_sent, "membership not re-established"
+            assert sched2.map_epoch > map0, "map epoch not fenced"
+            assert sched2.eviction_totals == {"worker": 0, "server": 0}, (
+                "spurious eviction at rebirth"
+            )
+            assert w.rank == 0 and srv.rank == 0  # rank-stable rebirth
+            np.testing.assert_array_equal(_roundtrip(w, 5, 3.5, 3), 3.5)
+
+            # heartbeats resumed against the new incarnation
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                live = w.query_cluster()
+                if 0 in live["worker"] and 0 in live["server"]:
+                    break
+                time.sleep(0.1)
+            assert 0 in live["worker"] and 0 in live["server"]
+            snap = counters().snapshot()
+            assert snap.get("sched_rejoin", 0) >= 2, snap  # worker + server
+
+            # metrics continuity: first beats to the new incarnation
+            # shipped FULL snapshots, so the rebuilt aggregate equals
+            # the local totals (not just the post-restart delta)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                agg = sched2.metrics_agg.counters.snapshot()
+                if agg.get("wire_rpc", 0) == counters().get("wire_rpc"):
+                    break
+                time.sleep(0.2)
+            assert agg.get("wire_rpc", 0) == counters().get("wire_rpc"), (
+                "rebuilt aggregate is missing pre-crash history"
+            )
+            w.close()
+            srv.stop()
+        finally:
+            _restore_env(old)
+            sched.stop()
+            if sched2 is not None:
+                sched2.stop()
+
+
+class TestBarrierRearmAcrossRestart:
+    def test_pending_barrier_rearms_from_reregistration(self):
+        """A worker parked in a scheduler barrier when the scheduler
+        dies must NOT strand: its barrier call rides the reconnect
+        machine, re-sends against the restarted scheduler's empty
+        barrier table, and pairs with its peer's re-sent barrier."""
+        from byteps_tpu.comm.ps_client import PSClient
+
+        old = _set_env({**_FAST, "DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "0"})
+        sched = Scheduler(2, 0, host="127.0.0.1")
+        sched.start()
+        os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+        old.setdefault("DMLC_PS_ROOT_PORT", None)
+        sched2 = None
+        try:
+            cfg = Config.from_env()
+            w0 = PSClient(cfg, node_uid="bar-w0")
+            w1 = PSClient(cfg, node_uid="bar-w1")
+            t0 = threading.Thread(target=w0.connect, daemon=True)
+            t0.start()
+            w1.connect()
+            t0.join(10)
+            assert w0.rank is not None and w1.rank is not None
+
+            done = [threading.Event(), threading.Event()]
+
+            def bar(i, w):
+                w.barrier(GROUP_WORKERS)
+                done[i].set()
+
+            threading.Thread(target=bar, args=(0, w0), daemon=True).start()
+            time.sleep(0.4)  # w0's waiter is parked at the scheduler
+            assert not done[0].is_set()
+            port = sched.port
+            sched.crash()
+            time.sleep(0.2)
+            sched2 = Scheduler(2, 0, host="127.0.0.1", port=port)
+            sched2.start()
+            # peer re-sends its barrier after rejoining; both must pair
+            threading.Thread(target=bar, args=(1, w1), daemon=True).start()
+            assert done[0].wait(20), "parked barrier stranded across restart"
+            assert done[1].wait(20), "peer barrier stranded across restart"
+            w0.close()
+            w1.close()
+        finally:
+            _restore_env(old)
+            sched.stop()
+            if sched2 is not None:
+                sched2.stop()
+
+
+class TestReconnectDoesNotArmBarrierBypass:
+    def test_next_barrier_pairs_after_reconnect_rejoin(self):
+        """A control-plane RECONNECT (link hiccup, scheduler alive) must
+        NOT mark the conn recovered: the client never tears its runtime
+        down and runs no re-init barrier to consume the bypass, so the
+        node's next TRAINING barrier would release unpaired and desync
+        it from its peers (review finding)."""
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.comm.transport import close_socket
+
+        old = _set_env({**_FAST, "DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "0"})
+        counters().reset()
+        sched = Scheduler(2, 0, host="127.0.0.1")
+        sched.start()
+        os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+        old.setdefault("DMLC_PS_ROOT_PORT", None)
+        try:
+            cfg = Config.from_env()
+            w0 = PSClient(cfg, node_uid="byp-w0")
+            w1 = PSClient(cfg, node_uid="byp-w1")
+            t0 = threading.Thread(target=w0.connect, daemon=True)
+            t0.start()
+            w1.connect()
+            t0.join(10)
+            # hiccup w0's control link; the scheduler stays up
+            close_socket(w0._sched)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if counters().get("sched_rejoin") >= 1:
+                    break
+                time.sleep(0.05)
+            assert counters().get("sched_rejoin") >= 1
+
+            done = [threading.Event(), threading.Event()]
+            threading.Thread(
+                target=lambda: (w0.barrier(GROUP_WORKERS), done[0].set()),
+                daemon=True,
+            ).start()
+            # the rejoined conn must WAIT for its peer, not bypass
+            assert not done[0].wait(1.0), (
+                "reconnect rejoin armed the barrier bypass: barrier "
+                "released without the peer"
+            )
+            threading.Thread(
+                target=lambda: (w1.barrier(GROUP_WORKERS), done[1].set()),
+                daemon=True,
+            ).start()
+            assert done[0].wait(10) and done[1].wait(10)
+            w0.close()
+            w1.close()
+        finally:
+            _restore_env(old)
+            sched.stop()
+
+
+class TestReconnectScrubsStaleBarrierWaiter:
+    def test_parked_barrier_does_not_double_count_after_reconnect(self):
+        """A worker whose control link dies WHILE its barrier is parked
+        re-sends the barrier after rejoining; the scheduler must scrub
+        the dead connection's stale waiter at re-register — otherwise
+        the same rank counts twice and the barrier releases without its
+        peer (review finding)."""
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.comm.transport import close_socket
+
+        old = _set_env({**_FAST, "DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "0"})
+        counters().reset()
+        sched = Scheduler(2, 0, host="127.0.0.1")
+        sched.start()
+        os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+        old.setdefault("DMLC_PS_ROOT_PORT", None)
+        try:
+            cfg = Config.from_env()
+            w0 = PSClient(cfg, node_uid="scrub-w0")
+            w1 = PSClient(cfg, node_uid="scrub-w1")
+            t0 = threading.Thread(target=w0.connect, daemon=True)
+            t0.start()
+            w1.connect()
+            t0.join(10)
+            done = [threading.Event(), threading.Event()]
+            threading.Thread(
+                target=lambda: (w0.barrier(GROUP_WORKERS), done[0].set()),
+                daemon=True,
+            ).start()
+            time.sleep(0.4)  # w0's waiter is parked at the scheduler
+            close_socket(w0._sched)  # link dies UNDER the parked barrier
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if counters().get("sched_rejoin") >= 1:
+                    break
+                time.sleep(0.05)
+            assert counters().get("sched_rejoin") >= 1
+            # w0's retry re-sent its barrier — it must NOT release on the
+            # stale waiter + retry double-count; w1 never arrived
+            assert not done[0].wait(1.0), (
+                "stale barrier waiter double-counted the reconnected rank"
+            )
+            threading.Thread(
+                target=lambda: (w1.barrier(GROUP_WORKERS), done[1].set()),
+                daemon=True,
+            ).start()
+            assert done[0].wait(10) and done[1].wait(10)
+            w0.close()
+            w1.close()
+        finally:
+            _restore_env(old)
+            sched.stop()
+
+
+class TestRebirthWindowDuplicateRegister:
+    def test_same_uid_reregister_during_fill_replaces_not_appends(self):
+        """A rejoiner whose parked reply's conn dies redials and
+        re-REGISTERs the same uid while the rebirth window is still
+        filling — the entry must be REPLACED: a ghost append would steal
+        the node's own rank hint, inflate the population count, and
+        burn one of the first books on a dead socket (review finding)."""
+        sched = Scheduler(num_workers=2, num_servers=0, host="127.0.0.1",
+                          rejoin_window=30.0)
+        sched.start()
+        try:
+            payload1 = {"role": "worker", "host": "", "port": 0,
+                        "uid": "dup-w1", "num_workers": 2,
+                        "num_servers": 0, "last_rank": 1, "epoch": 1,
+                        "map_epoch": 1}
+            s1 = socket.create_connection(("127.0.0.1", sched.port), timeout=5)
+            send_message(s1, Message(
+                Op.REGISTER, payload=json.dumps(payload1).encode()
+            ))
+            time.sleep(0.3)  # parked (population 1/2); now the conn dies
+            s1.close()
+            s2 = socket.create_connection(("127.0.0.1", sched.port), timeout=5)
+            s2.settimeout(10)
+            send_message(s2, Message(
+                Op.REGISTER, payload=json.dumps(payload1).encode()
+            ))
+            time.sleep(0.3)
+            with sched._lock:
+                n_workers = len(sched._nodes["worker"])
+            assert n_workers == 1, (
+                f"duplicate uid created a ghost entry ({n_workers} nodes)"
+            )
+            # the peer completes the population → books emit correctly
+            s0, resp0 = _register_raw(sched.port, {
+                "role": "worker", "host": "", "port": 0, "uid": "dup-w0",
+                "num_workers": 2, "num_servers": 0, "last_rank": 0,
+                "epoch": 1, "map_epoch": 1,
+            }, timeout=10)
+            book1 = json.loads(recv_message(s2).payload.decode())
+            book0 = json.loads(resp0.payload.decode())
+            assert book1["rank"] == 1 and book0["rank"] == 0
+            assert book0["num_workers"] == 2
+            s0.close()
+            s2.close()
+        finally:
+            sched.stop()
+
+
+class TestHeartbeatSurvivesHiccup:
+    def test_transient_link_loss_hands_off_to_reconnect(self):
+        """Satellite fix: a single scheduler-link failure used to
+        silently end ALL future beats and metric deltas for the node
+        (the heartbeat loop's permanent ``return``).  Now it hands off
+        to the reconnect machine, re-registers against the SAME live
+        scheduler, and keeps beating."""
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.comm.transport import close_socket
+        from byteps_tpu.server.server import PSServer
+
+        old = _set_env({**_FAST, "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1"})
+        counters().reset()
+        sched = Scheduler(1, 1, host="127.0.0.1")
+        sched.start()
+        os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+        old.setdefault("DMLC_PS_ROOT_PORT", None)
+        try:
+            cfg = Config.from_env()
+            srv = PSServer(cfg)
+            threading.Thread(target=srv.start, daemon=True).start()
+            w = PSClient(cfg, node_uid="hic-w0")
+            w.connect()
+            inc0 = w.sched_incarnation
+            # transient hiccup: the link dies under the node, scheduler
+            # stays up
+            close_socket(w._sched)
+            # wait for the REJOIN itself (polling _sched_dead alone races
+            # the recv loop, which may not have noticed the close yet)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if counters().get("sched_rejoin") >= 1:
+                    break
+                time.sleep(0.05)
+            assert counters().get("sched_rejoin") >= 1, (
+                "reconnect machine never rejoined"
+            )
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                with w._sched_cb_lock:
+                    if not w._sched_dead:
+                        break
+                time.sleep(0.05)
+            with w._sched_cb_lock:
+                assert not w._sched_dead
+            assert w.sched_incarnation == inc0  # same scheduler, same life
+            assert w.rank == 0
+            # beats flow again: the scheduler's liveness stamp refreshes
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                live = w.query_cluster()
+                if live["worker"].get(0, 99) < 1.0:
+                    break
+                time.sleep(0.1)
+            assert live["worker"].get(0, 99) < 1.0, (
+                "heartbeats did not resume after the hiccup"
+            )
+            w.close()
+            srv.stop()
+        finally:
+            _restore_env(old)
+            sched.stop()
+
+
+class TestMetricsReship:
+    def test_reship_for_rebases_once_per_token(self):
+        from byteps_tpu.core.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counters.bump("rpc_retry", 3)
+        d1 = reg.delta_snapshot()
+        assert d1["c"]["rpc_retry"] == 3
+        reg.counters.bump("rpc_retry", 2)
+        # new consumer: full history ships, not the 2-delta
+        assert reg.reship_for(111) is True
+        d2 = reg.delta_snapshot()
+        assert d2["c"]["rpc_retry"] == 5
+        # idempotent per token: a second beat loop sharing this registry
+        # must NOT re-ship what the first already delivered
+        assert reg.reship_for(111) is False
+        reg.counters.bump("rpc_retry", 1)
+        assert reg.delta_snapshot()["c"]["rpc_retry"] == 1  # deltas resume
+
+    def test_reship_reregisters_gauges_and_drops_requeued(self):
+        from byteps_tpu.core.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.gauge_set("control_plane_degraded", 0)
+        d = reg.delta_snapshot()
+        assert any(g["n"] == "control_plane_degraded" for g in d.get("g", []))
+        assert not reg.delta_snapshot().get("g")  # unchanged → not re-sent
+        # a failed-send delta parked for requeue is SUBSUMED by the full
+        # re-ship (keeping it would double-count in the new aggregate)
+        reg.counters.bump("rpc_retry", 4)
+        lost = reg.delta_snapshot()
+        reg.requeue_delta(lost)
+        reg.reship_for(222)
+        d = reg.delta_snapshot()
+        assert d["c"]["rpc_retry"] == 4  # full totals, counted ONCE
+        assert any(g["n"] == "control_plane_degraded" for g in d.get("g", []))
+
+
+class TestChaosSchedulerLink:
+    def test_dropped_ping_costs_one_beat_not_the_loop(self, monkeypatch):
+        """BYTEPS_CHAOS_SCHED + BYTEPS_CHAOS_OPS=PING +
+        BYTEPS_CHAOS_TARGET_PORT=<scheduler> drops exactly the first
+        budgeted heartbeat frames on an otherwise healthy link; the
+        beat loop must absorb them (bounded request wait + requeue) and
+        keep beating once the budget is spent."""
+        from byteps_tpu.comm.chaos import reset_fault_budget
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.server.server import PSServer
+
+        sched_env = {
+            **_FAST,
+            "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+            "BYTEPS_VAN": "chaos:tcp",
+            "BYTEPS_CHAOS_SCHED": "1",
+            "BYTEPS_CHAOS_OPS": "PING",
+            "BYTEPS_CHAOS_DROP": "1.0",
+            "BYTEPS_CHAOS_SEED": "5",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.2",
+        }
+        old = _set_env(sched_env)
+        counters().reset()
+        sched = Scheduler(1, 1, host="127.0.0.1")
+        sched.start()
+        os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+        old.setdefault("DMLC_PS_ROOT_PORT", None)
+        os.environ["BYTEPS_CHAOS_TARGET_PORT"] = str(sched.port)
+        old.setdefault("BYTEPS_CHAOS_TARGET_PORT", None)
+        reset_fault_budget(2)
+        try:
+            cfg = Config.from_env()
+            srv = PSServer(cfg)
+            threading.Thread(target=srv.start, daemon=True).start()
+            w = PSClient(cfg, node_uid="chaos-hb-w0")
+            w.connect()
+            # the first budgeted PINGs die; once spent, beats land and
+            # the scheduler's worker stamp goes fresh again
+            deadline = time.time() + 30
+            fresh = False
+            while time.time() < deadline:
+                if counters().get("chaos_drop") >= 2:
+                    live = sched.liveness()
+                    if live["worker"].get(0, 99) < 1.0:
+                        fresh = True
+                        break
+                time.sleep(0.2)
+            assert counters().get("chaos_drop") >= 2, (
+                "scheduler-link faults never injected"
+            )
+            assert fresh, "heartbeats did not recover after the drops"
+            with w._sched_cb_lock:
+                assert not w._sched_dead  # link never died: drops only
+            w.close()
+            srv.stop()
+        finally:
+            reset_fault_budget(None)
+            _restore_env(old)
+            sched.stop()
+
+    def test_addrbook_drop_injectable_on_scheduler_side(self, monkeypatch):
+        """The scheduler's accepted control connections are chaos-wrapped
+        too (BYTEPS_CHAOS_SCHED), so scheduler→node frames (ADDRBOOK)
+        are deterministically faultable: the first book is dropped, a
+        re-register with the same uid gets the next one."""
+        from byteps_tpu.comm.chaos import reset_fault_budget
+
+        old = _set_env({
+            "BYTEPS_VAN": "chaos:tcp",
+            "BYTEPS_CHAOS_SCHED": "1",
+            "BYTEPS_CHAOS_OPS": "ADDRBOOK",
+            "BYTEPS_CHAOS_DROP": "1.0",
+            "BYTEPS_CHAOS_SEED": "5",
+            "BYTEPS_CHAOS_TARGET_PORT": "0",
+        })
+        counters().reset()
+        reset_fault_budget(1)
+        sched = Scheduler(1, 0, host="127.0.0.1")
+        sched.start()
+        try:
+            payload = {"role": "worker", "host": "", "port": 0,
+                       "uid": "book-drop-w0", "num_workers": 1,
+                       "num_servers": 0}
+            s1 = socket.create_connection(("127.0.0.1", sched.port), timeout=5)
+            s1.settimeout(2)
+            send_message(s1, Message(
+                Op.REGISTER, payload=json.dumps(payload).encode()
+            ))
+            with pytest.raises(OSError):  # book dropped → recv times out
+                recv_message(s1)
+            assert counters().get("chaos_drop") == 1
+            # budget spent: the rejoin's recovery book is delivered
+            s2, resp = _register_raw(sched.port, payload, timeout=5)
+            book = json.loads(resp.payload.decode())
+            assert book["rank"] == 0 and book["is_recovery"] is True
+            s1.close()
+            s2.close()
+        finally:
+            reset_fault_budget(None)
+            _restore_env(old)
+            sched.stop()
+
+
+class TestRejoinGraceWindow:
+    def test_partial_population_adopted_after_window(self):
+        """A reborn scheduler whose window expires with ranks missing
+        adopts the re-registered subset (rank hints honored, epochs
+        fenced) instead of stranding the survivors forever."""
+        sched = Scheduler(num_workers=2, num_servers=0, host="127.0.0.1",
+                          rejoin_window=0.6)
+        sched.start()
+        try:
+            s1 = socket.create_connection(("127.0.0.1", sched.port), timeout=5)
+            s1.settimeout(10)
+            t0 = time.monotonic()
+            send_message(s1, Message(Op.REGISTER, payload=json.dumps({
+                "role": "worker", "host": "", "port": 0, "uid": "grace-w1",
+                "num_workers": 2, "num_servers": 0,
+                "last_rank": 1, "epoch": 2, "map_epoch": 3,
+            }).encode()))
+            book = json.loads(recv_message(s1).payload.decode())
+            waited = time.monotonic() - t0
+            assert waited >= 0.5, "book shipped before the grace window"
+            assert book["rank"] == 1  # hint honored
+            assert book["num_workers"] == 1  # partial population adopted
+            assert book["map_epoch"] > 3 and book["epoch"] > 2
+            assert sched.num_workers == 1
+            assert sched.eviction_totals == {"worker": 0, "server": 0}
+
+            # a late reconnector is re-admitted at its old rank and the
+            # expectation grows back
+            s0, resp = _register_raw(sched.port, {
+                "role": "worker", "host": "", "port": 0, "uid": "grace-w0",
+                "num_workers": 2, "num_servers": 0,
+                "last_rank": 0, "epoch": 2, "map_epoch": 3,
+            }, timeout=5)
+            late = json.loads(resp.payload.decode())
+            assert late["rank"] == 0 and late["is_recovery"] is True
+            assert sched.num_workers == 2
+            s0.close()
+            s1.close()
+        finally:
+            sched.stop()
+
+    def test_fresh_first_boot_never_arms_the_window(self):
+        """Feature-off parity: first-boot registrants carry no rejoin
+        report, so the grace timer must never start and bring-up waits
+        for the full population exactly as before."""
+        sched = Scheduler(num_workers=2, num_servers=0, host="127.0.0.1",
+                          rejoin_window=0.3)
+        sched.start()
+        try:
+            s1 = socket.create_connection(("127.0.0.1", sched.port), timeout=5)
+            s1.settimeout(1.0)
+            send_message(s1, Message(Op.REGISTER, payload=json.dumps({
+                "role": "worker", "host": "", "port": 0, "uid": "boot-w0",
+                "num_workers": 2, "num_servers": 0,
+            }).encode()))
+            with pytest.raises(OSError):  # no book: population incomplete
+                recv_message(s1)
+            assert sched._grace_thread is None
+            assert not sched._addrbook_sent
+            s1.close()
+        finally:
+            sched.stop()

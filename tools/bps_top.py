@@ -110,6 +110,27 @@ def render(url: str, cur: Sample, prev: Sample, dt: float) -> str:
             for srv, i, v in sorted(depths)
         )
         lines.append(f"  stripe queue depth   : {cells}")
+    # control plane (docs/robustness.md "Control-plane recovery"): the
+    # scheduler incarnation the aggregate belongs to, how many expected
+    # nodes have not yet re-registered with it (nonzero only during a
+    # rebirth's rejoin window), and how many nodes report themselves in
+    # control_plane_degraded mode (scheduler link down, data plane
+    # still training on the last book)
+    inc = rejoining = None
+    degraded = 0
+    for (name, lbl), v in cur.items():
+        if name == "byteps_cluster_sched_incarnation":
+            inc = int(v)
+        elif name == "byteps_cluster_rejoining_nodes":
+            rejoining = int(v)
+        elif name == "byteps_control_plane_degraded" and v:
+            degraded += 1
+    if inc is not None or rejoining or degraded:
+        lines.append(
+            "  control plane        : "
+            + (f"incarnation {inc}" if inc is not None else "incarnation ?")
+            + f" | rejoining {rejoining or 0} | degraded {degraded}"
+        )
     # elastic resharding ownership (docs/robustness.md "migration flow"):
     # the scheduler aggregate carries the cluster map epoch plus each
     # server's heartbeat-shipped owned-key count and adopted epoch, so a

@@ -55,6 +55,7 @@ def run_soak(
     dim: int = 1024,
     one_sided: bool = False,
     reshard: bool = False,
+    sched_crash: int = -1,
 ) -> dict:
     """Run the soak in-process; returns a result dict (raises on any
     invariant violation).  Env mutations are process-wide — run via the
@@ -68,13 +69,27 @@ def run_soak(
     exhaust — so the run exercises the in-place heal end-to-end: give-up
     → Op.RESYNC_QUERY → journal replay → rejoin, no re-init barrier
     (docs/robustness.md "healing flow").  Asserts the heal actually
-    fired (``resync_attempt`` > 0)."""
+    fired (``resync_attempt`` > 0).
+
+    ``sched_crash``: hard-kill the SCHEDULER at that step (every control
+    fd closes with no goodbye — the in-process SIGKILL equivalent) and
+    restart it on the same address.  The run then asserts the
+    control-plane recovery contract (docs/robustness.md): training
+    stepped bitwise-correctly through the outage, every node
+    re-registered with the reborn incarnation within the rejoin window
+    with ZERO spurious evictions, the new incarnation's map epoch fences
+    above the old one, and — composed with ``reshard`` — a subsequent
+    live scale-up still works against the reborn scheduler."""
     if one_sided and servers < 2:
         raise ValueError("--one-sided needs --servers >= 2 (one victim, "
                          "one healthy control)")
     if reshard and servers < 2:
         raise ValueError("--reshard needs --servers >= 2 (keys must have "
                          "somewhere to migrate)")
+    if sched_crash >= 0 and reshard and sched_crash >= max(1, steps // 3):
+        raise ValueError("--sched-crash must land before the --reshard "
+                         "scale-up step (steps//3) so the resize runs "
+                         "against the REBORN scheduler")
     os.environ.update(
         {
             "BYTEPS_VAN": "chaos:tcp",
@@ -98,6 +113,11 @@ def run_soak(
             "BYTEPS_DEGRADED_STEP_RETRIES": "8",
             "BYTEPS_HEARTBEAT_INTERVAL": "0.1",
             "BYTEPS_DEAD_NODE_TIMEOUT_S": "0.8",
+            # control-plane recovery (docs/robustness.md): survive the
+            # --sched-crash outage and rejoin the reborn incarnation fast
+            "BYTEPS_SCHED_RECONNECT_RETRIES": "80",
+            "BYTEPS_SCHED_RECONNECT_BACKOFF_S": "0.05",
+            "BYTEPS_SCHED_REJOIN_WINDOW_S": "10",
             "BYTEPS_FORCE_DISTRIBUTED": "1",
             # live migration instead of re-init barriers on server-set
             # changes (docs/robustness.md "migration flow")
@@ -172,6 +192,7 @@ def run_soak(
     up_at, down_at = max(1, steps // 3), max(2, (2 * steps) // 3)
     extra = None
     drained_ok = True
+    sched_reborn = False
     try:
         bps.init()
         client = None
@@ -191,6 +212,48 @@ def run_soak(
                 ws[i] = ws[i] - lr * agg
             if step == crash_at and servers > 1:
                 fleet[-1].stop()  # involuntary: eviction must heal it
+            if step == sched_crash:
+                # hard-kill the SCHEDULER (in-process SIGKILL: every
+                # control fd closes with no goodbye frame) and restart
+                # it on the same address — nodes must ride through in
+                # control_plane_degraded mode and rejoin the new
+                # incarnation (docs/robustness.md "Control-plane
+                # recovery")
+                sc_inc0, sc_map0 = sched.incarnation, sched.map_epoch
+                sc_port = sched.port
+                sched.crash()
+                # steps THROUGH the outage, before the successor even
+                # binds: the data plane must not notice the control
+                # plane is gone
+                for i in range(n_shards):
+                    grad = 2.0 * ws[i]
+                    agg = np.asarray(bps.push_pull(
+                        grad, name=f"chaos_soak.w{i}", average=True
+                    ))
+                    np.testing.assert_array_equal(agg, grad)
+                    ws[i] = ws[i] - lr * agg
+                live = servers - (1 if 0 <= crash_at <= step else 0)
+                sched = Scheduler(
+                    num_workers=1, num_servers=live,
+                    host="127.0.0.1", port=sc_port,
+                )
+                sched.start()
+                # every node must re-register within the rejoin window
+                deadline = _time.monotonic() + 12
+                while _time.monotonic() < deadline:
+                    with sched._lock:
+                        if sched._addrbook_sent:
+                            break
+                    _time.sleep(0.05)
+                assert sched._addrbook_sent, (
+                    "fleet never re-registered with the reborn scheduler"
+                )
+                assert sched.incarnation > sc_inc0, "incarnation not minted"
+                assert sched.map_epoch > sc_map0, (
+                    f"reborn scheduler's map epoch {sched.map_epoch} did "
+                    f"not fence above the reported {sc_map0}"
+                )
+                sched_reborn = True
             if reshard and step == up_at:
                 # live scale-UP: declare the bigger topology from the
                 # live worker (the scheduler parks the reply until the
@@ -248,6 +311,24 @@ def run_soak(
         )
     if crash_at >= 0 and servers > 1:
         assert snap.get("server_evicted", 0) >= 1, f"no eviction seen: {snap}"
+    if sched_crash >= 0:
+        # control-plane recovery contract: full membership re-established
+        # against the new incarnation (asserted in-loop), with ZERO
+        # spurious evictions at rebirth — only a server deliberately
+        # crashed AFTER the restart may appear in the reborn totals
+        assert sched_reborn, "scheduler was never restarted"
+        assert sched.eviction_totals["worker"] == 0, (
+            f"spurious worker eviction at rebirth: {sched.eviction_totals}"
+        )
+        expected_srv_evictions = 1 if crash_at > sched_crash else 0
+        assert sched.eviction_totals["server"] == expected_srv_evictions, (
+            f"spurious server eviction at rebirth: {sched.eviction_totals}"
+        )
+        # every node (1 worker + the live servers) rejoined via the
+        # reconnect machine, and nobody fell back to the terminal latch
+        assert snap.get("sched_rejoin", 0) >= 2, (
+            f"nodes did not rejoin through the reconnect machine: {snap}"
+        )
     if reshard:
         # both resizes were LIVE migrations: keys moved between owners
         # with their ledgers, every pull above stayed bitwise, and the
@@ -291,6 +372,14 @@ def main() -> int:
                          "mid-run, then remove one — keys migrate with "
                          "their ledgers (BYTEPS_ELASTIC_RESHARD), every "
                          "pull stays bitwise, no re-init barrier fires")
+    ap.add_argument("--sched-crash", type=int, default=-1,
+                    help="step at which to hard-kill the scheduler and "
+                         "restart it on the same address: training must "
+                         "step bitwise through the outage, every node "
+                         "rejoin the new incarnation within the grace "
+                         "window with zero spurious evictions, and a "
+                         "subsequent --reshard scale-up still work "
+                         "against the reborn scheduler")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="watchdog: the soak must finish within this")
     args = ap.parse_args()
@@ -307,6 +396,7 @@ def main() -> int:
                     disconnect=args.disconnect, truncate=args.truncate,
                     corrupt=args.corrupt, crash_at=args.crash_at,
                     one_sided=args.one_sided, reshard=args.reshard,
+                    sched_crash=args.sched_crash,
                 )
             )
         except BaseException as e:  # noqa: BLE001
