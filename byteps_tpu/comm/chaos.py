@@ -121,6 +121,23 @@ def _next_ctrl_conn_index() -> int:
         return next(_ctrl_conn_counter)
 
 
+def reset_conn_indices() -> None:
+    """Restart both connection-index streams from their origins.
+
+    The per-socket fault RNG is keyed by (seed, connection index), and
+    the index is process-global — a seeded chaos schedule therefore
+    depends on how many chaos connections EARLIER tests in the same
+    process happened to open.  Deterministic chaos tests call this at
+    setup so their schedule is canonical (indices from 0) no matter
+    which sub-suite combination runs them — the order-dependence that
+    made test_fusion's ``[native-s4]`` lane flake across pytest
+    selections.  Test-harness only: live jobs never reset mid-run."""
+    global _conn_counter, _ctrl_conn_counter
+    with _conn_counter_lock:
+        _conn_counter = itertools.count()
+        _ctrl_conn_counter = itertools.count(1 << 16)
+
+
 def control_chaos_enabled() -> bool:
     """True when the process opted the scheduler link into fault
     injection: a chaos van is selected AND ``BYTEPS_CHAOS_SCHED=1``."""

@@ -74,6 +74,26 @@ class Config:
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES (global.cc:43,137)
     threadpool_size: int = 4  # BYTEPS_THREADPOOL_SIZE (global.cc:216)
 
+    # --- adaptive compression (docs/gradient-compression.md "Compressed
+    # wire path") ---
+    # telemetry-driven codec selection: the COMPRESS stage tracks each
+    # key's observed wire ratio (compressed bytes / raw bytes) and, after
+    # the probe rounds, DISABLES the codec for keys where compression is
+    # a loss (ratio above the cutoff — tiny tensors, k too close to n,
+    # codec overhead beating the savings).  Disabling is worker-local and
+    # per-key: the server's chain stays registered and serves raw pushes/
+    # pulls for that key correctly (mixed-config rule), so no wire
+    # coordination is needed.  Off by default — the configured codec is
+    # a user decision until the operator opts into the policy.
+    compression_auto: bool = False  # BYTEPS_COMPRESSION_AUTO
+    # observed-ratio cutoff: a key whose mean wire ratio over the probe
+    # rounds is >= this stops compressing (1.0 = only when compression
+    # INFLATES the payload; the 0.9 default also drops near-break-even
+    # codecs that pay CPU for <10% wire savings)
+    compression_auto_ratio: float = 0.9  # BYTEPS_COMPRESSION_AUTO_RATIO
+    # rounds observed per key before the policy verdict
+    compression_auto_rounds: int = 3  # BYTEPS_COMPRESSION_AUTO_ROUNDS
+
     # --- small-tensor fusion (docs/perf.md) ---
     # partitions at or below this many BYTES take the FUSE stage: same-
     # server neighbors are packed into one multi-key Op.FUSED RPC instead
@@ -252,6 +272,14 @@ class Config:
             scheduling=os.environ.get("BYTEPS_SCHEDULING", "priority"),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             threadpool_size=_env_int("BYTEPS_THREADPOOL_SIZE", 4),
+            compression_auto=_env_bool("BYTEPS_COMPRESSION_AUTO"),
+            compression_auto_ratio=float(
+                os.environ.get("BYTEPS_COMPRESSION_AUTO_RATIO", "0.9")
+                or "0.9"
+            ),
+            compression_auto_rounds=max(
+                1, _env_int("BYTEPS_COMPRESSION_AUTO_ROUNDS", 3)
+            ),
             fusion_threshold=max(0, _env_int("BYTEPS_FUSION_THRESHOLD", 0)),
             fusion_bytes=max(1, _env_int("BYTEPS_FUSION_BYTES", 262144)),
             fusion_cycle_ms=max(0.0, float(

@@ -22,14 +22,41 @@ _LEVELS = {
     "FATAL": _pylog.CRITICAL,
 }
 
+class _StderrProxy:
+    """Late-binding stderr: resolve ``sys.stderr`` at EMIT time, not at
+    import.  A handler that captures the stream object at import keeps
+    writing to whatever stderr was then — a host app (or test harness)
+    that swaps ``sys.stderr`` afterwards would silently lose our logs."""
+
+    def write(self, s):
+        return sys.stderr.write(s)
+
+    def flush(self):
+        return sys.stderr.flush()
+
+
 logger = _pylog.getLogger("byteps_tpu")
 if not logger.handlers:
-    _h = _pylog.StreamHandler(sys.stderr)
+    _h = _pylog.StreamHandler(_StderrProxy())
     _h.setFormatter(
         _pylog.Formatter("[%(asctime)s] BYTEPS %(levelname)s %(message)s", "%H:%M:%S")
     )
     logger.addHandler(_h)
-logger.setLevel(_LEVELS.get(os.environ.get("BYTEPS_LOG_LEVEL", "WARNING").upper(), _pylog.WARNING))
+
+
+def apply_env_level() -> None:
+    """(Re-)apply ``BYTEPS_LOG_LEVEL``.  Called at import AND at every
+    runtime init: the level must track the environment the runtime was
+    started under, not whichever import happened to load this module
+    first (a long-lived process — or a test session — that sets the env
+    var later would otherwise be stuck with the frozen level)."""
+    logger.setLevel(_LEVELS.get(
+        os.environ.get("BYTEPS_LOG_LEVEL", "WARNING").upper(),
+        _pylog.WARNING,
+    ))
+
+
+apply_env_level()
 
 
 def trace(msg, *a):

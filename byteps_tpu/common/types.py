@@ -261,6 +261,12 @@ class TensorTableEntry:
     # members already passed their per-key round gates at the FUSE queue,
     # re-gating the pack under its route key would deadlock it)
     gate_exempt: bool = False
+    # fusion staging accounting: True from submit (a FUSE-routed task
+    # enters the engine's staged-smalls window) until the task reaches
+    # the fusion buffer or dies — the engine's idle-flush check must
+    # never miss a small that is still upstream of the FUSE queue
+    # (in COPYD2H, or in COMPRESS on the compressed-fused pipeline)
+    fuse_staged: bool = False
     # distributed tracing (docs/observability.md): the job's trace id and
     # this partition-task's span id — propagated on every framed RPC the
     # task issues, so server-side child spans join the worker timeline.

@@ -34,6 +34,16 @@ class Compressor(abc.ABC):
         (server-side SUM_RECV).  Default: densify then add."""
         acc += self.decompress(payload, acc.size)
 
+    def wire_nbytes(self) -> int:
+        """Worst-case compressed payload size in bytes — the codec wire
+        formats are size-deterministic, so this is exact for every codec
+        shipped.  Feeds the FUSE-stage routing decision: a compressed
+        partition fuses when its WIRE size fits the fusion threshold,
+        not its raw size (docs/gradient-compression.md "Compressed wire
+        path").  Default: the uncompressed fp32 size (no savings
+        assumed)."""
+        return self.size * 4
+
     def update_error(self, corrected: np.ndarray, payload: bytes) -> np.ndarray:
         """e = corrected − decompress(compress(corrected)) — the
         FastUpdateError hook (error_feedback.h:46-90)."""

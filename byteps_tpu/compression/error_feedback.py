@@ -43,3 +43,6 @@ class VanillaErrorFeedback(Compressor):
 
     def sum_into(self, payload: bytes, acc: np.ndarray) -> None:
         self.inner.sum_into(payload, acc)
+
+    def wire_nbytes(self) -> int:
+        return self.inner.wire_nbytes()

@@ -31,6 +31,9 @@ class OneBitCompressor(Compressor):
         super().__init__(size)
         self.scaling = scaling
 
+    def wire_nbytes(self) -> int:
+        return 4 + 4 * ((self.size + 31) // 32)
+
     def compress(self, grad: np.ndarray) -> bytes:
         grad = np.ascontiguousarray(grad, dtype=np.float32)
         n = grad.size
@@ -67,6 +70,9 @@ class TopKCompressor(Compressor):
         super().__init__(size)
         self.k = max(1, min(int(k), size))
 
+    def wire_nbytes(self) -> int:
+        return 8 * self.k
+
     def compress(self, grad: np.ndarray) -> bytes:
         grad = np.ascontiguousarray(grad, dtype=np.float32)
         n, k = grad.size, min(self.k, grad.size)
@@ -102,6 +108,8 @@ class RandomKCompressor(Compressor):
         self.k = max(1, min(int(k), size))
         self.s0, self.s1 = seed_pair_from(seed)
 
+    wire_nbytes = TopKCompressor.wire_nbytes
+
     def compress(self, grad: np.ndarray) -> bytes:
         grad = np.ascontiguousarray(grad, dtype=np.float32)
         n, k = grad.size, min(self.k, grad.size)
@@ -134,6 +142,9 @@ class DitheringCompressor(Compressor):
         self.natural = 1 if partition in ("natural", "1", 1) else 0
         self.l2 = 1 if normalize in ("l2", "L2", "1", 1) else 0
         self.s0, self.s1 = seed_pair_from(seed)
+
+    def wire_nbytes(self) -> int:
+        return 4 + self.size
 
     def compress(self, grad: np.ndarray) -> bytes:
         grad = np.ascontiguousarray(grad, dtype=np.float32)

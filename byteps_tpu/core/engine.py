@@ -274,6 +274,19 @@ class PipelineEngine:
     STAGES_FUSED = [
         QueueType.COPYD2H, QueueType.FUSE, QueueType.PULL, QueueType.COPYH2D,
     ]
+    #: compressed wire path × fusion (docs/gradient-compression.md
+    #: "Compressed wire path"): a compressed partition whose WIRE size
+    #: (codec wire_nbytes) fits the fusion threshold rides the fuser like
+    #: any small partition — its member cmd carries
+    #: RequestType.COMPRESSED_PUSH_PULL so the server sums it through the
+    #: key's codec chain, and the fused reply slot comes back
+    #: codec-compressed for the DECOMPRESS stage to decode.  The two
+    #: headline wire optimizations finally multiply instead of excluding
+    #: each other.
+    STAGES_COMPRESSED_FUSED = [
+        QueueType.COPYD2H, QueueType.COMPRESS, QueueType.FUSE,
+        QueueType.PULL, QueueType.DECOMPRESS, QueueType.COPYH2D,
+    ]
 
     #: monotonically increasing engine-instance id: the tensor registry
     #: (and each ctx's ``initialized`` flag) outlives shutdown()/init()
@@ -352,6 +365,15 @@ class PipelineEngine:
         # inversion of the reference's CPU-post-staging compress
         # (core_loops.cc:498-536; SURVEY §7's genuine TPU improvement)
         self._device_codecs: Dict[int, object] = {}
+        # adaptive compression (BYTEPS_COMPRESSION_AUTO): keys whose
+        # observed wire ratio made the codec a loss — their later rounds
+        # take the raw pipeline (the codec chain and the server-side
+        # registration stay put: servers serve raw pushes/pulls on a
+        # codec-registered key correctly, the mixed-config rule, so the
+        # policy needs no wire coordination).  _auto_stats accumulates
+        # (rounds, compressed bytes, raw bytes) per key until the verdict.
+        self._compression_auto_off: set = set()
+        self._auto_stats: Dict[int, list] = {}
         self._compression_lr: float = 1.0
         self._lr_sent_to_servers: float = 1.0
         # tensor names whose last job failed degraded: their next submit
@@ -476,21 +498,42 @@ class PipelineEngine:
             np_dtype=np_dtype, is_jax=is_jax, version=ctx.version,
             device_parts={} if on_device else None,
         )
-        compressed = ctx.partitions and ctx.partitions[0].key in self._compressors
-        stages = self.STAGES_COMPRESSED if compressed else self.STAGES
-        # small-tensor fusion: uncompressed partitions at or below the
-        # threshold take FUSE instead of PUSH (compressed partitions keep
-        # their own RPC — their wire size is codec-dependent, and the
-        # default MIN_COMPRESS_BYTES floor keeps genuinely small tensors
-        # out of the codec path anyway)
-        fuse_limit = 0 if compressed else self.cfg.fusion_threshold
+        # small-tensor fusion routing, per partition: uncompressed
+        # partitions gauge their RAW size against the threshold;
+        # compressed partitions gauge their WIRE size (codec wire_nbytes
+        # — the bytes that actually ride the frame), so a 256KB tensor
+        # whose onebit payload is 8KB fuses like any small tensor
+        # (docs/gradient-compression.md "Compressed wire path").  Device-
+        # codec jobs never fuse: their decoded partitions assemble on
+        # device and the fused reply delivery writes a host result
+        # buffer those jobs deliberately never allocate.
+        fuse_limit = self.cfg.fusion_threshold
         itemsize = np_dtype.itemsize
         if self._traced():
             from byteps_tpu.core.tracing import new_trace_id
 
             job.trace_id = new_trace_id()
         for part in ctx.partitions:
-            small = fuse_limit and part.length * itemsize <= fuse_limit
+            p_compressed = (
+                part.key in self._compressors
+                and part.key not in self._compression_auto_off
+            )
+            if job.device_parts is not None:
+                small = False
+                qlist = self.STAGES_COMPRESSED
+            elif p_compressed:
+                wire_est = self._compressors[part.key].wire_nbytes()
+                small = bool(fuse_limit) and wire_est <= fuse_limit
+                qlist = (
+                    self.STAGES_COMPRESSED_FUSED if small
+                    else self.STAGES_COMPRESSED
+                )
+            else:
+                small = (
+                    bool(fuse_limit)
+                    and part.length * itemsize <= fuse_limit
+                )
+                qlist = self.STAGES_FUSED if small else self.STAGES
             if small:
                 with self._fuse_lock:
                     self._staged_smalls += 1
@@ -502,8 +545,9 @@ class PipelineEngine:
                 offset=part.offset,
                 length=part.length,
                 total_partnum=len(ctx.partitions),
-                queue_list=list(self.STAGES_FUSED if small else stages),
+                queue_list=list(qlist),
                 context=job,
+                fuse_staged=bool(small),
             )
             self._stamp_task_trace(task, job)
             self.queues[QueueType.COPYD2H].add_task(task)
@@ -583,7 +627,18 @@ class PipelineEngine:
                     else:
                         self.client.init_tensor(part.key, part.length, dtype_id)
                 if ctx.initialized:
-                    self._reship_compressors(ctx)
+                    if (on_first_init is not None and not any(
+                            p.key in self._compressors
+                            for p in ctx.partitions)):
+                        # registry-surviving tensor on a NEW engine (a
+                        # shutdown()/init() cycle): this engine holds no
+                        # codec chains for it, so re-run the compressor
+                        # setup like a first init — reshipping an empty
+                        # chain set would silently drop the tensor to
+                        # raw for the rest of the process
+                        on_first_init()
+                    else:
+                        self._reship_compressors(ctx)
                     ctx.version = 0
                     for part in ctx.partitions:
                         self._seeded.discard(part.key)
@@ -887,6 +942,10 @@ class PipelineEngine:
                 return
             task.failed = True
             job.failed = True  # abort fence: stops sibling tasks' retries
+        # a FUSE-routed task that died before reaching the fusion buffer
+        # must leave the staging window, or the pinned counter disables
+        # idle flushing forever
+        self._unstage_small(task)
         self.queues[stage].report_finish(task)
         self._push_ready.add_ready_count(task.key)
         self.queues[QueueType.PUSH].notify()
@@ -1053,28 +1112,31 @@ class PipelineEngine:
         staging, core_loops.cc:498-536): the Pallas/jnp packer runs on the
         DEVICE slice first, and what crosses the device→host boundary here
         is the compressed payload — 32× less for onebit."""
-        # a small (FUSE-routed) partition leaves the staging window only
-        # once it is visible downstream: _proceed enqueues it into the
-        # FUSE queue BEFORE the counter drops, so the fuser's idle check
-        # (staged == 0 AND fuse queue empty) can never miss it.  The
-        # finally also covers the failure path, or a staging error would
-        # pin the counter and disable idle flushing forever.
-        small = len(task.queue_list) > 1 and task.queue_list[1] == QueueType.FUSE
-        try:
-            job: _Job = task.context
-            if job.device_parts is not None:
-                dc = self._device_codecs[task.key]
-                sl = job.flat[task.offset : task.offset + task.length]
-                task.compressed = dc.compress(sl)  # D2H of the packed payload
-                self._proceed(task)
-                return
+        job: _Job = task.context
+        if job.device_parts is not None:
+            dc = self._device_codecs[task.key]
             sl = job.flat[task.offset : task.offset + task.length]
-            task.cpubuff = sl if isinstance(sl, np.ndarray) else np.asarray(sl)
+            task.compressed = dc.compress(sl)  # D2H of the packed payload
             self._proceed(task)
-        finally:
-            if small:
-                with self._fuse_lock:
-                    self._staged_smalls -= 1
+            return
+        sl = job.flat[task.offset : task.offset + task.length]
+        task.cpubuff = sl if isinstance(sl, np.ndarray) else np.asarray(sl)
+        self._proceed(task)
+
+    def _unstage_small(self, task: TensorTableEntry) -> None:
+        """A FUSE-routed task left the staging window: it reached the
+        fusion buffer (visible to the drain) or died upstream.  Exactly
+        once per task — the idle-flush check (staged == 0 AND FUSE queue
+        empty) must neither miss a small still in COPYD2H/COMPRESS nor
+        stay pinned by one that failed there.  The test-and-clear runs
+        under the fuse lock: _fuse_once and a racing _fail_task (a
+        sibling's failure fanning out mid-stage) must not both
+        decrement, or the counter goes negative and idle flush never
+        fires again."""
+        with self._fuse_lock:
+            if task.fuse_staged:
+                task.fuse_staged = False
+                self._staged_smalls -= 1
 
     def _compress_once(self, task: TensorTableEntry) -> None:
         """COMPRESS stage (core_loops.cc:498-536): run the codec chain on
@@ -1088,21 +1150,87 @@ class PipelineEngine:
             self._proceed(task)
             return
         codec = self._compressors[task.key]
+        raw_nbytes = task.cpubuff.nbytes
         task.compressed = codec.compress(task.cpubuff)
+        # wire-savings telemetry + the adaptive-compression policy feed
+        # (docs/gradient-compression.md "Codec auto-selection")
+        self._note_compression(task.key, raw_nbytes, len(task.compressed))
         self._proceed(task)
+
+    def _note_compression(self, key: int, raw_nbytes: int,
+                          comp_nbytes: int) -> None:
+        """Record one compression's observed wire outcome and, with
+        BYTEPS_COMPRESSION_AUTO on, run the per-key policy: after the
+        probe rounds a key whose mean wire ratio (compressed/raw) is at
+        or above the cutoff stops compressing — its later rounds take
+        the raw pipeline (tiny tensors, k too close to n, codec overhead
+        beating the savings).  Worker-local and per-key: the server
+        serves raw traffic on a codec-registered key correctly (the
+        mixed-config rule), so no wire coordination is needed.  Runs on
+        the key's COMPRESS stripe thread, so per-key stats never race."""
+        from byteps_tpu.core.telemetry import RATIO_BUCKETS, counters, metrics
+
+        if comp_nbytes < raw_nbytes:
+            counters().bump("wire_bytes_saved", raw_nbytes - comp_nbytes)
+        # unlabeled on purpose: a per-key label would mint one histogram
+        # series per compressed partition (unbounded cardinality — every
+        # other label in the registry is bounded); the policy keeps its
+        # per-key state in _auto_stats, and per-key wire sizes are
+        # observable server-side via native_request_bytes{key}
+        metrics().observe(
+            "compression_ratio", comp_nbytes / max(1, raw_nbytes),
+            buckets=RATIO_BUCKETS,
+        )
+        if not self.cfg.compression_auto or key in self._compression_auto_off:
+            return
+        st = self._auto_stats.get(key, False)
+        if st is None:
+            return  # probe complete, verdict was KEEP — stop tracking
+        if st is False:
+            st = self._auto_stats[key] = [0, 0, 0]
+        st[0] += 1
+        st[1] += comp_nbytes
+        st[2] += raw_nbytes
+        if st[0] < self.cfg.compression_auto_rounds:
+            return
+        ratio = st[1] / max(1, st[2])
+        if ratio < self.cfg.compression_auto_ratio:
+            self._auto_stats[key] = None  # keep the codec; one verdict
+            return
+        self._auto_stats.pop(key, None)
+        from byteps_tpu.common import logging as bpslog
+
+        # one verdict per key per engine (either way): the shipped
+        # codecs' wire sizes are size-deterministic, so the observed
+        # ratio cannot drift across the cutoff later
+        self._compression_auto_off.add(key)
+        counters().bump("compression_auto_off")
+        bpslog.warning(
+            "compression auto-disabled for key %d: observed wire "
+            "ratio %.3f >= %.3f over %d rounds (BYTEPS_COMPRESSION_"
+            "AUTO); later rounds push raw", key, ratio,
+            self.cfg.compression_auto_ratio, st[0],
+        )
 
     def _fuse_once(self, task: TensorTableEntry) -> None:
         """FUSE stage: stage a small partition into its destination
         server's fusion buffer instead of issuing a per-key push RPC.
-        Tasks leave the FUSE queue in priority order (and round-gated per
-        key, same as PUSH), so packs fill highest-priority-first; the
-        flushed group then re-enters the PUSH queue carrying the max
-        member priority."""
-        buf = task.cpubuff
-        payload = (
-            buf.data.cast("B") if buf.flags.c_contiguous else buf.tobytes()
-        )
+        Compressed members (the COMPRESSED_FUSED pipeline) stage their
+        codec wire bytes — what rides the member slot is exactly what an
+        unfused compressed push would have sent.  Tasks leave the FUSE
+        queue in priority order (and round-gated per key, same as PUSH),
+        so packs fill highest-priority-first; the flushed group then
+        re-enters the PUSH queue carrying the max member priority."""
+        if task.compressed is not None:
+            payload = task.compressed
+        else:
+            buf = task.cpubuff
+            payload = (
+                buf.data.cast("B") if buf.flags.c_contiguous
+                else buf.tobytes()
+            )
         self._fuser.add(task, payload)
+        self._unstage_small(task)
         with self._fuse_lock:
             staging = self._staged_smalls
         if staging == 0 and self.queues[QueueType.FUSE].pending() == 0:
@@ -1110,9 +1238,9 @@ class PipelineEngine:
             # buffer and none wait in the FUSE queue — this burst is over,
             # ship what we have rather than paying the cycle-timer latency
             # on every quiet round.  (Checking the FUSE queue alone is not
-            # enough: COPYD2H feeds us one task at a time and a popped-
-            # but-unstaged task is invisible to pending() — that's what
-            # the _staged_smalls counter tracks.)
+            # enough: the upstream stages feed us one task at a time and a
+            # popped-but-unstaged task is invisible to pending() — that's
+            # what the _staged_smalls counter tracks.)
             self._fuser.drain_idle()
 
     def _push_group(self, group_task: TensorTableEntry, group: _FusionGroup) -> None:
@@ -1144,21 +1272,33 @@ class PipelineEngine:
                 self._unfuse_members(group, "server set resized under pack")
             return
 
+        # per-member compressed flag: the member cmd Cantor-encodes the
+        # request type, so a compressed member rides the SAME fused frame
+        # as raw siblings with COMPRESSED_PUSH_PULL in its cmd — the
+        # server routes it through the key's codec chain (decompress or
+        # sparse-sum) and returns its reply slot codec-compressed.  Old
+        # decoders already parse the cmd field, so no new wire bit is
+        # needed (docs/gradient-compression.md "Compressed wire path").
         wire = [
             (
                 mtask.key,
                 get_command_type(
-                    RequestType.DEFAULT_PUSH_PULL, mtask.context.dtype_id
+                    RequestType.COMPRESSED_PUSH_PULL
+                    if mtask.compressed is not None
+                    else RequestType.DEFAULT_PUSH_PULL,
+                    mtask.context.dtype_id,
                 ),
                 mtask.version,
                 payload,
             )
             for mtask, payload in members
         ]
+        nbytes = sum(len(p) for _, _, _, p in wire)
         if self.telemetry is not None:
-            self.telemetry.record(sum(len(p) for _, _, _, p in wire))
+            self.telemetry.record(nbytes)
         counters().bump("fused_frames")
         counters().bump("fused_keys", len(members))
+        counters().bump("wire_tx_bytes", nbytes)
         if self._journal is not None:
             # each member journals individually: a resync replay re-sends
             # them as plain per-key pushes, which the server sums through
@@ -1276,6 +1416,9 @@ class PipelineEngine:
             rtype = RequestType.DEFAULT_PUSH_PULL
         if self.telemetry is not None:
             self.telemetry.record(len(payload))
+        from byteps_tpu.core.telemetry import counters
+
+        counters().bump("wire_tx_bytes", len(payload))
         if self._journal is not None:
             # recovery plane: journal the exact wire payload BEFORE the
             # send, so a give-up on this very RPC can already replay it
@@ -1298,25 +1441,44 @@ class PipelineEngine:
         """ZPull into the result buffer (RunPullLoopOnce,
         core_loops.cc:584-618)."""
         job: _Job = task.context
+        # compressed-ness is a property of the TASK's pipeline, not of the
+        # key: an auto-disabled key keeps its registered codec chain but
+        # its later rounds ride the raw pipeline, and the pull must match
+        # what this round's push actually sent
+        compressed = (
+            len(task.queue_list) > 1
+            and task.queue_list[1] == QueueType.DECOMPRESS
+        )
         if task.fused_reply is not None:
             # fused member: the multi-key reply already carried this key's
-            # merged round — deliver straight into the partition's slice of
-            # the result buffer (the zero-copy sink destination), no wire
-            # pull
+            # merged round — deliver locally, no wire pull.  Compressed
+            # members' reply slots are codec-compressed (the server
+            # compressed the merged round once); route them to DECOMPRESS
+            # exactly like an unfused compressed pull's payload.
             payload = task.fused_reply
             task.fused_reply = None
             if self.telemetry is not None:
                 self.telemetry.record(len(payload))
-            arr = np.frombuffer(payload, dtype=job.np_dtype)
-            job.result[task.offset : task.offset + task.length] = arr[: task.length]
+            from byteps_tpu.core.telemetry import counters
+
+            counters().bump("wire_rx_bytes", len(payload))
+            if compressed:
+                task.compressed = payload  # decoded by DECOMPRESS stage
+            else:
+                arr = np.frombuffer(payload, dtype=job.np_dtype)
+                job.result[task.offset : task.offset + task.length] = (
+                    arr[: task.length]
+                )
             self._proceed(task)
             return
-        compressed = task.key in self._compressors
 
         if job.rowsparse is not None:
             def on_rs_pull(payload: bytes) -> None:
+                from byteps_tpu.core.telemetry import counters
+
                 if self.telemetry is not None:
                     self.telemetry.record(len(payload))
+                counters().bump("wire_rx_bytes", len(payload))
                 arr = np.frombuffer(payload, dtype=job.np_dtype)
                 job.result[: arr.size] = arr
                 self._proceed(task)
@@ -1346,16 +1508,19 @@ class PipelineEngine:
 
         def on_pull(payload) -> None:
             from byteps_tpu.comm.ps_client import _ZERO_COPIED
+            from byteps_tpu.core.telemetry import counters
 
+            # actual WIRE bytes: a zero-copy sink is always the full
+            # uncompressed partition; otherwise len(payload) is the
+            # real (possibly compressed) transfer size
+            nbytes = (
+                task.length * job.np_dtype.itemsize
+                if payload is _ZERO_COPIED
+                else len(payload)
+            )
             if self.telemetry is not None:
-                # actual WIRE bytes: a zero-copy sink is always the full
-                # uncompressed partition; otherwise len(payload) is the
-                # real (possibly compressed) transfer size
-                self.telemetry.record(
-                    task.length * job.np_dtype.itemsize
-                    if payload is _ZERO_COPIED
-                    else len(payload)
-                )
+                self.telemetry.record(nbytes)
+            counters().bump("wire_rx_bytes", nbytes)
             if payload is _ZERO_COPIED:
                 pass  # already in job.result via the sink
             elif compressed:

@@ -110,6 +110,11 @@ def init_state(fresh_env: bool = True) -> RuntimeState:
         # rewrites DMLC_* then re-initializes (operations.cc:96-112)
         cfg = reset_config() if fresh_env else get_config()
         st.config = cfg
+        # log level tracks the env this runtime was started under, not
+        # whichever import first loaded the logging module
+        from byteps_tpu.common import logging as bpslog
+
+        bpslog.apply_env_level()
         st.registry = get_registry()
         # multi-host JAX runtime (pod slices): opt-in coordinator bring-up —
         # the scheduler-node analogue for the ICI/DCN collective plane

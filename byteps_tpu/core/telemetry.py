@@ -279,6 +279,14 @@ SIZE_BUCKETS: Tuple[float, ...] = (
     64, 256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216,
 )
 
+#: compression wire-ratio buckets (compressed bytes / raw bytes): dense
+#: below 1.0 where the codecs live (onebit ~0.03, topk 2k/n, dithering
+#: ~0.25), with >1 buckets so inflation — the adaptive policy's disable
+#: signal — is visible in the same histogram
+RATIO_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.02, 0.05, 0.1, 0.2, 0.35, 0.5, 0.75, 0.9, 1.0, 1.5, 2.0,
+)
+
 
 class Histogram:
     """Fixed-bucket histogram with cheap percentile snapshots.

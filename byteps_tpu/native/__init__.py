@@ -147,6 +147,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             lib.bps_native_server_set_ownership.restype = None
         lib.bps_wire_golden.argtypes = [c.c_void_p, c.c_uint64]
         lib.bps_wire_golden.restype = c.c_int64
+        # compressed-wire-path fixtures (may be absent in a stale .so;
+        # the golden test skips that lane rather than failing it)
+        if hasattr(lib, "bps_wire_golden_compressed"):
+            lib.bps_wire_golden_compressed.argtypes = [c.c_void_p, c.c_uint64]
+            lib.bps_wire_golden_compressed.restype = c.c_int64
         lib.bps_wire_fused_echo.argtypes = [
             c.c_void_p, c.c_uint64, c.c_void_p, c.c_uint64,
         ]

@@ -2811,6 +2811,90 @@ int64_t bps_wire_golden(uint8_t* out, uint64_t cap) {
   return (int64_t)buf.size();
 }
 
+// Compressed-wire-path fixtures (docs/gradient-compression.md
+// "Compressed wire path"): a fused PUSH frame whose members carry the
+// per-member compressed flag — RequestType kCompressedPushPull Cantor-
+// encoded in the member cmd — alongside a raw sibling, WITH the
+// member-span trailer (old decoders ignore it, pinned separately), and
+// the codec-compressed fused REPLY through the LIVE reply encoder.
+// A separate fixture stream from bps_wire_golden so the original frozen
+// digest stays untouched (these frames EXTEND the fixture set; the
+// existing frames' bytes are unchanged).  Returns bytes written, or
+// -(needed) when cap is too small.
+int64_t bps_wire_golden_compressed(uint8_t* out, uint64_t cap) {
+  std::vector<uint8_t> buf;
+  auto put_header = [&](uint8_t op, uint8_t status, uint8_t flags,
+                        uint32_t seq, uint64_t key, uint32_t cmd,
+                        uint32_t version, uint64_t len) {
+    Header h;
+    pack_header(&h, op, status, flags, seq, key, cmd, version, len);
+    const uint8_t* p = (const uint8_t*)&h;
+    buf.insert(buf.end(), p, p + sizeof(h));
+  };
+  auto put_bytes = [&](const void* p, size_t n) {
+    buf.insert(buf.end(), (const uint8_t*)p, (const uint8_t*)p + n);
+  };
+  // member cmds: Cantor (rtype, dtype=f32) — compressed rtype 2 → 3,
+  // default rtype 0 → 0 (common.cc:98 pairing; the "compressed flag"
+  // IS the member cmd, no new wire bit)
+  const uint32_t kCmdCompressedF32 = 3, kCmdDefaultF32 = 0;
+  // onebit-shaped compressed payload: f32 scale + two u32 sign words
+  // (little-endian, compressor.cc wire format), fixed bytes both sides
+  const uint8_t onebit_payload[12] = {0x00, 0x00, 0x00, 0x3F,   // 0.5f LE
+                                      0xEF, 0xBE, 0xAD, 0xDE,
+                                      0x67, 0x45, 0x23, 0x01};
+  const uint8_t raw_payload[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+  // G: fused PUSH body — count 2, compressed member + raw member, then
+  // the 2×u64 member-span trailer (transport.encode_fused_push layout)
+  std::vector<uint8_t> body;
+  auto put_member = [&](uint64_t key, uint32_t cmd, uint32_t ver,
+                        const uint8_t* p, uint64_t n) {
+    uint64_t key_be = htobe64(key), len_be = htobe64(n);
+    uint32_t cmd_be = htonl(cmd), ver_be = htonl(ver);
+    uint8_t m[24];
+    std::memcpy(m, &key_be, 8);
+    std::memcpy(m + 8, &cmd_be, 4);
+    std::memcpy(m + 12, &ver_be, 4);
+    std::memcpy(m + 16, &len_be, 8);
+    body.insert(body.end(), m, m + 24);
+    body.insert(body.end(), p, p + n);
+  };
+  uint32_t count_be = htonl(2);
+  body.insert(body.end(), (uint8_t*)&count_be, (uint8_t*)&count_be + 4);
+  put_member(301, kCmdCompressedF32, 5, onebit_payload,
+             sizeof(onebit_payload));
+  put_member(302, kCmdDefaultF32, 5, raw_payload, sizeof(raw_payload));
+  for (uint64_t sid : {0xC0FFEE0000000001ull, 0xC0FFEE0000000002ull}) {
+    uint64_t be = htobe64(sid);
+    body.insert(body.end(), (uint8_t*)&be, (uint8_t*)&be + 8);
+  }
+  put_header(kFused, kTraceFlag, 1, 31, 301, 2, 0, body.size());
+  uint8_t trace[16];
+  bps_wire::pack_trace(trace, 0x5555555555555555ull, 0x6666666666666666ull);
+  put_bytes(trace, sizeof(trace));
+  put_bytes(body.data(), body.size());
+  // H: the fused REPLY with a codec-compressed slot beside a raw one,
+  // through the LIVE reply encoder the engine sends with
+  std::vector<uint64_t> keys = {301, 302};
+  std::vector<uint32_t> versions = {5, 5};
+  std::vector<std::vector<uint8_t>> slots = {
+      std::vector<uint8_t>(onebit_payload,
+                           onebit_payload + sizeof(onebit_payload)),
+      std::vector<uint8_t>(raw_payload, raw_payload + sizeof(raw_payload))};
+  std::vector<uint8_t> reply = encode_fused_reply_bytes(keys, versions, slots);
+  put_header(kFused, 0, 0, 31, 301, 0, 0, reply.size());
+  put_bytes(reply.data(), reply.size());
+  // I: the codec-config registration that arms the server-side chain
+  // (newline key=value text, REGISTER_COMPRESSOR)
+  const char reg[] =
+      "byteps_compressor_type=onebit\nbyteps_ef_type=vanilla";
+  put_header(kRegisterCompressor, 0, 0, 32, 301, 0, 0, sizeof(reg) - 1);
+  put_bytes(reg, sizeof(reg) - 1);
+  if (buf.size() > cap) return -(int64_t)buf.size();
+  std::memcpy(out, buf.data(), buf.size());
+  return (int64_t)buf.size();
+}
+
 // Parse a fused-push body with the live decoder and re-encode it
 // canonically (count + members, NO span trailer).  The Python test
 // feeds transport.encode_fused_push output — with and without the

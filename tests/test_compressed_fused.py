@@ -1,0 +1,418 @@
+"""Compressed wire path × fusion (docs/gradient-compression.md
+"Compressed wire path"): gradient compression composed with multi-key
+fused frames, on both server engines.
+
+Layers under test:
+
+- wire level: compressed members ride Op.FUSED frames (per-member
+  compressed flag = RequestType.COMPRESSED_PUSH_PULL in the member cmd),
+  the server sums them through the key's codec chain, the fused reply
+  slot comes back codec-compressed, and a RESENT frame never double-sums
+  (the per-(worker, key) exactly-once ledger covers compressed members)
+- trajectory level: a fixed-seed 1-bit + error-feedback run is BITWISE
+  identical across {python, native} × {fused, unfused} × {stripes 1, 4}
+  — fusing compressed tensors changes where bytes ride, never what they
+  say, and the EF residual state evolves identically everywhere
+- recovery plane: journaled compressed fused members replay through
+  RESYNC as plain compressed pushes, bitwise and exactly-once
+- adaptive policy: BYTEPS_COMPRESSION_AUTO disables a codec whose
+  observed wire ratio makes compression a loss; later rounds push raw
+  and stay correct
+"""
+
+import hashlib
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.types import (
+    DataType,
+    RequestType,
+    get_command_type,
+)
+from byteps_tpu.comm.journal import RoundJournal
+from byteps_tpu.comm.rendezvous import Scheduler
+from byteps_tpu.comm.transport import (
+    Message,
+    Op,
+    close_socket,
+    connect,
+    decode_fused_reply,
+    decode_resync_state,
+    encode_fused_push,
+    encode_resync_query,
+    recv_message,
+    send_message,
+)
+from byteps_tpu.compression.registry import create_compressor
+from byteps_tpu.core.telemetry import counters
+from byteps_tpu.server.server import PSServer
+
+from conftest import (
+    ENGINE_STRIPES,
+    ENGINE_STRIPES_IDS,
+    make_ps_server,
+    require_engine,
+    set_stripes,
+)
+
+CMD_F32 = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                           int(DataType.FLOAT32))
+CMD_COMP = get_command_type(RequestType.COMPRESSED_PUSH_PULL,
+                            int(DataType.FLOAT32))
+
+#: lossless codec config (topk with k = n): exact sums, so wire-level
+#: tests can assert bitwise float equality without simulating the codec
+def _topk_full(n: int) -> dict:
+    return {"byteps_compressor_type": "topk", "byteps_compressor_k": str(n)}
+
+
+def _init_key(socks_flags, key: int, n: int) -> None:
+    payload = struct.pack("!QI", n, int(DataType.FLOAT32))
+    for i, (sock, flag) in enumerate(socks_flags):
+        send_message(sock, Message(Op.INIT, key=key, seq=100 + i,
+                                   flags=flag, payload=payload))
+    for sock, _ in socks_flags:
+        assert recv_message(sock).op == Op.INIT
+
+
+def _register_codec(sock, key: int, kwargs: dict, seq: int) -> None:
+    body = "\n".join(f"{k}={v}" for k, v in sorted(kwargs.items())).encode()
+    send_message(sock, Message(Op.REGISTER_COMPRESSOR, key=key, seq=seq,
+                               payload=body))
+    assert recv_message(sock).op == Op.REGISTER_COMPRESSOR
+
+
+class TestCompressedFusedWire:
+    @pytest.mark.parametrize(("engine", "stripes"), ENGINE_STRIPES,
+                             ids=ENGINE_STRIPES_IDS)
+    def test_resent_compressed_fused_frame_never_double_sums(
+            self, engine, stripes, monkeypatch):
+        """Wire-level exactly-once for COMPRESSED members: worker 1 sends
+        one fused frame of two topk-compressed members TWICE (the retry
+        case); worker 2 completes both rounds with compressed plain
+        pushes.  Every reply slot must decode to the sum of exactly one
+        contribution per worker per key — on both engines and on striped
+        (4) and single-reducer (1) native lanes."""
+        require_engine(engine)
+        set_stripes(monkeypatch, stripes)
+        cfg = Config(num_worker=2, num_server=1)
+        if engine == "native":
+            from byteps_tpu.server.server import NativePSServer
+
+            srv = NativePSServer(cfg)
+            base_dedupe = counters().get("native_push_dedup")
+        else:
+            srv = PSServer(cfg)
+            srv.start(register=False)
+        KEY_A, KEY_B, N = 401, 402, 64
+        codec = create_compressor(_topk_full(N), N, server=False)
+        rng = np.random.default_rng(11)
+        a1, b1, a2, b2 = (
+            rng.standard_normal(N).astype(np.float32) for _ in range(4)
+        )
+        try:
+            w1 = connect(srv.host, srv.port)
+            w2 = connect(srv.host, srv.port)
+            for s in (w1, w2):
+                s.settimeout(15)
+            for key in (KEY_A, KEY_B):
+                _init_key([(w1, 1), (w2, 2)], key, N)
+                _register_codec(w1, key, _topk_full(N), seq=300 + key)
+            frame = encode_fused_push([
+                (KEY_A, CMD_COMP, 1, codec.compress(a1)),
+                (KEY_B, CMD_COMP, 1, codec.compress(b1)),
+            ])
+            send_message(w1, Message(Op.FUSED, key=KEY_A, seq=11, flags=1,
+                                     cmd=2, payload=frame))
+            send_message(w1, Message(Op.FUSED, key=KEY_A, seq=12, flags=1,
+                                     cmd=2, payload=frame))
+            send_message(w2, Message(Op.PUSH, key=KEY_A, seq=21, flags=2,
+                                     cmd=CMD_COMP, version=1,
+                                     payload=codec.compress(a2)))
+            send_message(w2, Message(Op.PUSH, key=KEY_B, seq=22, flags=2,
+                                     cmd=CMD_COMP, version=1,
+                                     payload=codec.compress(b2)))
+            for _ in range(2):
+                assert recv_message(w2).op == Op.PUSH
+            sums = {KEY_A: a1 + a2, KEY_B: b1 + b2}
+            for _ in range(2):  # original + retry both answered
+                msg = recv_message(w1)
+                assert msg.op == Op.FUSED
+                reply = decode_fused_reply(msg.payload)
+                assert [k for k, _, _ in reply] == [KEY_A, KEY_B]
+                for key, _ver, payload in reply:
+                    # compressed member ⇒ codec-compressed reply slot
+                    got = codec.decompress(payload, N)
+                    np.testing.assert_array_equal(got, sums[key])
+            if engine == "native":
+                assert (
+                    counters().get("native_push_dedup") - base_dedupe >= 2
+                )
+            close_socket(w1)
+            close_socket(w2)
+        finally:
+            srv.stop()
+
+    def test_resync_replays_compressed_members_exactly_once(self):
+        """Recovery plane × compressed wire path: a lost compressed
+        FUSED frame heals by replaying its journaled members as plain
+        compressed pushes — bitwise, and a second replay dedupes."""
+        srv = PSServer(Config(num_worker=2, num_server=1))
+        srv.start(register=False)
+        KEY_A, KEY_B, N = 421, 422, 32
+        codec = create_compressor(_topk_full(N), N, server=False)
+        rng = np.random.default_rng(13)
+        a1, b1, a2, b2 = (
+            rng.standard_normal(N).astype(np.float32) for _ in range(4)
+        )
+        try:
+            w1 = connect(srv.host, srv.port)
+            w2 = connect(srv.host, srv.port)
+            for s in (w1, w2):
+                s.settimeout(15)
+            for key in (KEY_A, KEY_B):
+                _init_key([(w1, 1), (w2, 2)], key, N)
+                _register_codec(w1, key, _topk_full(N), seq=300 + key)
+            # worker 2's compressed fused pack is "lost"; only the
+            # journal survives — members recorded with the COMPRESSED cmd
+            journal = RoundJournal(max_rounds=2, max_bytes=1 << 20)
+            journal.record(KEY_A, 1, CMD_COMP, codec.compress(a2),
+                           fused=True)
+            journal.record(KEY_B, 1, CMD_COMP, codec.compress(b2),
+                           fused=True)
+            frame = encode_fused_push([
+                (KEY_A, CMD_COMP, 1, codec.compress(a1)),
+                (KEY_B, CMD_COMP, 1, codec.compress(b1)),
+            ])
+            send_message(w1, Message(Op.FUSED, key=KEY_A, seq=1, flags=1,
+                                     cmd=2, payload=frame))
+            send_message(w2, Message(
+                Op.RESYNC_QUERY, key=KEY_A, seq=2, flags=2,
+                payload=encode_resync_query(2, [KEY_A, KEY_B]),
+            ))
+            resp = recv_message(w2)
+            assert resp.op == Op.RESYNC_STATE
+            state = decode_resync_state(resp.payload)
+            seq = 10
+            for key in (KEY_A, KEY_B):
+                assert state[key]["seen"] == 0
+                for e in journal.entries_after(key, 0):
+                    assert e.fused and e.cmd == CMD_COMP
+                    send_message(w2, Message(Op.PUSH, key=key, seq=seq,
+                                             flags=2, cmd=e.cmd,
+                                             version=e.version,
+                                             payload=e.payload))
+                    assert recv_message(w2).op == Op.PUSH
+                    seq += 1
+            # both rounds published: worker 1's fused reply decodes to
+            # bitwise the fault-free sums
+            msg = recv_message(w1)
+            assert msg.op == Op.FUSED
+            sums = {KEY_A: a1 + a2, KEY_B: b1 + b2}
+            for key, _ver, payload in decode_fused_reply(msg.payload):
+                np.testing.assert_array_equal(
+                    codec.decompress(payload, N), sums[key]
+                )
+            # replaying AGAIN dedupes: pull the round, the sum stands
+            for key in (KEY_A, KEY_B):
+                for e in journal.entries_after(key, 0):
+                    send_message(w2, Message(Op.PUSH, key=key, seq=seq,
+                                             flags=2, cmd=e.cmd,
+                                             version=e.version,
+                                             payload=e.payload))
+                    assert recv_message(w2).op == Op.PUSH
+                    seq += 1
+                send_message(w2, Message(Op.PULL, key=key, seq=seq,
+                                         cmd=CMD_COMP, version=1))
+                seq += 1
+                reply = recv_message(w2)
+                assert reply.op == Op.PULL
+                np.testing.assert_array_equal(
+                    codec.decompress(reply.payload, N), sums[key]
+                )
+            close_socket(w1)
+            close_socket(w2)
+        finally:
+            srv.stop()
+
+
+def _reset_runtime() -> None:
+    from byteps_tpu.common import config as _config
+    from byteps_tpu.common import registry as _registry
+    from byteps_tpu.core import state as _state
+
+    _state.shutdown_state()
+    _registry.reset_registry()
+    _config.clear_config()
+
+
+def _run_ef_lane(engine: str, stripes: int, threshold: int,
+                 monkeypatch) -> tuple:
+    """One full cluster: fixed-seed onebit+EF workload, every pull
+    digested.  Returns (digest, counter snapshot)."""
+    monkeypatch.setenv("BYTEPS_FUSION_THRESHOLD", str(threshold))
+    monkeypatch.setenv("BYTEPS_FUSION_CYCLE_MS", "2")
+    monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+    set_stripes(monkeypatch, stripes)
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    if engine == "native":
+        monkeypatch.setenv("BYTEPS_SERVER_NATIVE", "1")
+    else:
+        monkeypatch.delenv("BYTEPS_SERVER_NATIVE", raising=False)
+    srv = make_ps_server(engine, Config.from_env())
+    threading.Thread(target=srv.start, daemon=True).start()
+
+    import byteps_tpu as bps
+
+    digest = hashlib.sha256()
+    try:
+        bps.init()
+        n, names = 1024, [f"ef.{i}" for i in range(4)]
+        for nm in names:
+            bps.declare_tensor(
+                nm, byteps_compressor_type="onebit",
+                byteps_compressor_onebit_scaling="True",
+                byteps_ef_type="vanilla",
+            )
+        rng = np.random.default_rng(99)
+        xs = {nm: rng.standard_normal(n).astype(np.float32)
+              for nm in names}
+        hs = {nm: bps.push_pull_async(x, name=nm, average=False)
+              for nm, x in xs.items()}
+        for h in hs.values():
+            bps.synchronize(h)
+        counters().reset()
+        for r in range(2, 5):
+            hs = {nm: bps.push_pull_async(xs[nm] * r, name=nm,
+                                          average=False)
+                  for nm in names}
+            for nm in names:
+                digest.update(np.asarray(bps.synchronize(hs[nm])).tobytes())
+        snap = counters().snapshot()
+    finally:
+        bps.shutdown()
+        _reset_runtime()
+        srv.stop()
+        sched.stop()
+    return digest.hexdigest(), snap
+
+
+class TestCompressedEfTrajectory:
+    def test_trajectory_bitwise_python_native_fused_unfused_striped(
+            self, monkeypatch):
+        """The acceptance pin: a fixed-seed 1-bit + error-feedback run is
+        BITWISE identical across {python, native} × {fused, unfused} ×
+        {1, 4 native stripes}.  Fused lanes must actually have fused
+        (compressed members rode Op.FUSED frames), and compression must
+        have saved wire bytes."""
+        from conftest import have_native_parity_server
+
+        lanes = [("python", 0, 16384), ("python", 0, 0)]
+        if have_native_parity_server():
+            lanes += [("native", 1, 16384), ("native", 1, 0),
+                      ("native", 4, 16384)]
+        digests = {}
+        for engine, stripes, threshold in lanes:
+            d, snap = _run_ef_lane(engine, stripes, threshold, monkeypatch)
+            digests[(engine, stripes, threshold)] = d
+            if threshold:
+                assert snap.get("fused_keys", 0) > 0, (engine, stripes, snap)
+            else:
+                assert snap.get("fused_keys", 0) == 0, (engine, stripes, snap)
+            # onebit ⇒ ~32x smaller payloads actually crossed the wire
+            assert snap.get("wire_bytes_saved", 0) > 0, (engine, snap)
+            raw_push_bytes = 3 * 4 * 1024 * 4  # rounds × tensors × fp32
+            assert snap.get("wire_tx_bytes", 0) < raw_push_bytes / 4
+        assert len(set(digests.values())) == 1, digests
+
+    def test_reinit_cycle_keeps_compression(self, monkeypatch):
+        """shutdown()/init() with the SAME tensor name: the registry
+        (and ctx.initialized) survive, but the new engine holds no codec
+        chains — the re-init barrier must re-run the compressor setup,
+        not silently drop the tensor to raw for the rest of the process
+        (found by the two-cycle verify probe; pre-existing)."""
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        import byteps_tpu as bps
+
+        x = np.random.default_rng(7).standard_normal(512).astype(np.float32)
+        for cycle in range(2):
+            sched = Scheduler(num_workers=1, num_servers=1,
+                              host="127.0.0.1")
+            sched.start()
+            monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+            monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+            monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+            monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+            monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+            srv = PSServer(Config.from_env())
+            threading.Thread(target=srv.start, daemon=True).start()
+            try:
+                bps.init()
+                bps.declare_tensor("cycle.keep",
+                                   byteps_compressor_type="onebit")
+                counters().reset()
+                bps.push_pull(x, name="cycle.keep", average=False)
+                snap = counters().snapshot()
+                assert snap.get("wire_bytes_saved", 0) > 0, (cycle, snap)
+            finally:
+                bps.shutdown()
+                srv.stop()
+                sched.stop()
+
+    def test_auto_policy_disables_loss_making_codec(self, monkeypatch):
+        """BYTEPS_COMPRESSION_AUTO: a codec whose observed wire ratio is
+        a loss (topk with k = n → 2.0) is disabled after the probe
+        rounds; later rounds push raw and stay bitwise correct, while a
+        winning codec (onebit) stays on."""
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        monkeypatch.setenv("BYTEPS_COMPRESSION_AUTO", "1")
+        monkeypatch.setenv("BYTEPS_COMPRESSION_AUTO_ROUNDS", "2")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            n = 256
+            bps.declare_tensor("auto.bad",
+                               byteps_compressor_type="topk",
+                               byteps_compressor_k=str(n))
+            bps.declare_tensor("auto.good",
+                               byteps_compressor_type="onebit")
+            x = np.random.default_rng(5).standard_normal(n).astype(
+                np.float32)
+            counters().reset()
+            for r in range(1, 6):
+                out = np.asarray(
+                    bps.push_pull(x * r, name="auto.bad", average=False)
+                )
+                # topk full-k is lossless; post-disable rounds are raw —
+                # both bitwise equal to the input
+                np.testing.assert_array_equal(out, x * r)
+                bps.push_pull(x, name="auto.good", average=False)
+            snap = counters().snapshot()
+            assert snap.get("compression_auto_off", 0) == 1, snap
+            assert snap.get("wire_bytes_saved", 0) > 0, snap
+        finally:
+            bps.shutdown()
+            _reset_runtime()
+            srv.stop()
+            sched.stop()

@@ -348,6 +348,20 @@ class TestFusedReplayDedupe:
 
 
 class TestFusionChaos:
+    @pytest.fixture(autouse=True)
+    def _canonical_chaos_schedule(self):
+        """The fault RNG is keyed by (seed, process-global connection
+        index): without a reset the injected schedule depends on how
+        many chaos connections EARLIER tests opened, and this suite's
+        ``[native-s4]`` lane flaked in some sub-suite combinations
+        (CHANGES.md PR 9).  Resetting pins one canonical schedule —
+        identical under any pytest selection."""
+        from byteps_tpu.comm.chaos import reset_conn_indices, reset_fault_budget
+
+        reset_conn_indices()
+        reset_fault_budget()
+        yield
+
     @pytest.mark.parametrize(("engine", "stripes"), ENGINE_STRIPES,
                              ids=ENGINE_STRIPES_IDS)
     def test_fused_frames_bitwise_exact_under_chaos(self, engine, stripes,

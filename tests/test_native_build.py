@@ -56,6 +56,8 @@ _REQUIRED_SYMBOLS = (
     # elastic resharding plane (ISSUE 8): ownership map adoption (the
     # engine's WRONG_OWNER redirect feed)
     "bps_native_server_set_ownership",
+    # compressed wire path (ISSUE 11): compressed-fused golden fixtures
+    "bps_wire_golden_compressed",
 )
 
 

@@ -106,6 +106,93 @@ class TestGoldenFrames:
         )
 
 
+#: sha256 of the compressed-wire-path fixture stream (compressed fused
+#: push/reply + codec registration) as frozen at the compressed-fused
+#: port — a SEPARATE stream so the original GOLDEN_SHA256 frames stay
+#: byte-identical (these EXTEND the fixture set, no protocol revision)
+COMPRESSED_GOLDEN_SHA256 = (
+    "710311daf22719e13ef04dbf30e2bbcff75436db94d952fd13fd131ffd22b8f3"
+)
+
+
+def python_compressed_golden_frames() -> bytes:
+    """Compressed-wire-path fixtures via transport.py: a fused PUSH whose
+    members carry the per-member compressed flag — RequestType
+    .COMPRESSED_PUSH_PULL Cantor-encoded in the member cmd — beside a
+    raw sibling, with the member-span trailer and outer trace context;
+    the codec-compressed fused REPLY; and the REGISTER_COMPRESSOR frame
+    that arms the server-side chain.  Mirrors ps_server.cc
+    bps_wire_golden_compressed — change both together."""
+    from byteps_tpu.common.types import DataType, RequestType, get_command_type
+
+    cmd_comp = get_command_type(RequestType.COMPRESSED_PUSH_PULL,
+                                int(DataType.FLOAT32))
+    cmd_raw = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                               int(DataType.FLOAT32))
+    # onebit-shaped payload: f32 scale 0.5 + two u32 sign words, LE
+    # (compressor.cc wire format)
+    onebit = (struct.pack("<f", 0.5)
+              + struct.pack("<II", 0xDEADBEEF, 0x01234567))
+    raw = bytes(range(1, 9))
+    out = b""
+    # G: compressed fused PUSH (trailer + trace context)
+    body = encode_fused_push(
+        [(301, cmd_comp, 5, onebit), (302, cmd_raw, 5, raw)],
+        span_ids=[0xC0FFEE0000000001, 0xC0FFEE0000000002],
+    )
+    out += Message(Op.FUSED, key=301, payload=body, seq=31, cmd=2, flags=1,
+                   trace=(0x5555555555555555, 0x6666666666666666)).encode()
+    # H: the fused REPLY with a codec-compressed slot beside a raw one
+    reply = encode_fused_reply([(301, 5, onebit), (302, 5, raw)])
+    out += Message(Op.FUSED, key=301, payload=reply, seq=31).encode()
+    # I: codec-config registration (newline key=value text)
+    reg = b"byteps_compressor_type=onebit\nbyteps_ef_type=vanilla"
+    out += Message(Op.REGISTER_COMPRESSOR, key=301, payload=reg,
+                   seq=32).encode()
+    return out
+
+
+class TestCompressedGoldenFrames:
+    def test_native_codec_matches_python(self):
+        lib = _lib()
+        if not hasattr(lib, "bps_wire_golden_compressed"):
+            pytest.skip("lib predates the compressed-wire-path shim")
+        buf = (ctypes.c_uint8 * 8192)()
+        n = lib.bps_wire_golden_compressed(buf, len(buf))
+        assert n > 0, f"bps_wire_golden_compressed failed: {n}"
+        assert bytes(buf[:n]) == python_compressed_golden_frames()
+
+    def test_frames_match_frozen_digest(self):
+        digest = hashlib.sha256(
+            python_compressed_golden_frames()
+        ).hexdigest()
+        assert digest == COMPRESSED_GOLDEN_SHA256, (
+            "the compressed-fused wire format changed — a PROTOCOL "
+            "revision: update COMPRESSED_GOLDEN_SHA256 and audit every "
+            "decoder (Python AND C++) for compatibility"
+        )
+
+    def test_old_decoder_compat_on_compressed_frame(self):
+        """The compressed-flag member cmd and the span trailer must both
+        be invisible to a pre-compression fused decoder: decode yields
+        exactly the members (trailer ignored), and the member cmd is an
+        opaque u32 it already carried."""
+        from byteps_tpu.common.types import (
+            DataType, RequestType, decode_command_type, get_command_type,
+        )
+
+        cmd_comp = get_command_type(RequestType.COMPRESSED_PUSH_PULL,
+                                    int(DataType.FLOAT32))
+        members = [(301, cmd_comp, 5, b"\x01\x02"), (302, 0, 5, b"\x03")]
+        body = encode_fused_push(members, span_ids=[7, 8])
+        assert decode_fused_push(body) == members
+        rtype, dtype = decode_command_type(cmd_comp)
+        assert rtype == RequestType.COMPRESSED_PUSH_PULL
+        assert dtype == int(DataType.FLOAT32)
+        # the native decoder sees the same two members, trailer dropped
+        assert _fused_echo(body) == encode_fused_push(members)
+
+
 #: sha256 of the CLIENT-encoder fixture stream (trace-flagged frames
 #: through bps_wire_client_frame, the live bpsc_send2 path) as frozen at
 #: the native-observability port

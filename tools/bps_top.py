@@ -160,6 +160,26 @@ def render(url: str, cur: Sample, prev: Sample, dt: float) -> str:
             f"  ownership map        : {head}"
             + (f" | owned keys {cells}" if cells else "")
         )
+    # compressed wire path (docs/gradient-compression.md): cumulative
+    # wire bytes the codecs removed vs shipped, and how many keys the
+    # adaptive policy (BYTEPS_COMPRESSION_AUTO) turned OFF because their
+    # observed ratio made compression a loss
+    saved = tx = auto_off = 0
+    for (name, lbl), v in cur.items():
+        if lbl:
+            continue  # flat totals only (labeled twins double-count)
+        if name == "byteps_wire_bytes_saved_total":
+            saved = int(v)
+        elif name == "byteps_wire_tx_bytes_total":
+            tx = int(v)
+        elif name == "byteps_compression_auto_off_total":
+            auto_off = int(v)
+    if saved or auto_off:
+        pct = 100.0 * saved / max(1, saved + tx)
+        lines.append(
+            f"  compression          : saved {saved / 1e6:.1f} MB on wire"
+            f" ({pct:.0f}% of push bytes) | auto-disabled keys {auto_off}"
+        )
     # latency families
     rows = _histo_rows(cur)
     if rows:

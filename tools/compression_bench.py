@@ -1,0 +1,282 @@
+"""Compressed wire path A/B — bytes-on-wire and step time for
+{off, 1bit, topk} × {fused, unfused} on a shaped low-bandwidth link.
+
+The matrix the ISSUE 11 tentpole exists for: gradient compression and
+small-tensor fusion used to EXCLUDE each other (a compressed partition
+always paid its own RPC; a fused frame always shipped raw fp32).  This
+bench drives the same deterministic workload — N medium tensors per step
+through a live in-process PS cluster over a rate-shaped van
+(``BYTEPS_VAN_RATE_MBPS``, the OVERLAP_r05 harness's link model) — in
+every combination and reports wire RPC counts, actual bytes on the wire
+(``wire_tx/rx_bytes`` counters), and step-latency stats.
+
+    python tools/compression_bench.py [--keys 48] [--bytes 16384]
+        [--steps 8] [--threshold 16384] [--rate-mbps 200] [--delay-ms 0.2]
+        [--engine python|native] [--skip-auto] [--out COMPRESS_BENCH_r07.json]
+
+Rows per engine:
+
+- ``raw_unfused`` / ``raw_fused``           — the pre-compression pair
+- ``onebit_unfused`` / ``onebit_fused``     — 1-bit + error feedback
+- ``topk_unfused`` / ``topk_fused``         — top-k (k = 3%)
+- ``auto``  — a deliberately LOSS-making codec (topk with k = n, wire
+  ratio 2.0) under ``BYTEPS_COMPRESSION_AUTO=1``: the policy disables it
+  after the probe rounds and the tail steps run at raw speed
+
+Cross-mode assertions: compressed-fused pulls are BITWISE identical to
+compressed-unfused pulls (same codec math, different framing), and the
+acceptance block checks compressed-fused beats compressed-unfused on
+RPC count AND raw-fused on bytes-on-wire, with a step-time speedup on
+the bandwidth-bound link.
+
+``--engine native`` reruns the matrix against the GIL-free C++ server
+engine and merges under a top-level ``"native"`` key (native responses
+bypass the shaper — the within-engine A/B stays fair, the cross-engine
+latency comparison carries that caveat, as in fusion_bench.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def _reset_runtime() -> None:
+    from byteps_tpu.common import config as _config
+    from byteps_tpu.common import registry as _registry
+    from byteps_tpu.core import state as _state
+
+    _state.shutdown_state()
+    _registry.reset_registry()
+    _config.clear_config()
+
+
+def run_mode(codec: str, threshold: int, keys: int, nbytes: int, steps: int,
+             rate_mbps: float, delay_ms: float, engine: str,
+             auto: bool = False) -> dict:
+    """One cluster bring-up → timed steps → teardown.  ``codec``:
+    "" (raw), "onebit", "topk", or "topk_full" (the deliberate loss)."""
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.core.telemetry import counters
+    from byteps_tpu.server.server import NativePSServer, PSServer
+
+    n = max(32, nbytes // 4)
+    os.environ.update({
+        "BYTEPS_VAN": "tcp",
+        "BYTEPS_FUSION_THRESHOLD": str(threshold),
+        "BYTEPS_FUSION_CYCLE_MS": "2",
+        "BYTEPS_VAN_RATE_MBPS": str(rate_mbps),
+        "BYTEPS_VAN_DELAY_MS": str(delay_ms),
+        "BYTEPS_MIN_COMPRESS_BYTES": "0",
+        "BYTEPS_COMPRESSION_AUTO": "1" if auto else "0",
+        "BYTEPS_COMPRESSION_AUTO_ROUNDS": "2",
+    })
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    os.environ.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(sched.port),
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    if engine == "native":
+        os.environ["BYTEPS_SERVER_NATIVE"] = "1"
+        srv = NativePSServer(Config.from_env())
+    else:
+        os.environ.pop("BYTEPS_SERVER_NATIVE", None)
+        srv = PSServer(Config.from_env())
+    threading.Thread(target=srv.start, daemon=True).start()
+
+    import byteps_tpu as bps
+
+    kwargs = {}
+    if codec == "onebit":
+        kwargs = {"byteps_compressor_type": "onebit",
+                  "byteps_compressor_onebit_scaling": "True",
+                  "byteps_ef_type": "vanilla"}
+    elif codec == "topk":
+        kwargs = {"byteps_compressor_type": "topk",
+                  "byteps_compressor_k": "0.03",
+                  "byteps_ef_type": "vanilla"}
+    elif codec == "topk_full":  # wire ratio 2.0 — the auto row's bait
+        kwargs = {"byteps_compressor_type": "topk",
+                  "byteps_compressor_k": str(n)}
+
+    rng = np.random.default_rng(42)
+    base = [rng.standard_normal(n).astype(np.float32) for _ in range(keys)]
+    names = [f"cb.{i}" for i in range(keys)]
+    final = {}
+    try:
+        bps.init()
+        for nm in names:
+            if kwargs:
+                bps.declare_tensor(nm, **kwargs)
+        hs = [bps.push_pull_async(x, name=nm, average=False)
+              for nm, x in zip(names, base)]
+        for h in hs:
+            bps.synchronize(h)
+        counters().reset()
+        lat = []
+        for step in range(steps):
+            scale = np.float32(step + 2)
+            t0 = time.perf_counter()
+            hs = [bps.push_pull_async(x * scale, name=nm, average=False)
+                  for nm, x in zip(names, base)]
+            outs = [np.asarray(bps.synchronize(h)) for h in hs]
+            lat.append(time.perf_counter() - t0)
+            if step == steps - 1:
+                final = {nm: out for nm, out in zip(names, outs)}
+        snap = counters().snapshot()
+    finally:
+        bps.shutdown()
+        _reset_runtime()
+        srv.stop()
+        sched.stop()
+    tail = sorted(lat[len(lat) // 2:])  # post-settle half (auto row)
+    slat = sorted(lat)
+    return {
+        "engine": engine,
+        "codec": codec or "raw",
+        "fused": threshold > 0,
+        "auto": auto,
+        "steps": steps,
+        "wire_rpcs": snap.get("wire_rpc", 0),
+        "wire_tx_bytes": snap.get("wire_tx_bytes", 0),
+        "wire_rx_bytes": snap.get("wire_rx_bytes", 0),
+        "wire_bytes_saved": snap.get("wire_bytes_saved", 0),
+        "fused_frames": snap.get("fused_frames", 0),
+        "fused_keys": snap.get("fused_keys", 0),
+        "compression_auto_off": snap.get("compression_auto_off", 0),
+        "step_ms_mean": 1e3 * sum(lat) / len(lat),
+        "step_ms_p50": 1e3 * slat[len(slat) // 2],
+        "step_ms_max": 1e3 * slat[-1],
+        "tail_step_ms_mean": 1e3 * sum(tail) / len(tail),
+        "_final": final,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--keys", type=int, default=48)
+    ap.add_argument("--bytes", type=int, default=16384)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--threshold", type=int, default=16384)
+    ap.add_argument("--rate-mbps", type=float, default=200.0,
+                    help="shaped-link bandwidth (the bandwidth-bound "
+                         "config the compressed path is for)")
+    ap.add_argument("--delay-ms", type=float, default=0.2)
+    ap.add_argument("--engine", choices=("python", "native"),
+                    default="python")
+    ap.add_argument("--skip-auto", action="store_true")
+    ap.add_argument("--out", default="COMPRESS_BENCH_r07.json")
+    args = ap.parse_args()
+
+    def mode(codec, threshold, auto=False):
+        return run_mode(codec, threshold, args.keys, args.bytes, args.steps,
+                        args.rate_mbps, args.delay_ms, args.engine, auto)
+
+    rows = {}
+    for codec in ("", "onebit", "topk"):
+        name = codec or "raw"
+        rows[f"{name}_unfused"] = mode(codec, 0)
+        rows[f"{name}_fused"] = mode(codec, args.threshold)
+    if not args.skip_auto:
+        rows["auto"] = mode("topk_full", args.threshold, auto=True)
+
+    # compressed-fused vs compressed-unfused must be BITWISE identical —
+    # same codec math, different framing (raw pair checked the same way)
+    for name in ("raw", "onebit", "topk"):
+        a, b = rows[f"{name}_unfused"], rows[f"{name}_fused"]
+        for nm, ref in a["_final"].items():
+            np.testing.assert_array_equal(
+                b["_final"][nm], ref,
+                err_msg=f"{name}: fused vs unfused results diverged ({nm})",
+            )
+    for r in rows.values():
+        r.pop("_final")
+
+    raw_f, ob_u, ob_f = rows["raw_fused"], rows["onebit_unfused"], rows["onebit_fused"]
+    report = {
+        "workload": {
+            "keys": args.keys, "bytes_per_key": args.bytes,
+            "steps": args.steps, "threshold": args.threshold,
+            "rate_mbps": args.rate_mbps, "delay_ms": args.delay_ms,
+            "engine": args.engine,
+        },
+        "headline": {
+            # the three-way composition win (ISSUE 11 acceptance)
+            "rpc_reduction_vs_compressed_unfused":
+                ob_u["wire_rpcs"] / max(1, ob_f["wire_rpcs"]),
+            "bytes_reduction_vs_raw_fused":
+                raw_f["wire_tx_bytes"] / max(1, ob_f["wire_tx_bytes"]),
+            "speedup_vs_raw_fused":
+                raw_f["step_ms_mean"] / ob_f["step_ms_mean"],
+            "speedup_vs_compressed_unfused":
+                ob_u["step_ms_mean"] / ob_f["step_ms_mean"],
+            "bitwise_identical_fused_vs_unfused": True,
+        },
+        "acceptance": {},
+        **rows,
+    }
+    if "auto" in rows:
+        report["headline"]["auto_disabled_keys"] = rows["auto"][
+            "compression_auto_off"
+        ]
+        # post-settle steps should run near raw-fused speed (the codec
+        # is off for every key by then)
+        report["headline"]["auto_tail_vs_raw_fused"] = (
+            rows["auto"]["tail_step_ms_mean"]
+            / max(1e-9, raw_f["tail_step_ms_mean"])
+        )
+    report["acceptance"] = {
+        "compressed_fused_fewer_rpcs_than_compressed_unfused":
+            ob_f["wire_rpcs"] < ob_u["wire_rpcs"],
+        "compressed_fused_fewer_bytes_than_raw_fused":
+            ob_f["wire_tx_bytes"] < raw_f["wire_tx_bytes"],
+        "compressed_fused_faster_than_raw_fused":
+            ob_f["step_ms_mean"] < raw_f["step_ms_mean"],
+        "compressed_fused_faster_than_compressed_unfused":
+            ob_f["step_ms_mean"] < ob_u["step_ms_mean"],
+        "auto_policy_disabled_all_keys":
+            ("auto" not in rows
+             or rows["auto"]["compression_auto_off"] == args.keys),
+    }
+
+    # one artifact carries both engines: python rows own the top level,
+    # a native rerun lands under "native" (fusion_bench.py convention)
+    existing = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+        except (ValueError, OSError):
+            existing = {}
+    if args.engine == "native":
+        merged = existing or {}
+        merged["native"] = report
+        merged["native"]["note"] = (
+            "native response direction is unshaped under the rate/delay "
+            "knobs — within-engine ratios are fair, cross-engine "
+            "latency is not comparable"
+        )
+        report = merged
+    else:
+        if "native" in existing:
+            report["native"] = existing["native"]
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
