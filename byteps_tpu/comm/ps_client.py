@@ -66,14 +66,14 @@ class _ServerConn:
 
         if streams > 1 and shaping_enabled():
             # each stripe would get its OWN virtual wire, silently scaling
-            # the emulated link to N x BYTEPS_VAN_RATE_MBPS — a shaped
+            # the emulated link to N x BYTEPS_VAN_RATE_MBYTES_S — a shaped
             # link models one wire, so striping is forced off
             warn_native_bypass_once(
                 "ignoring BYTEPS_TCP_STREAMS>1 (a shaped link is one wire)"
             )
             streams = 1
         # data-plane link: shaped when BYTEPS_VAN_DELAY_MS /
-        # BYTEPS_VAN_RATE_MBPS emulate a DCN link (shaping.py)
+        # BYTEPS_VAN_RATE_MBYTES_S emulate a DCN link (shaping.py)
         self.sock = maybe_shape(connect(host, port, timeout=dial_timeout))
         self.send_lock = threading.Lock()
         # striped lanes (BYTEPS_TCP_STREAMS, tcp only): extra parallel
@@ -840,6 +840,20 @@ class PSClient:
             # scrape of the scheduler sees the whole job without the
             # scraper having to discover every worker's endpoint
             delta = metrics().delta_snapshot()
+            # flight-recorder ledger tail (docs/observability.md "Flight
+            # recorder & doctor"): a compact window of recent per-step
+            # records rides every beat so the scheduler holds a
+            # cluster-wide step matrix.  Idempotent — the window is
+            # re-shipped and the scheduler dedupes by step index, so a
+            # lost beat costs nothing and the requeue path (which only
+            # folds metric increments) never needs to know about it.
+            from byteps_tpu.core.flightrec import get_process_recorder
+
+            rec = get_process_recorder()
+            if rec is not None and rec.enabled:
+                tail = rec.ledger_tail()
+                if tail:
+                    delta["fr"] = tail
             try:
                 payload = json.dumps(delta).encode() if delta else b""
                 # bounded wait: a chaos-dropped PING on a healthy link
@@ -1620,9 +1634,14 @@ class PSClient:
                 else:
                     # per-ATTEMPT round trip (retries each time their own
                     # attempt; the retry cost itself shows up in
-                    # retry_backoff_seconds + the rpc_retry counter)
+                    # retry_backoff_seconds + the rpc_retry counter).
+                    # Labeled per server RANK like the rpc_* counters:
+                    # the flight recorder's straggler rule needs "whose
+                    # p99 ran away THIS step", which a flat family can
+                    # never answer (docs/observability.md)
                     metrics().observe(
-                        "rpc_round_trip_seconds", time.monotonic() - t_sent
+                        "rpc_round_trip_seconds", time.monotonic() - t_sent,
+                        labels={"server": sid},
                     )
                     deliver(msg)
 

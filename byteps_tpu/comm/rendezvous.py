@@ -223,6 +223,14 @@ class Scheduler:
         self.metrics_agg.gauge_fn(
             "cluster_rejoining_nodes", self._rejoining_count
         )
+        # cluster step matrix (docs/observability.md "Flight recorder &
+        # doctor"): every node piggybacks a compact flight-ledger tail
+        # on its heartbeat; the matrix answers "who is the straggler
+        # THIS step" and exports cluster_straggler_rank to the aggregate
+        from byteps_tpu.core.flightrec import ClusterFlight
+
+        self.flight = ClusterFlight()
+        self.flight.attach(self.metrics_agg)
         self._metrics_http = None
         # scheduler-link fault injection (BYTEPS_CHAOS_SCHED under a
         # chaos van): accepted control connections get the same
@@ -332,10 +340,14 @@ class Scheduler:
                 ]
             # a barrier the dead node would have joined can now be full
             self._release_satisfied_barriers_locked()
-        for _, n in doomed:
+        for role, n in doomed:
             # FIN wakes a hung-but-alive node's control reader so it
             # learns it was expelled instead of waiting forever
             close_socket(n.conn)
+            # and its row leaves the step matrix — a dead rank's frozen
+            # last-step duration must not keep feeding the straggler
+            # median (it can rejoin via the restart-detection path)
+            self.flight.forget(role, n.rank)
 
     def _bump_map_epoch_locked(self) -> bool:
         """Advance the ownership-map epoch iff the server set actually
@@ -490,6 +502,16 @@ class Scheduler:
         labels = (
             {"role": ident[0], "rank": str(ident[1])} if ident else None
         )
+        # flight-ledger tail: route to the cluster step matrix (it is
+        # not a metric delta; merge_delta would ignore it)
+        tail = delta.pop("fr", None)
+        if tail and ident:
+            try:
+                self.flight.merge(ident[0], ident[1], tail)
+            except Exception as e:  # noqa: BLE001
+                from byteps_tpu.common import logging as bpslog
+
+                bpslog.warning("flight tail merge failed: %r", e)
         try:
             self.metrics_agg.merge_delta(delta, labels=labels)
         except Exception as e:  # noqa: BLE001

@@ -9,9 +9,14 @@ emulate a DCN link so scheduling/overlap effects become measurable:
 
 - ``BYTEPS_VAN_DELAY_MS``   — one-way propagation delay added per
   message (pipelined: it delays delivery, it does not occupy the wire).
-- ``BYTEPS_VAN_RATE_MBPS``  — link bandwidth; serialization time
-  ``bytes/rate`` occupies the virtual wire, so back-to-back messages
-  queue behind each other exactly like a real NIC.
+- ``BYTEPS_VAN_RATE_MBYTES_S`` — link bandwidth in **megabytes per
+  second**; serialization time ``bytes/rate`` occupies the virtual
+  wire, so back-to-back messages queue behind each other exactly like
+  a real NIC.  (``BYTEPS_VAN_RATE_MBPS`` is the deprecated original
+  spelling of the same knob — it always meant MB/s despite the
+  "mbps" suffix, the naming trap this rename closes; it still works,
+  with a one-time warning, and the canonical name wins when both are
+  set.)
 - ``BYTEPS_VAN_SHAPE_BUF_KB`` — shaping buffer (default 256): once this
   many bytes are queued on the virtual wire, ``sendall`` blocks.  This
   is the kernel-socket-buffer analogue that propagates backpressure to
@@ -43,12 +48,39 @@ from collections import deque
 from typing import Optional
 
 
+_warned_legacy_rate = False
+
+
+def _rate_mbytes_s() -> float:
+    """Link bandwidth in MB/s: canonical ``BYTEPS_VAN_RATE_MBYTES_S``,
+    falling back to the deprecated ``BYTEPS_VAN_RATE_MBPS`` alias (same
+    unit — it was always megaBYTES/s despite the name) with a one-time
+    warning.  The canonical spelling wins when both are set."""
+    v = os.environ.get("BYTEPS_VAN_RATE_MBYTES_S")
+    if v not in (None, ""):
+        return float(v)
+    legacy = os.environ.get("BYTEPS_VAN_RATE_MBPS")
+    if legacy in (None, ""):
+        return 0.0
+    global _warned_legacy_rate
+    if not _warned_legacy_rate:
+        _warned_legacy_rate = True
+        from byteps_tpu.common import logging as bps_logging
+
+        bps_logging.warning(
+            "BYTEPS_VAN_RATE_MBPS is deprecated (the unit is megaBYTES/s, "
+            "not megabits) — use BYTEPS_VAN_RATE_MBYTES_S; honoring the "
+            "old name with the same MB/s meaning",
+        )
+    return float(legacy)
+
+
 def shaping_params() -> tuple:
     """(delay_s, rate_Bps, buf_bytes) from env; (0, 0, _) means off."""
     delay_ms = float(os.environ.get("BYTEPS_VAN_DELAY_MS", "0") or 0)
-    rate_mbps = float(os.environ.get("BYTEPS_VAN_RATE_MBPS", "0") or 0)
+    rate_mbytes_s = _rate_mbytes_s()
     buf_kb = float(os.environ.get("BYTEPS_VAN_SHAPE_BUF_KB", "256") or 256)
-    return delay_ms / 1e3, rate_mbps * 1e6, max(1, int(buf_kb * 1024))
+    return delay_ms / 1e3, rate_mbytes_s * 1e6, max(1, int(buf_kb * 1024))
 
 
 def shaping_enabled() -> bool:
@@ -213,7 +245,7 @@ def warn_native_bypass_once(context: str) -> None:
     from byteps_tpu.common import logging as bps_logging
 
     bps_logging.warning(
-        "BYTEPS_VAN_DELAY_MS/RATE_MBPS set: %s (shaping needs the "
+        "BYTEPS_VAN_DELAY_MS/RATE_MBYTES_S set: %s (shaping needs the "
         "Python data plane)", context,
     )
 

@@ -207,6 +207,24 @@ class Config:
     # built; the shm van keeps the Python client (mmap bulk path).
     native_client: bool = False  # BYTEPS_NATIVE_CLIENT
 
+    # --- flight recorder + anomaly triggers (docs/observability.md
+    # "Flight recorder & doctor") ---
+    # always-on bounded ring of per-step records stamped by the engine
+    # at round completion (servers stamp per heartbeat beat); 0 disables
+    # the recorder AND the trigger engine entirely
+    flight_steps: int = 256  # BYTEPS_FLIGHT_STEPS
+    # slow-step / straggler / hot-stripe sensitivity: a step (or one
+    # peer's p99) must exceed the rolling/peer median by this factor
+    flight_slow_factor: float = 3.0  # BYTEPS_FLIGHT_SLOW_FACTOR
+    # queue-stall bound: a stage dwell p99 past this many seconds in one
+    # step fires the queue_stall trigger
+    flight_stall_s: float = 5.0  # BYTEPS_FLIGHT_STALL_S
+    # where triggered diagnostic bundles land ("" = <trace_dir>/flight_bundles)
+    flight_dir: str = ""  # BYTEPS_FLIGHT_DIR
+    # per-rule bundle rate limit: one dump per rule per this many seconds
+    # (triggers past the limit still count in flight_trigger{rule})
+    flight_bundle_s: float = 60.0  # BYTEPS_FLIGHT_BUNDLE_S
+
     # --- debug / trace / observability (global.cc:113-124; docs/observability.md) ---
     log_level: str = "WARNING"
     trace_on: bool = False
@@ -333,6 +351,17 @@ class Config:
             ),
             tcp_streams=max(1, _env_int("BYTEPS_TCP_STREAMS", 1)),
             native_client=_env_bool("BYTEPS_NATIVE_CLIENT"),
+            flight_steps=max(0, _env_int("BYTEPS_FLIGHT_STEPS", 256)),
+            flight_slow_factor=max(1.1, float(
+                os.environ.get("BYTEPS_FLIGHT_SLOW_FACTOR", "3") or "3"
+            )),
+            flight_stall_s=max(0.001, float(
+                os.environ.get("BYTEPS_FLIGHT_STALL_S", "5") or "5"
+            )),
+            flight_dir=_env_str("BYTEPS_FLIGHT_DIR", ""),
+            flight_bundle_s=max(0.0, float(
+                os.environ.get("BYTEPS_FLIGHT_BUNDLE_S", "60") or "60"
+            )),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),

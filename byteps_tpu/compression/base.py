@@ -18,6 +18,15 @@ import numpy as np
 class Compressor(abc.ABC):
     """Level-2 codec operating on the flat fp32 staging buffer."""
 
+    #: True when :meth:`wire_nbytes` is EXACT for every payload this
+    #: codec will ever emit (a size-deterministic wire format), not just
+    #: a worst-case bound.  Every shipped codec sets it; the base stays
+    #: False so a custom codec inheriting the default fp32-size bound is
+    #: never mistaken for one.  ``BYTEPS_COMPRESSION_AUTO`` uses it to
+    #: compute the policy verdict at registration instead of paying
+    #: probe rounds (docs/gradient-compression.md "Codec auto-selection").
+    wire_static = False
+
     def __init__(self, size: int) -> None:
         self.size = size  # element count of the uncompressed tensor
 

@@ -46,3 +46,9 @@ class VanillaErrorFeedback(Compressor):
 
     def wire_nbytes(self) -> int:
         return self.inner.wire_nbytes()
+
+    @property
+    def wire_static(self) -> bool:
+        # EF changes the values fed to the inner codec, never the wire
+        # format — size determinism delegates
+        return self.inner.wire_static

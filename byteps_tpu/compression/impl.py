@@ -31,6 +31,8 @@ class OneBitCompressor(Compressor):
         super().__init__(size)
         self.scaling = scaling
 
+    wire_static = True  # [f32 scale][packed sign words]: size-deterministic
+
     def wire_nbytes(self) -> int:
         return 4 + 4 * ((self.size + 31) // 32)
 
@@ -69,6 +71,8 @@ class TopKCompressor(Compressor):
     def __init__(self, size: int, k: int) -> None:
         super().__init__(size)
         self.k = max(1, min(int(k), size))
+
+    wire_static = True  # always exactly k (idx, val) pairs
 
     def wire_nbytes(self) -> int:
         return 8 * self.k
@@ -109,6 +113,7 @@ class RandomKCompressor(Compressor):
         self.s0, self.s1 = seed_pair_from(seed)
 
     wire_nbytes = TopKCompressor.wire_nbytes
+    wire_static = True
 
     def compress(self, grad: np.ndarray) -> bytes:
         grad = np.ascontiguousarray(grad, dtype=np.float32)
@@ -142,6 +147,8 @@ class DitheringCompressor(Compressor):
         self.natural = 1 if partition in ("natural", "1", 1) else 0
         self.l2 = 1 if normalize in ("l2", "L2", "1", 1) else 0
         self.s0, self.s1 = seed_pair_from(seed)
+
+    wire_static = True  # [f32 norm][i8 level x n]: size-deterministic
 
     def wire_nbytes(self) -> int:
         return 4 + self.size

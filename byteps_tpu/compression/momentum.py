@@ -39,3 +39,7 @@ class NesterovMomentum(Compressor):
 
     def wire_nbytes(self) -> int:
         return self.inner.wire_nbytes()
+
+    @property
+    def wire_static(self) -> bool:
+        return self.inner.wire_static

@@ -50,7 +50,7 @@ class _Job:
     __slots__ = (
         "name", "ctx", "flat", "result", "dtype_id", "average", "handle",
         "pending", "lock", "shape", "np_dtype", "is_jax", "version", "t0",
-        "rowsparse", "device_parts", "failed", "trace_id",
+        "rowsparse", "device_parts", "failed", "trace_id", "step_counted",
     )
 
     def __init__(self, name, ctx, flat, result, dtype_id, average, handle,
@@ -85,6 +85,10 @@ class _Job:
         # distributed tracing: one trace id per push_pull invocation;
         # every partition task's span joins it (0 = tracing off)
         self.trace_id = 0
+        # once-guard for the flight recorder's step accounting: a job
+        # leaves the in-flight count exactly once whether it finalized
+        # or several of its tasks raced into _fail_job
+        self.step_counted = False
 
 
 class _FusionGroup:
@@ -295,11 +299,20 @@ class PipelineEngine:
     #: init-push barrier, exactly like an elastic server resize
     _epoch_counter = itertools.count()
 
-    def __init__(self, cfg: Config, ps_client, telemetry=None, tracer=None) -> None:
+    def __init__(self, cfg: Config, ps_client, telemetry=None, tracer=None,
+                 flightrec=None) -> None:
         self.cfg = cfg
         self.client = ps_client
         self.telemetry = telemetry
         self.tracer = tracer
+        # flight recorder (docs/observability.md "Flight recorder &
+        # doctor"): the engine stamps one ledger record per completed
+        # round — when the in-flight job count drains back to zero —
+        # carrying the step wall time.  None / capacity 0 = off.
+        self._flight = flightrec
+        self._step_lock = threading.Lock()
+        self._step_open = 0
+        self._step_t0 = 0.0
         self._epoch = next(PipelineEngine._epoch_counter)
         self._stop = threading.Event()
         credit = cfg.scheduling_credit
@@ -513,6 +526,7 @@ class PipelineEngine:
             from byteps_tpu.core.tracing import new_trace_id
 
             job.trace_id = new_trace_id()
+        self._step_begin()
         for part in ctx.partitions:
             p_compressed = (
                 part.key in self._compressors
@@ -708,6 +722,7 @@ class PipelineEngine:
             from byteps_tpu.core.tracing import new_trace_id
 
             job.trace_id = new_trace_id()
+        self._step_begin()
         task = TensorTableEntry(
             tensor_name=name,
             key=key,
@@ -745,6 +760,18 @@ class PipelineEngine:
             self._compressors[part.key] = codec
             # a chain created after set_compression_lr must still honor it
             self._apply_lr_to_chain(codec, self._compression_lr)
+            # BYTEPS_COMPRESSION_AUTO, static fast path: every shipped
+            # codec's wire format is size-deterministic (wire_static →
+            # wire_nbytes() is EXACT, not a bound), so the policy verdict
+            # is computable at registration — no probe rounds, no
+            # compressed bytes wasted discovering that k ≈ n.  The probe
+            # path survives only for data-dependent codecs
+            # (wire_static=False — custom chains whose payload size
+            # varies with the gradient).
+            if self.cfg.compression_auto and getattr(
+                codec, "wire_static", False
+            ):
+                self._auto_static_verdict(part.key, codec)
             self.client.register_compressor(part.key, ctx.kwargs)
             from byteps_tpu.core.device_codec import device_codec_for
 
@@ -797,6 +824,34 @@ class PipelineEngine:
             self._lr_sent_to_servers = self._compression_lr
 
     # --- observability helpers (docs/observability.md) -------------------
+
+    def _step_begin(self) -> None:
+        """One push_pull job entered the pipeline.  The first job after
+        a quiescent stretch opens a new step window; the flight
+        recorder stamps a ledger record when the count drains back to
+        zero (round completion)."""
+        with self._step_lock:
+            if self._step_open == 0:
+                self._step_t0 = time.monotonic()
+            self._step_open += 1
+
+    def _step_end(self, job: _Job) -> None:
+        """A job left the pipeline (finalized OR failed) — exactly once
+        per job.  Draining the in-flight count to zero completes the
+        step: the flight recorder takes its registry delta and runs the
+        trigger rules on it."""
+        with job.lock:
+            if job.step_counted:
+                return
+            job.step_counted = True
+        with self._step_lock:
+            if self._step_open <= 0:
+                return
+            self._step_open -= 1
+            done = self._step_open == 0
+            dur = time.monotonic() - self._step_t0
+        if done and self._flight is not None and self._flight.enabled:
+            self._flight.record_step(dur)
 
     def _traced(self) -> bool:
         return (
@@ -896,11 +951,20 @@ class PipelineEngine:
             job.pending -= 1
             done = job.pending == 0
         if done:
+            # close the step window BEFORE the handle completes: a
+            # synchronous trainer resubmits the moment mark_done wakes
+            # it, and a _step_begin racing in ahead of _step_end would
+            # merge two rounds into one record (and skew the slow-step
+            # rolling median)
+            self._step_end(job)
             self._finalize(job)
 
     def _fail_job(self, job: _Job, status: Status) -> None:
         from byteps_tpu.core.state import get_state
 
+        # step window closes before the handle completes — same
+        # resubmission race as the _finalize path
+        self._step_end(job)
         get_state().handles.mark_done(job.handle, None, status)
 
     def _fail_task(self, task: TensorTableEntry, stage: QueueType,
@@ -1156,6 +1220,31 @@ class PipelineEngine:
         # (docs/gradient-compression.md "Codec auto-selection")
         self._note_compression(task.key, raw_nbytes, len(task.compressed))
         self._proceed(task)
+
+    def _auto_static_verdict(self, key: int, codec) -> None:
+        """Registration-time verdict of the adaptive-compression policy
+        for a size-deterministic codec: the exact wire ratio is
+        ``wire_nbytes() / raw fp32 bytes``, so the key's fate is known
+        before any round ships.  Either way the probe is marked complete
+        (``_auto_stats[key] = None``) so ``_note_compression`` never
+        accumulates probe state for it."""
+        from byteps_tpu.core.telemetry import RATIO_BUCKETS, counters, metrics
+
+        ratio = codec.wire_nbytes() / max(1, codec.size * 4)
+        metrics().observe("compression_ratio", ratio, buckets=RATIO_BUCKETS)
+        self._auto_stats[key] = None  # probe complete at registration
+        if ratio < self.cfg.compression_auto_ratio:
+            return
+        self._compression_auto_off.add(key)
+        counters().bump("compression_auto_off")
+        from byteps_tpu.common import logging as bpslog
+
+        bpslog.warning(
+            "compression auto-disabled for key %d at registration: static "
+            "wire ratio %.3f >= %.3f (BYTEPS_COMPRESSION_AUTO; codec wire "
+            "size is deterministic, no probe rounds needed); rounds push "
+            "raw", key, ratio, self.cfg.compression_auto_ratio,
+        )
 
     def _note_compression(self, key: int, raw_nbytes: int,
                           comp_nbytes: int) -> None:

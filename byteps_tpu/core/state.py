@@ -25,6 +25,7 @@ class RuntimeState:
         self.handles = HandleManager()
         self.engine = None  # core.engine.PipelineEngine (distributed mode)
         self.ps_client = None  # comm.ps_client.PSClient
+        self.flightrec = None  # core.flightrec.FlightRecorder
         self.telemetry = None  # core.telemetry.PushPullSpeed
         self.tracer = None  # core.tracing.Tracer
         self.metrics_http = None  # core.telemetry.MetricsHTTPServer
@@ -162,7 +163,29 @@ def init_state(fresh_env: bool = True) -> RuntimeState:
             # names this process's track in merged timelines
             if st.ps_client.rank is not None:
                 st.tracer.process_name = f"worker{st.ps_client.rank}"
-            st.engine = PipelineEngine(cfg, st.ps_client, st.telemetry, st.tracer)
+            # flight recorder (docs/observability.md "Flight recorder &
+            # doctor"): the engine stamps a ledger record per completed
+            # round; the context closure lets each record carry the
+            # membership/map epoch + scheduler incarnation it ran under
+            from byteps_tpu.core.flightrec import ensure_process_recorder
+
+            client = st.ps_client
+
+            def _flight_ctx(c=client):
+                return {
+                    "epoch": c.membership_epoch,
+                    "map_epoch": max(c.map_epoch, c._seen_map_epoch),
+                    "incarnation": c.sched_incarnation,
+                    "degraded": 0 if c._sched_up.is_set() else 1,
+                }
+
+            st.flightrec = ensure_process_recorder(
+                cfg, context_fn=_flight_ctx, tracer=st.tracer
+            )
+            st.engine = PipelineEngine(
+                cfg, st.ps_client, st.telemetry, st.tracer,
+                flightrec=st.flightrec,
+            )
             st.engine.start()
         st.initialized = True
         return st
@@ -180,6 +203,17 @@ def shutdown_state() -> None:
         if st.ps_client is not None:
             st.ps_client.close()
             st.ps_client = None
+        if st.flightrec is not None:
+            # drop the process recorder: its context closure holds the
+            # closed client, and the next init owns a fresh ring
+            from byteps_tpu.core.flightrec import (
+                get_process_recorder,
+                set_process_recorder,
+            )
+
+            if get_process_recorder() is st.flightrec:
+                set_process_recorder(None)
+            st.flightrec = None
         if st.tracer is not None:
             st.tracer.flush()
         if st.metrics_http is not None:

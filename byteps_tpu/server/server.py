@@ -348,7 +348,29 @@ class PSServer:
             # a dedicated server process tags chaos faults on this tracer;
             # in-process test clusters keep the worker's tracer
             set_process_tracer(self.tracer)
+        # flight recorder (docs/observability.md "Flight recorder &
+        # doctor"): dedicated server processes own the process recorder;
+        # in-process fleets share whichever role created it first (they
+        # already share one metrics registry, so the ledger is coherent)
+        from byteps_tpu.core.flightrec import ensure_process_recorder
+
+        ensure_process_recorder(
+            cfg, context_fn=self._flight_context, tracer=self.tracer
+        )
         self._metrics_http = None
+
+    def _flight_context(self) -> dict:
+        """Control-plane context stamped into every flight record."""
+        from byteps_tpu.core.telemetry import metrics
+
+        # GIL-atomic dict read of the gauge the reconnect machine sets
+        deg = metrics()._gauges.get(("control_plane_degraded", ()), 0)
+        return {
+            "epoch": getattr(self, "membership_epoch", 0),
+            "map_epoch": getattr(self, "_map_epoch", 0),
+            "incarnation": getattr(self, "sched_incarnation", 0),
+            "degraded": int(deg),
+        }
 
     # --- lifecycle -------------------------------------------------------
 
@@ -374,6 +396,13 @@ class PSServer:
         if self._metrics_http is not None:
             self._metrics_http.close()
             self._metrics_http = None
+        # release the flight recorder iff THIS server installed it (a
+        # worker-owned one in an in-process fleet stays); leaving a dead
+        # server's recorder — its context closure and knob snapshot —
+        # would poison the next init cycle's ensure_process_recorder
+        from byteps_tpu.core.flightrec import release_process_recorder
+
+        release_process_recorder(self._flight_context)
         if self.reshard and self.rank is not None:
             # ownership gauges describe a live server only — drop the
             # series (in-process fleets reuse the registry across
@@ -582,10 +611,28 @@ class PSServer:
                             # one registry across several beat loops)
                             metrics().reship_for(inc)
                             beat_incarnation = inc
+                        # flight recorder: servers have no training
+                        # rounds, so the beat IS the step — one ledger
+                        # record per beat gives the hot-stripe and
+                        # queue-stall rules a cadence, and the compact
+                        # tail rides this beat into the scheduler's
+                        # cluster step matrix (docs/observability.md
+                        # "Flight recorder & doctor")
+                        from byteps_tpu.core.flightrec import (
+                            get_process_recorder,
+                        )
+
+                        rec = get_process_recorder()
+                        if rec is not None and rec.enabled:
+                            rec.record_step()
                         # metric deltas piggyback on the beat — the
                         # scheduler aggregates them cluster-wide
                         # (docs/observability.md), same as the workers
                         delta = metrics().delta_snapshot()
+                        if rec is not None and rec.enabled:
+                            tail = rec.ledger_tail()
+                            if tail:
+                                delta["fr"] = tail
                         send_message(
                             conn,
                             Message(
@@ -2176,6 +2223,15 @@ class NativePSServer:
         )
         if get_process_tracer() is None:
             set_process_tracer(self.tracer)
+        # flight recorder: same surface as PSServer — the borrowed
+        # control loop stamps one beat record per heartbeat (the native
+        # hot-stripe gauges/histograms above are exactly what its
+        # hot_stripe rule reads)
+        from byteps_tpu.core.flightrec import ensure_process_recorder
+
+        ensure_process_recorder(
+            cfg, context_fn=self._flight_context, tracer=self.tracer
+        )
         native_server_set_trace(sid, cfg.trace_on and cfg.trace_spans)
         self._span_drain_thread: Optional[threading.Thread] = None
         if cfg.trace_on and cfg.trace_spans:
@@ -2320,6 +2376,7 @@ class NativePSServer:
     _register_with_scheduler = PSServer._register_with_scheduler
     _sched_register_once = PSServer._sched_register_once
     _control_plane_loop = PSServer._control_plane_loop
+    _flight_context = PSServer._flight_context
     _sched_reconnect = PSServer._sched_reconnect
     _handle_control = PSServer._handle_control
     _fence_book = PSServer._fence_book
@@ -2345,6 +2402,11 @@ class NativePSServer:
         if self._metrics_http is not None:
             self._metrics_http.close()
             self._metrics_http = None
+        # flight recorder: release iff this instance installed it (same
+        # rule as PSServer.stop)
+        from byteps_tpu.core.flightrec import release_process_recorder
+
+        release_process_recorder(self._flight_context)
         # freeze the engine's final counter values BEFORE the instance
         # id disappears, so post-stop snapshots keep everything the
         # GIL-free plane counted (and a racing scrape can't double-count)

@@ -136,6 +136,21 @@ def make_ps_server(engine: str, cfg):
     return PSServer(cfg)
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _flight_bundles_to_tmp(tmp_path_factory):
+    """Route flight-recorder diagnostic bundles into a session tmp dir:
+    chaos/deadline tests legitimately produce slow steps, and their
+    triggered bundle dumps must never litter the repo tree.  Tests that
+    assert on bundles set BYTEPS_FLIGHT_DIR themselves (env wins over
+    this default only in subprocesses they spawn; in-process they use
+    recorder.bundle_dir directly)."""
+    if not os.environ.get("BYTEPS_FLIGHT_DIR"):
+        os.environ["BYTEPS_FLIGHT_DIR"] = str(
+            tmp_path_factory.mktemp("flight_bundles")
+        )
+    yield
+
+
 @pytest.fixture(autouse=True)
 def _clean_runtime():
     """Reset global runtime state between tests."""

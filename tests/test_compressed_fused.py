@@ -370,10 +370,11 @@ class TestCompressedEfTrajectory:
                 sched.stop()
 
     def test_auto_policy_disables_loss_making_codec(self, monkeypatch):
-        """BYTEPS_COMPRESSION_AUTO: a codec whose observed wire ratio is
-        a loss (topk with k = n → 2.0) is disabled after the probe
-        rounds; later rounds push raw and stay bitwise correct, while a
-        winning codec (onebit) stays on."""
+        """BYTEPS_COMPRESSION_AUTO: a codec whose wire ratio is a loss
+        (topk with k = n → 2.0) is disabled — since the static fast
+        path, at REGISTRATION (every shipped codec is
+        size-deterministic); later rounds push raw and stay bitwise
+        correct, while a winning codec (onebit) stays on."""
         monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
         monkeypatch.setenv("BYTEPS_COMPRESSION_AUTO", "1")
         monkeypatch.setenv("BYTEPS_COMPRESSION_AUTO_ROUNDS", "2")
@@ -416,3 +417,134 @@ class TestCompressedEfTrajectory:
             _reset_runtime()
             srv.stop()
             sched.stop()
+
+    def test_auto_static_verdict_skips_probe_rounds(self, monkeypatch):
+        """ROADMAP follow-up: deterministic codecs (``wire_static``) get
+        their BYTEPS_COMPRESSION_AUTO verdict at REGISTRATION — exact
+        via ``Compressor.wire_nbytes()`` — so no probe rounds ship
+        compressed loss-making bytes.  Proven by setting the probe
+        budget absurdly high: the probe path could never conclude, yet
+        the loss-making key is off after round 1."""
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        monkeypatch.setenv("BYTEPS_COMPRESSION_AUTO", "1")
+        monkeypatch.setenv("BYTEPS_COMPRESSION_AUTO_ROUNDS", "100000")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            n = 256
+            bps.declare_tensor("static.bad",
+                               byteps_compressor_type="topk",
+                               byteps_compressor_k=str(n))
+            bps.declare_tensor("static.good",
+                               byteps_compressor_type="onebit",
+                               byteps_ef_type="vanilla")
+            x = np.random.default_rng(9).standard_normal(n).astype(
+                np.float32)
+            counters().reset()
+            out = np.asarray(
+                bps.push_pull(x, name="static.bad", average=False)
+            )
+            np.testing.assert_array_equal(out, x)  # round 1 already raw
+            snap = counters().snapshot()
+            # the verdict landed at registration, before any probe round
+            assert snap.get("compression_auto_off", 0) == 1, snap
+            assert snap.get("wire_bytes_saved", 0) == 0, snap
+            # round 1's push was RAW (n fp32), not topk wire (2n fp32)
+            assert snap.get("wire_tx_bytes", 0) <= n * 4, snap
+            # a statically-winning chain (onebit under EF delegates
+            # wire_static) keeps its codec with no probe bookkeeping
+            bps.push_pull(x, name="static.good", average=False)
+            snap = counters().snapshot()
+            assert snap.get("compression_auto_off", 0) == 1, snap
+            assert snap.get("wire_bytes_saved", 0) > 0, snap
+            from byteps_tpu.core.state import require_state
+
+            eng = require_state().engine
+            for key, st in eng._auto_stats.items():
+                assert st is None, (key, st)  # probe closed for all keys
+        finally:
+            bps.shutdown()
+            _reset_runtime()
+            srv.stop()
+            sched.stop()
+
+    def test_auto_probe_path_kept_for_data_dependent_codecs(
+        self, monkeypatch
+    ):
+        """A codec whose wire size is NOT deterministic
+        (``wire_static=False``) still takes the observed-ratio probe:
+        with the static flag forced off, topk k=n is only disabled
+        after BYTEPS_COMPRESSION_AUTO_ROUNDS observed rounds — the
+        pre-static behavior, preserved for custom codecs."""
+        from byteps_tpu.compression.impl import TopKCompressor
+
+        monkeypatch.setattr(TopKCompressor, "wire_static", False)
+        monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+        monkeypatch.setenv("BYTEPS_COMPRESSION_AUTO", "1")
+        monkeypatch.setenv("BYTEPS_COMPRESSION_AUTO_ROUNDS", "2")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            n = 256
+            bps.declare_tensor("probe.bad",
+                               byteps_compressor_type="topk",
+                               byteps_compressor_k=str(n))
+            x = np.random.default_rng(3).standard_normal(n).astype(
+                np.float32)
+            counters().reset()
+            bps.push_pull(x, name="probe.bad", average=False)
+            snap = counters().snapshot()
+            assert snap.get("compression_auto_off", 0) == 0, snap
+            bps.push_pull(x, name="probe.bad", average=False)
+            snap = counters().snapshot()
+            assert snap.get("compression_auto_off", 0) == 1, snap
+        finally:
+            bps.shutdown()
+            _reset_runtime()
+            srv.stop()
+            sched.stop()
+
+    def test_wire_static_flags(self):
+        """Every shipped codec is size-deterministic; EF/momentum
+        wrappers delegate; the abstract base (whose wire_nbytes is a
+        worst-case BOUND) stays False so custom codecs never get a
+        static verdict by accident."""
+        from byteps_tpu.compression.base import Compressor
+        from byteps_tpu.compression.error_feedback import (
+            VanillaErrorFeedback,
+        )
+        from byteps_tpu.compression.impl import (
+            DitheringCompressor,
+            OneBitCompressor,
+            RandomKCompressor,
+            TopKCompressor,
+        )
+
+        assert Compressor.wire_static is False
+        for codec in (OneBitCompressor(64), TopKCompressor(64, 8),
+                      RandomKCompressor(64, 8), DitheringCompressor(64)):
+            assert codec.wire_static is True, type(codec)
+        ef = VanillaErrorFeedback(OneBitCompressor(64))
+        assert ef.wire_static is True

@@ -1,0 +1,660 @@
+"""Flight recorder + anomaly triggers + bps_doctor
+(docs/observability.md "Flight recorder & doctor").
+
+Layers under test:
+
+- ledger ring bounds/eviction, registry-delta records (clamped against
+  test-style counter resets), control context stamping
+- trigger determinism: slow-step fires exactly once per rate-limit
+  window, straggler/hot-stripe/queue-stall/degraded-flip on synthetic
+  registry states, bundle directory contents
+- heartbeat tail merge at the scheduler: idempotent re-shipped windows
+  dedupe by step index, the cluster step matrix marks the straggler,
+  and a live in-process fleet's tails actually arrive
+- bps_doctor: bundle loading, live-scrape loading, ranked findings
+- the acceptance demo: 2 worker subprocesses + 2 servers, one server
+  shaped slow via the chaos van → slow_step + straggler_server fire, a
+  bundle is written, and bps_doctor ranks the straggler-server
+  diagnosis first naming the correct rank
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.comm.rendezvous import Scheduler
+from byteps_tpu.core.flightrec import ClusterFlight, FlightRecorder
+from byteps_tpu.core.telemetry import MetricsRegistry, RobustnessCounters
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_rec(tmp_path, capacity=64, ctx=None, **kw):
+    """A FlightRecorder on its OWN registry (never the process-global
+    one: these tests must not see other tests' counters)."""
+    c = RobustnessCounters()
+    reg = MetricsRegistry(counter_store=c)
+    rec = FlightRecorder(
+        capacity=capacity, registry=reg, counter_store=c, context_fn=ctx,
+    )
+    rec.bundle_dir = str(tmp_path / "bundles")
+    rec.bundle_interval_s = kw.pop("bundle_interval_s", 3600.0)
+    for k, v in kw.items():
+        setattr(rec, k, v)
+    return rec, reg, c
+
+
+class TestLedgerRing:
+    def test_ring_bounds_and_eviction(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path, capacity=4)
+        for _ in range(10):
+            rec.record_step(0.01)
+        ring = rec.snapshot()
+        assert len(ring) == 4
+        assert [r["step"] for r in ring] == [7, 8, 9, 10]
+
+    def test_capacity_zero_disables(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path, capacity=0)
+        assert not rec.enabled
+        assert rec.record_step(0.01) is None
+        assert rec.snapshot() == []
+
+    def test_record_is_a_registry_delta(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path)
+        c.bump("wire_tx_bytes", 100)
+        c.bump("resync_attempt", 2)
+        reg.observe("stage_dwell_seconds", 0.02, labels={"stage": "PUSH"})
+        r1 = rec.record_step(0.01)
+        assert r1["tx"] == 100
+        assert r1["events"]["resync_attempt"] == 2
+        assert r1["stages"]["PUSH"]["n"] == 1
+        # second step: only the increment ships, not the cumulative total
+        c.bump("wire_tx_bytes", 7)
+        r2 = rec.record_step(0.01)
+        assert r2["tx"] == 7
+        assert "resync_attempt" not in r2["events"]
+        assert r2["stages"] == {}
+
+    def test_delta_clamps_after_counter_reset(self, tmp_path):
+        """A test-style counters().reset() mid-flight must never produce
+        negative deltas (the recorder is process-global in real runs)."""
+        rec, reg, c = make_rec(tmp_path)
+        c.bump("wire_tx_bytes", 1000)
+        rec.record_step(0.01)
+        c.reset()
+        c.bump("wire_tx_bytes", 5)
+        r = rec.record_step(0.01)
+        assert r["tx"] == 0  # clamped: 5 - 1000 < 0
+
+    def test_context_stamped(self, tmp_path):
+        ctx = {"epoch": 3, "map_epoch": 2, "incarnation": 99, "degraded": 1}
+        rec, reg, c = make_rec(tmp_path, ctx=lambda: ctx)
+        r = rec.record_step(0.01)
+        assert (r["epoch"], r["map_epoch"], r["incarnation"], r["deg"]) == (
+            3, 2, 99, 1
+        )
+        # beat records (servers) carry no duration and the "beat" kind
+        b = rec.record_step()
+        assert b["k"] == "beat" and b["dur"] is None
+
+
+def _warm(rec, reg, steps=10, dur=0.01, rpc=None):
+    """Feed ``steps`` quiet steps so rolling-median rules have history."""
+    for _ in range(steps):
+        for rank, v in (rpc or {}).items():
+            reg.observe("rpc_round_trip_seconds", v,
+                        labels={"server": rank})
+        rec.record_step(dur)
+
+
+class TestTriggers:
+    def test_slow_step_fires_and_rate_limiter_holds(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path)
+        _warm(rec, reg)
+        r = rec.record_step(0.5)  # 50x the median
+        assert "slow_step" in r["trig"]
+        assert len(rec.bundles_written) == 1
+        # second slow step inside the rate-limit window: counted, not dumped
+        r2 = rec.record_step(0.5)
+        assert "slow_step" in r2["trig"]
+        assert len(rec.bundles_written) == 1
+        labeled = c.snapshot_labeled()["flight_trigger"]
+        assert labeled[(("rule", "slow_step"),)] == 2
+        assert c.get("flight_bundle") == 1
+
+    def test_slow_step_needs_history(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path)
+        for _ in range(3):
+            r = rec.record_step(5.0)  # slow, but no baseline yet
+            assert r["trig"] == []
+
+    def test_straggler_server_on_synthetic_skew(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path)
+        reg.observe("rpc_round_trip_seconds", 0.001, labels={"server": "0"})
+        reg.observe("rpc_round_trip_seconds", 0.001, labels={"server": "2"})
+        reg.observe("rpc_round_trip_seconds", 0.4, labels={"server": "1"})
+        r = rec.record_step(0.4)
+        assert "straggler_server" in r["trig"]
+        (b,) = [p for p in rec.bundles_written if "straggler_server" in p]
+        ev = json.load(open(os.path.join(b, "trigger.json")))["evidence"]
+        assert ev["rank"] == "1"
+
+    def test_straggler_needs_two_ranks_and_a_floor(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path)
+        # one rank only: no peers to compare against
+        reg.observe("rpc_round_trip_seconds", 0.4, labels={"server": "0"})
+        assert "straggler_server" not in rec.record_step(0.4)["trig"]
+        # sub-floor skew (tens of µs): loopback noise must not fire
+        reg.observe("rpc_round_trip_seconds", 1e-5, labels={"server": "0"})
+        reg.observe("rpc_round_trip_seconds", 9e-5, labels={"server": "1"})
+        assert "straggler_server" not in rec.record_step(0.001)["trig"]
+
+    def test_hot_stripe_on_synthetic_state(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path)
+        for _ in range(20):
+            reg.observe("native_stripe_sum_seconds", 0.05,
+                        labels={"stripe": "2"})
+        for s in ("0", "1", "3"):
+            reg.observe("native_stripe_sum_seconds", 0.001,
+                        labels={"stripe": s})
+        r = rec.record_step()  # beat record: servers have no step dur
+        assert "hot_stripe" in r["trig"]
+        (b,) = [p for p in rec.bundles_written if "hot_stripe" in p]
+        ev = json.load(open(os.path.join(b, "trigger.json")))["evidence"]
+        assert ev["stripe"] == "2"
+        assert ev["share"] > 0.9
+
+    def test_queue_stall_on_stage_dwell(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path, stall_s=1.0)
+        reg.observe("stage_dwell_seconds", 8.0, labels={"stage": "PUSH"})
+        r = rec.record_step(8.0)
+        assert "queue_stall" in r["trig"]
+        (b,) = [p for p in rec.bundles_written if "queue_stall" in p]
+        ev = json.load(open(os.path.join(b, "trigger.json")))["evidence"]
+        assert ev["stage"] == "PUSH"
+
+    def test_degraded_flip_fires_on_transition_only(self, tmp_path):
+        state = {"degraded": 0}
+        rec, reg, c = make_rec(tmp_path, ctx=lambda: state)
+        assert "degraded_flip" not in rec.record_step(0.01)["trig"]
+        state["degraded"] = 1
+        assert "degraded_flip" in rec.record_step(0.01)["trig"]
+        # still degraded: a flip fires once, not every step
+        assert "degraded_flip" not in rec.record_step(0.01)["trig"]
+        state["degraded"] = 0
+        rec.record_step(0.01)
+        state["degraded"] = 1
+        assert "degraded_flip" in rec.record_step(0.01)["trig"]
+
+    def test_bundle_contents(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path, stall_s=0.5)
+        c.bump("rpc_retry", 3, labels={"server": "1"})
+        reg.observe("stage_dwell_seconds", 2.0, labels={"stage": "PULL"})
+        rec.record_step(2.0)
+        (b,) = rec.bundles_written
+        trig = json.load(open(os.path.join(b, "trigger.json")))
+        assert trig["rule"] == "queue_stall"
+        ledger = [
+            json.loads(ln)
+            for ln in open(os.path.join(b, "ledger.jsonl"))
+        ]
+        assert len(ledger) == 1 and ledger[0]["step"] == 1
+        snap = json.load(open(os.path.join(b, "metrics.json")))
+        assert snap["counters"]["rpc_retry"] == 3
+        cfgj = json.load(open(os.path.join(b, "config.json")))
+        assert "env" in cfgj and "context" in cfgj
+
+
+class TestHeartbeatTailMerge:
+    def test_tail_is_compact_and_windowed(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path, capacity=64)
+        for _ in range(40):
+            rec.record_step(0.01)
+        tail = rec.ledger_tail(limit=16)
+        assert len(tail) == 16
+        assert tail[-1]["step"] == 40 and tail[0]["step"] == 25
+        assert set(tail[0]) == {"step", "k", "t", "dur", "deg", "trig",
+                                "rpc"}
+
+    def test_cluster_matrix_dedupes_reshipped_windows(self):
+        cf = ClusterFlight()
+        recs = [
+            {"step": i, "k": "step", "dur": 0.01, "t": 0.0, "deg": 0,
+             "trig": [], "rpc": {}}
+            for i in range(1, 6)
+        ]
+        assert cf.merge("worker", 0, recs) == 5
+        assert cf.merge("worker", 0, recs) == 0  # idempotent re-ship
+        assert cf.merge("worker", 0, recs + [
+            {"step": 6, "k": "step", "dur": 0.01, "t": 0.0, "deg": 0,
+             "trig": [], "rpc": {}}
+        ]) == 1
+        assert len(cf.matrix()["worker0"]) == 6
+
+    def test_cluster_straggler_marked_and_counted(self):
+        agg = MetricsRegistry()
+        cf = ClusterFlight()
+        cf.attach(agg)
+        fast = [{"step": 1, "k": "step", "dur": 0.01, "t": 0, "deg": 0,
+                 "trig": [], "rpc": {}}]
+        slow = [{"step": 1, "k": "step", "dur": 0.9, "t": 0, "deg": 0,
+                 "trig": [], "rpc": {}}]
+        cf.merge("worker", 0, fast)
+        assert cf.straggler_rank == -1  # one worker is never a straggler
+        cf.merge("worker", 1, slow)
+        assert cf.straggler_rank == 1
+        labeled = agg.counters.snapshot_labeled()["flight_trigger"]
+        assert labeled[(("rule", "straggler_node"),)] == 1
+        # the gauge the bps_top steps row stars from
+        assert "cluster_straggler_rank" in agg.snapshot()["gauges"]
+        # recovery: the slow worker catches back up
+        cf.merge("worker", 1, [
+            {"step": 2, "k": "step", "dur": 0.011, "t": 0, "deg": 0,
+             "trig": [], "rpc": {}}
+        ])
+        assert cf.straggler_rank == -1
+
+    def test_restarted_node_resets_dedupe_cursor(self):
+        """A reborn node's recorder restarts its step sequence at 1; a
+        tail whose newest step sits below the cursor must reset the
+        node's row instead of being dropped forever (review finding)."""
+        cf = ClusterFlight()
+        old = [{"step": s, "k": "step", "dur": 0.5, "t": 0, "deg": 0,
+                "trig": [], "rpc": {}} for s in range(90, 101)]
+        assert cf.merge("worker", 0, old) == 11
+        reborn = [{"step": s, "k": "step", "dur": 0.01, "t": 1, "deg": 0,
+                   "trig": [], "rpc": {}} for s in (1, 2, 3)]
+        assert cf.merge("worker", 0, reborn) == 3
+        recs = cf.matrix()["worker0"]
+        assert [r["step"] for r in recs] == [1, 2, 3]  # ghost rows gone
+
+    def test_forget_drops_evicted_rank_from_straggler_median(self):
+        cf = ClusterFlight()
+        cf.attach(MetricsRegistry())
+        slow = [{"step": 1, "k": "step", "dur": 0.9, "t": 0, "deg": 0,
+                 "trig": [], "rpc": {}}]
+        fast = [{"step": 1, "k": "step", "dur": 0.01, "t": 0, "deg": 0,
+                 "trig": [], "rpc": {}}]
+        cf.merge("worker", 0, fast)
+        cf.merge("worker", 1, slow)
+        assert cf.straggler_rank == 1
+        cf.forget("worker", 1)  # evicted: its frozen dur leaves the pool
+        assert cf.straggler_rank == -1
+        assert "worker1" not in cf.matrix()
+
+    def test_server_stop_releases_its_recorder(self, monkeypatch,
+                                               tmp_path):
+        """A stopped PSServer releases the process recorder IT
+        installed (stale context/knobs must not leak into the next init
+        cycle), but never one another role owns (review finding)."""
+        from byteps_tpu.core import flightrec as fr
+        from byteps_tpu.server.server import PSServer
+
+        monkeypatch.setattr(fr, "_recorder", None)
+        monkeypatch.setenv("BYTEPS_FLIGHT_DIR", str(tmp_path))
+        srv = PSServer(Config(num_worker=1, num_server=1))
+        assert fr.get_process_recorder() is not None
+        srv.stop()
+        assert fr.get_process_recorder() is None
+        # a recorder owned by someone else survives a server stop
+        other = fr.ensure_process_recorder(context_fn=lambda: {})
+        srv2 = PSServer(Config(num_worker=1, num_server=1))
+        srv2.stop()
+        assert fr.get_process_recorder() is other
+
+    def test_scheduler_routes_fr_payload(self):
+        """The PING payload's "fr" field reaches the scheduler's step
+        matrix and is NOT folded into the metric aggregate."""
+        sched = Scheduler(num_workers=1, num_servers=0, host="127.0.0.1")
+        try:
+            conn = object()
+            with sched._lock:
+                sched._conn_ids[conn] = ("worker", 0)
+            payload = json.dumps({
+                "c": {"wire_rpc": 3},
+                "fr": [{"step": 1, "k": "step", "dur": 0.02, "t": 0.0,
+                        "deg": 0, "trig": ["slow_step"], "rpc": {"0": 0.01}}],
+            }).encode()
+            sched._merge_metric_delta(conn, payload)
+            m = sched.flight.matrix()
+            assert m["worker0"][0]["trig"] == ["slow_step"]
+            agg = sched.metrics_agg.counters.snapshot()
+            assert agg.get("wire_rpc") == 3
+            assert "fr" not in agg
+        finally:
+            sched.stop()
+
+    def test_live_fleet_tails_reach_scheduler(self, monkeypatch, tmp_path):
+        """In-process 1w/1s fleet with fast heartbeats: worker step
+        records and server beat records both land in the scheduler's
+        matrix, and node_step_seconds reaches the aggregate gauges."""
+        monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.2")
+        monkeypatch.setenv("BYTEPS_FLIGHT_DIR", str(tmp_path))
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+        from byteps_tpu.server.server import PSServer
+
+        srv = PSServer(Config.from_env())
+        threading.Thread(target=srv.start, daemon=True).start()
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            x = np.arange(256, dtype=np.float32)
+            for step in range(6):
+                np.testing.assert_array_equal(
+                    np.asarray(bps.push_pull(x, name="fr.live",
+                                             average=False)), x
+                )
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                m = sched.flight.matrix()
+                if any(k.startswith("worker") for k in m):
+                    break
+                time.sleep(0.1)
+            m = sched.flight.matrix()
+            workers = [k for k in m if k.startswith("worker")]
+            assert workers, m.keys()
+            recs = m[workers[0]]
+            assert any(r.get("k") == "step" and r.get("dur") is not None
+                       for r in recs)
+            gauges = sched.metrics_agg.snapshot()["gauges"]
+            assert any(g.startswith("node_step_seconds") for g in gauges), (
+                gauges
+            )
+        finally:
+            bps.shutdown()
+            srv.stop()
+            sched.stop()
+
+
+def _run_doctor(args):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bps_doctor.py"),
+         "--json", *args],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr + r.stdout
+    return json.loads(r.stdout)
+
+
+class TestDoctor:
+    def test_ranks_straggler_first_from_bundle(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path)
+        _warm(rec, reg, rpc={"0": 0.001, "1": 0.001})
+        reg.observe("rpc_round_trip_seconds", 0.001, labels={"server": "0"})
+        reg.observe("rpc_round_trip_seconds", 0.5, labels={"server": "1"})
+        c.bump("rpc_retry", 4, labels={"server": "1"})
+        rec.record_step(0.5)
+        bundles = [p for p in rec.bundles_written
+                   if "straggler_server" in p]
+        findings = _run_doctor(bundles)
+        assert findings, "doctor found nothing"
+        assert findings[0]["rule"] == "straggler_server"
+        assert re.search(r"server rank 1\b", findings[0]["diagnosis"])
+        rules = {f["rule"] for f in findings}
+        assert "slow_step" in rules
+
+    def test_healthy_bundle_yields_nothing(self, tmp_path):
+        rec, reg, c = make_rec(tmp_path, stall_s=0.5)
+        reg.observe("stage_dwell_seconds", 2.0, labels={"stage": "PULL"})
+        rec.record_step(2.0)  # one stall bundle to have something on disk
+        (b,) = rec.bundles_written
+        # scrub the ledger+metrics down to a healthy window
+        healthy = tmp_path / "healthy"
+        healthy.mkdir()
+        (healthy / "metrics.json").write_text(json.dumps({
+            "counters": {"wire_rpc": 100}, "counters_labeled": {},
+            "gauges": {}, "histograms": {},
+        }))
+        (healthy / "ledger.jsonl").write_text("")
+        findings = _run_doctor([str(healthy)])
+        assert findings == []
+        # while the real stall bundle does diagnose the stage
+        findings = _run_doctor([b])
+        assert any(f["rule"] == "stage_stall" for f in findings)
+
+    def test_live_scrape_mode(self, tmp_path):
+        from byteps_tpu.core.telemetry import serve_metrics
+
+        c = RobustnessCounters()
+        reg = MetricsRegistry(counter_store=c)
+        c.bump("sched_stale_book", 2)
+        reg.gauge_set("control_plane_degraded", 1)
+        http = serve_metrics(0, reg.render_prometheus, host="127.0.0.1")
+        try:
+            findings = _run_doctor(
+                ["--live", f"http://127.0.0.1:{http.port}"]
+            )
+            rules = {f["rule"]: f for f in findings}
+            assert "control_plane_stuck" in rules
+            assert "zombie_scheduler" in rules
+            assert findings[0]["rule"] == "control_plane_stuck"
+            # anchors must point at the real doc
+            for f in findings:
+                assert f["anchor"].startswith("docs/troubleshooting.md#")
+        finally:
+            http.close()
+
+
+class TestBpsTopStepsRow:
+    def test_render_sparkline_star_and_trigger_counts(self):
+        """bps_top's steps row: per-node sparkline from poll history,
+        the scheduler-marked straggler rank starred, flight trigger
+        totals summed per rule."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import importlib
+
+            bps_top = importlib.import_module("bps_top")
+        finally:
+            sys.path.remove(os.path.join(REPO, "tools"))
+        cur = {
+            ("byteps_node_step_seconds", '{rank="0",role="worker"}'): 0.01,
+            ("byteps_node_step_seconds", '{rank="1",role="worker"}'): 0.4,
+            ("byteps_cluster_straggler_rank", ""): 1.0,
+            ("byteps_flight_trigger_labeled_total",
+             '{rank="1",role="worker",rule="slow_step"}'): 2.0,
+            ("byteps_flight_trigger_labeled_total",
+             '{rule="straggler_node"}'): 1.0,
+        }
+        hist = {}
+        for _ in range(4):
+            out = bps_top.render("http://sched:9102", cur, {}, 2.0,
+                                 hist=hist)
+        assert "worker1*" in out          # straggler starred
+        assert "worker0 " in out          # peer not starred
+        assert "slow_step=2" in out
+        assert "straggler_node=1" in out
+        # 4 polls of history → a 4-char sparkline
+        row = [ln for ln in out.splitlines() if "worker1*" in ln][0]
+        assert len(row.split()[1]) == 4
+
+    def test_render_without_steps_data_is_unchanged(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import importlib
+
+            bps_top = importlib.import_module("bps_top")
+        finally:
+            sys.path.remove(os.path.join(REPO, "tools"))
+        out = bps_top.render(
+            "http://w0:9102",
+            {("byteps_wire_rpc_total", ""): 5.0}, {}, 2.0, hist={},
+        )
+        assert "steps" not in out
+        assert "wire_rpc" in out
+
+
+_DEMO_WORKER = r"""
+import json, os, sys
+import numpy as np
+import byteps_tpu as bps
+
+bps.init()
+rank = bps.rank()
+N = 1024
+# fr.a -> key 0 -> server rank 1 (the shaped one); fr.b -> key 65536 ->
+# server rank 0 (djb2 over 2 servers) — both servers see traffic, so the
+# straggler rule has a peer baseline every step
+for step in range(40):
+    a = (np.arange(N, dtype=np.float32) + step) * (rank + 1)
+    b = (np.arange(N, dtype=np.float32) - step) * (rank + 1)
+    ha = bps.push_pull_async(a, name="fr.a", average=False)
+    hb = bps.push_pull_async(b, name="fr.b", average=False)
+    base_a = (np.arange(N, dtype=np.float32) + step) * 3
+    base_b = (np.arange(N, dtype=np.float32) - step) * 3
+    np.testing.assert_array_equal(np.asarray(bps.synchronize(ha)), base_a)
+    np.testing.assert_array_equal(np.asarray(bps.synchronize(hb)), base_b)
+snap = bps.get_metrics()
+print("TRIGGERS=" + json.dumps(snap["counters_labeled"].get(
+    "flight_trigger", {})))
+print("COUNTERS=" + json.dumps(bps.get_robustness_counters()))
+print("DEMO_OK rank=%d" % rank)
+"""
+
+
+class TestDoctorDemo:
+    """The acceptance demo (docs/observability.md "Flight recorder &
+    doctor"): 2 workers + 2 servers, server rank 1 shaped slow via the
+    chaos van (every PUSH to it delayed 0..40ms, one seeded drop at
+    targeted frame 21 → a 0.5s deadline stall).  The victim worker's
+    slow_step + straggler_server triggers fire, bundles land on disk,
+    and bps_doctor ranks the straggler-server diagnosis first naming
+    rank 1."""
+
+    def test_straggler_diagnosed_end_to_end(self, monkeypatch, tmp_path):
+        from byteps_tpu.server.server import PSServer
+
+        monkeypatch.setenv("BYTEPS_VAN", "chaos:tcp")
+        monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.5")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+        sched = Scheduler(num_workers=2, num_servers=2, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        # rank order pinned by REGISTRATION order (the book — and
+        # srv.rank — only ships once the whole population is in, so
+        # observe the scheduler's table, not srv0.rank)
+        srv0 = PSServer(Config.from_env())
+        threading.Thread(target=srv0.start, daemon=True).start()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            with sched._lock:
+                if len(sched._nodes["server"]) == 1:
+                    break
+            time.sleep(0.05)
+        with sched._lock:
+            assert len(sched._nodes["server"]) == 1
+        srv1 = PSServer(Config.from_env())
+        threading.Thread(target=srv1.start, daemon=True).start()
+
+        flight_dir = tmp_path / "flight"
+        base_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(sched.port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "2",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.5",
+            "BYTEPS_INIT_DEADLINE_S": "15",
+        }
+        victim_env = {
+            **base_env,
+            "DMLC_WORKER_ID": "0",
+            "BYTEPS_NODE_UID": "doctor-victim",
+            "BYTEPS_FLIGHT_DIR": str(flight_dir),
+            # shape server rank 1 slow, client-side: every PUSH frame to
+            # its port is delayed up to 40ms, and the seeded schedule
+            # drops targeted frame 21 (one deadline stall mid-run, after
+            # the slow-step rule has its 8-step history)
+            "BYTEPS_CHAOS_SEED": "34",
+            "BYTEPS_CHAOS_DELAY": "1.0",
+            "BYTEPS_CHAOS_DELAY_MS": "40",
+            "BYTEPS_CHAOS_DROP": "0.02",
+            "BYTEPS_CHAOS_OPS": "PUSH",
+            "BYTEPS_CHAOS_TARGET_PORT": str(srv1.port),
+            "BYTEPS_RPC_DEADLINE_S": "0.5",
+            "BYTEPS_RPC_RETRIES": "3",
+            "BYTEPS_RPC_BACKOFF_S": "0.05",
+        }
+        peer_env = {
+            **base_env,
+            "DMLC_WORKER_ID": "1",
+            "BYTEPS_NODE_UID": "doctor-peer",
+            "BYTEPS_FLIGHT_DIR": str(tmp_path / "peer_flight"),
+        }
+        try:
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, "-c", _DEMO_WORKER],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True,
+                )
+                for env in (victim_env, peer_env)
+            ]
+            outs = []
+            deadline = time.monotonic() + 180
+            for p in procs:
+                try:
+                    out, _ = p.communicate(
+                        timeout=max(5.0, deadline - time.monotonic())
+                    )
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    out, _ = p.communicate()
+                    pytest.fail(f"demo worker hung:\n{out}")
+                outs.append(out)
+            for p, out in zip(procs, outs):
+                assert p.returncode == 0, f"worker failed:\n{out}"
+                assert "DEMO_OK" in out, out
+            victim_out = outs[0]
+            trig = json.loads(
+                victim_out.split("TRIGGERS=", 1)[1].splitlines()[0]
+            )
+            fired = {k: v for k, v in trig.items()}
+            assert any("straggler_server" in k for k in fired), fired
+            assert any("slow_step" in k for k in fired), fired
+            snap = json.loads(
+                victim_out.split("COUNTERS=", 1)[1].splitlines()[0]
+            )
+            assert snap.get("chaos_drop", 0) >= 1, snap
+            assert snap.get("chaos_delay", 0) >= 20, snap
+            # bundles on disk: one per fired rule (the 60s rate limiter
+            # holds for the whole run)
+            bundles = sorted(
+                os.path.join(flight_dir, d)
+                for d in os.listdir(flight_dir)
+            )
+            strag = [b for b in bundles if "straggler_server" in b]
+            slow = [b for b in bundles if "slow_step" in b]
+            assert len(strag) == 1, bundles
+            assert len(slow) == 1, bundles
+            # the doctor ranks the straggler-server diagnosis first and
+            # names the shaped rank
+            findings = _run_doctor(bundles)
+            assert findings[0]["rule"] == "straggler_server", findings
+            assert re.search(r"server rank 1\b",
+                             findings[0]["diagnosis"]), findings[0]
+            # the scheduler's step matrix saw the victim's steps
+            m = sched.flight.matrix()
+            assert any(k.startswith("worker") for k in m), m.keys()
+        finally:
+            srv0.stop()
+            srv1.stop()
+            sched.stop()

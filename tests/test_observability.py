@@ -612,6 +612,21 @@ class TestMetricsCatalog:
         finally:
             sys.path.remove(os.path.join(REPO, "tools"))
 
+    def test_doctor_rules_complete(self):
+        """tools/check_doctor_rules.py: every bps_doctor rule names a
+        real docs/troubleshooting.md anchor and is cited by the field
+        guide, and every field-guide row names a rule (or carries an
+        explicit no-rule waiver) — the doc/rule rot guard for the
+        diagnosis engine (docs/observability.md "Flight recorder &
+        doctor")."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_doctor_rules
+
+            assert check_doctor_rules.main(["--repo", REPO]) == 0
+        finally:
+            sys.path.remove(os.path.join(REPO, "tools"))
+
 
 @pytest.fixture
 def observed_cluster(monkeypatch, tmp_path):

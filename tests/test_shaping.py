@@ -168,6 +168,22 @@ class TestShapedSocket:
         assert rate_bps == pytest.approx(100e6)
         assert buf == 256 * 1024
 
+    def test_canonical_rate_name_and_legacy_alias(self, monkeypatch):
+        """BYTEPS_VAN_RATE_MBYTES_S is the canonical spelling (the unit
+        was always megaBYTES/s — the old "MBPS" suffix was the naming
+        trap); the legacy name still works, same unit, and the
+        canonical name wins when both are set."""
+        monkeypatch.delenv("BYTEPS_VAN_RATE_MBPS", raising=False)
+        monkeypatch.setenv("BYTEPS_VAN_RATE_MBYTES_S", "25")
+        assert shaping_params()[1] == pytest.approx(25e6)
+        # legacy alias alone: same MB/s meaning
+        monkeypatch.delenv("BYTEPS_VAN_RATE_MBYTES_S", raising=False)
+        monkeypatch.setenv("BYTEPS_VAN_RATE_MBPS", "10")
+        assert shaping_params()[1] == pytest.approx(10e6)
+        # both set: canonical wins
+        monkeypatch.setenv("BYTEPS_VAN_RATE_MBYTES_S", "40")
+        assert shaping_params()[1] == pytest.approx(40e6)
+
 
 class TestShapedCluster:
     def test_push_pull_correct_and_delayed_through_shaped_van(self, monkeypatch):

@@ -1,0 +1,666 @@
+#!/usr/bin/env python
+"""bps_doctor — rank diagnoses from a flight-recorder bundle or live endpoints.
+
+The telemetry plane can already *show* everything (bps_top, trace_merge,
+the Prometheus families); this tool makes it *conclude*.  It loads a
+diagnostic bundle directory (written by a flight-recorder trigger —
+``ledger.jsonl`` + ``metrics.json`` + ``trigger.json`` + ``config.json``,
+docs/observability.md "Flight recorder & doctor") or scrapes live
+Prometheus endpoints (``--live URL...``), runs a ranked rule table that
+codifies the docs/troubleshooting.md field guide, and prints each
+matching diagnosis with the evidence it matched, the doc anchor to read,
+and the knob to turn.
+
+Every rule names a real anchor in docs/troubleshooting.md, and every
+field-guide failure mode names a rule (or carries an explicit
+``no-rule:`` waiver) — ``tools/check_doctor_rules.py`` (tier-1) fails
+the build when either direction rots.
+
+Usage:
+
+    python tools/bps_doctor.py ./flight_bundles/20260804-*-straggler_server-*
+    python tools/bps_doctor.py --live http://w0:9102 http://sched:9102
+    python tools/bps_doctor.py --json <bundle-dir>     # machine-readable
+
+Stdlib only (the doctor must run on a box where byteps itself won't).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import statistics
+import sys
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+TROUBLESHOOTING = "docs/troubleshooting.md"
+
+
+def slugify(heading: str) -> str:
+    """Markdown heading → anchor slug.  Deliberately dumb (lowercase,
+    non-alphanumeric runs → one '-') and SHARED with
+    tools/check_doctor_rules.py so the two can never disagree."""
+    return re.sub(r"[^a-z0-9]+", "-", heading.lower()).strip("-")
+
+
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_labels(s: str) -> Dict[str, str]:
+    """'{server="1",stage="PUSH"}' (or '') → dict."""
+    return dict(_LABEL_RE.findall(s or ""))
+
+
+class View:
+    """One normalized read surface over a bundle OR a live scrape:
+    flat counters, labeled counter slices, histogram summaries
+    (count/p50/p90/p99 per label set), gauges, and the flight ledger
+    (empty in live mode — the ledger lives node-side)."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, float] = {}
+        self.labeled: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+        self.hists: Dict[str, List[Tuple[Dict[str, str], Dict[str, float]]]] = {}
+        self.gauges: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+        self.ledger: List[dict] = []
+        self.trigger: Optional[dict] = None
+        self.sources: List[str] = []
+
+    # --- accessors rules use --------------------------------------------
+
+    def counter(self, *names: str) -> float:
+        return sum(self.counters.get(n, 0.0) for n in names)
+
+    def labeled_by(self, name: str, label: str) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for labels, v in self.labeled.get(name, []):
+            key = labels.get(label)
+            if key is not None:
+                out[key] = out.get(key, 0.0) + v
+        return out
+
+    def hist_by(self, name: str, label: str) -> Dict[str, Dict[str, float]]:
+        """{label_value: summary} for series of ``name`` carrying
+        ``label``; same-value series (several scrape sources) keep the
+        max p99 and summed count."""
+        out: Dict[str, Dict[str, float]] = {}
+        for labels, summ in self.hists.get(name, []):
+            key = labels.get(label)
+            if key is None:
+                continue
+            cur = out.get(key)
+            if cur is None:
+                out[key] = dict(summ)
+            else:
+                cur["count"] = cur.get("count", 0) + summ.get("count", 0)
+                for q in ("p50", "p90", "p99"):
+                    cur[q] = max(cur.get(q, 0.0), summ.get(q, 0.0))
+        return out
+
+    def hist_top(self, name: str, q: str = "p99") -> float:
+        """The worst quantile across every series of a family."""
+        return max(
+            (summ.get(q, 0.0) for _l, summ in self.hists.get(name, [])),
+            default=0.0,
+        )
+
+    def hist_count(self, name: str) -> float:
+        return sum(s.get("count", 0) for _l, s in self.hists.get(name, []))
+
+    def gauge_max(self, name: str) -> float:
+        return max((v for _l, v in self.gauges.get(name, [])), default=0.0)
+
+    def ledger_triggers(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.ledger:
+            for rule in r.get("trig") or ():
+                out[rule] = out.get(rule, 0) + 1
+        if self.trigger and self.trigger.get("rule"):
+            out[self.trigger["rule"]] = out.get(self.trigger["rule"], 0) + 1
+        return out
+
+    # --- loaders ---------------------------------------------------------
+
+    def load_bundle(self, path: str) -> "View":
+        self.sources.append(path)
+        mpath = os.path.join(path, "metrics.json")
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                snap = json.load(f)
+            for name, v in (snap.get("counters") or {}).items():
+                self.counters[name] = self.counters.get(name, 0.0) + float(v)
+            for name, per in (snap.get("counters_labeled") or {}).items():
+                dst = self.labeled.setdefault(name, [])
+                for lstr, v in per.items():
+                    dst.append((parse_labels(lstr), float(v)))
+            for series, summ in (snap.get("histograms") or {}).items():
+                name, _, lstr = series.partition("{")
+                self.hists.setdefault(name, []).append(
+                    (parse_labels("{" + lstr if lstr else ""), dict(summ))
+                )
+            for series, v in (snap.get("gauges") or {}).items():
+                name, _, lstr = series.partition("{")
+                self.gauges.setdefault(name, []).append(
+                    (parse_labels("{" + lstr if lstr else ""), float(v))
+                )
+        lpath = os.path.join(path, "ledger.jsonl")
+        if os.path.exists(lpath):
+            with open(lpath) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            self.ledger.append(json.loads(line))
+                        except ValueError:
+                            continue
+        tpath = os.path.join(path, "trigger.json")
+        if os.path.exists(tpath):
+            with open(tpath) as f:
+                try:
+                    self.trigger = json.load(f)
+                except ValueError:
+                    self.trigger = None
+        return self
+
+    def load_live(self, urls: List[str], timeout: float = 3.0) -> "View":
+        for url in urls:
+            if "://" not in url:
+                url = "http://" + url
+            self.sources.append(url)
+            body = urllib.request.urlopen(url, timeout=timeout).read().decode()
+            self._parse_prometheus(body)
+        return self
+
+    def _parse_prometheus(self, body: str) -> None:
+        hist_parts: Dict[Tuple[str, str], Dict[str, float]] = {}
+        for line in body.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            try:
+                series, value = line.rsplit(" ", 1)
+                v = float(value)
+            except ValueError:
+                continue
+            name, _, lstr = series.partition("{")
+            lstr = "{" + lstr if lstr else ""
+            if name.startswith("byteps_"):
+                name = name[len("byteps_"):]
+            if name.endswith("_labeled_total"):
+                base = name[: -len("_labeled_total")]
+                self.labeled.setdefault(base, []).append((parse_labels(lstr), v))
+            elif name.endswith("_total"):
+                base = name[: -len("_total")]
+                self.counters[base] = self.counters.get(base, 0.0) + v
+            elif name.endswith(("_p50", "_p90", "_p99", "_count", "_sum")):
+                base, _, part = name.rpartition("_")
+                if part == "sum" or name.endswith("_bucket"):
+                    continue
+                hist_parts.setdefault((base, lstr), {})[
+                    "count" if part == "count" else part
+                ] = v
+            elif not name.endswith("_bucket"):
+                self.gauges.setdefault(name, []).append((parse_labels(lstr), v))
+        for (base, lstr), summ in hist_parts.items():
+            if "p50" in summ or "p99" in summ:
+                self.hists.setdefault(base, []).append(
+                    (parse_labels(lstr), summ)
+                )
+
+
+# --- the rule table --------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    score: float
+    diagnosis: str
+    evidence: List[str]
+    anchor: str
+    knob: str
+
+
+@dataclass
+class Rule:
+    """One executable row of the troubleshooting field guide: the
+    predicate reads the View, the anchor points at the doc section it
+    codifies (a real heading slug — tier-1-enforced), the knob is the
+    first thing to turn."""
+
+    name: str
+    anchor: str
+    knob: str
+    fn: Callable[[View], Optional[Tuple[float, str, List[str]]]] = field(
+        repr=False, default=None
+    )
+
+    def run(self, view: View) -> Optional[Finding]:
+        try:
+            out = self.fn(view)
+        except Exception:  # noqa: BLE001 — one broken rule ≠ no diagnosis
+            return None
+        if out is None:
+            return None
+        score, diagnosis, evidence = out
+        return Finding(self.name, round(score, 1), diagnosis, evidence,
+                       f"{TROUBLESHOOTING}#{self.anchor}", self.knob)
+
+
+_SLOW_ANCHOR = slugify("A step is slow — which metric to read first")
+
+
+def _r_straggler_server(v: View):
+    """One server rank's latency/retry totals run away from its peers."""
+    per = v.hist_by("rpc_round_trip_seconds", "server")
+    cells = {r: s for r, s in per.items()
+             if r != "?" and s.get("count", 0) > 0}
+    # the per-step ledger view (survives even when cumulative histograms
+    # have averaged the incident away): worst per-record skew
+    led_rank, led_skew = None, 0.0
+    for rec in v.ledger:
+        rpc = rec.get("rpc") or {}
+        vals = {r: (p.get("p99", 0.0) if isinstance(p, dict) else float(p))
+                for r, p in rpc.items() if r != "?"}
+        if len(vals) < 2:
+            continue
+        worst = max(vals, key=vals.get)
+        others = [x for r, x in vals.items() if r != worst]
+        skew = vals[worst] / max(statistics.median(others), 1e-6)
+        if skew > led_skew:
+            led_rank, led_skew = worst, skew
+    evidence, rank, skew = [], None, 0.0
+    if len(cells) >= 2:
+        worst = max(cells, key=lambda r: cells[r].get("p99", 0.0))
+        others = [cells[r].get("p99", 0.0) for r in cells if r != worst]
+        med = statistics.median(others)
+        hskew = cells[worst].get("p99", 0.0) / max(med, 1e-6)
+        if hskew >= 3.0:
+            rank, skew = worst, hskew
+            evidence.append(
+                f"rpc_round_trip_seconds p99: server {worst} = "
+                f"{cells[worst].get('p99', 0.0):.4f}s vs peer median "
+                f"{med:.4f}s ({hskew:.0f}x)"
+            )
+    if led_skew >= 3.0 and (rank is None or led_rank == rank
+                            or led_skew > skew):
+        rank = led_rank if rank is None else rank
+        skew = max(skew, led_skew)
+        evidence.append(
+            f"flight ledger: per-step RPC p99 skew up to {led_skew:.0f}x "
+            f"toward server {led_rank}"
+        )
+    retries = v.labeled_by("rpc_retry", "server")
+    expiries = v.labeled_by("rpc_deadline_expired", "server")
+    for fam, per_rank in (("rpc_retry", retries),
+                          ("rpc_deadline_expired", expiries)):
+        if rank is not None and per_rank.get(rank, 0) > 0:
+            evidence.append(
+                f"{fam}_labeled_total{{server={rank}}} = "
+                f"{int(per_rank[rank])}"
+            )
+    trig = v.ledger_triggers().get("straggler_server", 0)
+    if trig:
+        evidence.append(f"straggler_server trigger fired {trig}x on-node")
+    if rank is None and retries and sum(retries.values()) >= 3:
+        worst = max(retries, key=retries.get)
+        others = [x for r, x in retries.items() if r != worst] or [0]
+        if retries[worst] >= 3 * max(statistics.median(others), 1):
+            rank, skew = worst, retries[worst]
+            evidence.append(
+                f"retries skewed to server {worst}: {int(retries[worst])} "
+                f"vs peer median {statistics.median(others):.0f}"
+            )
+    if rank is None:
+        return None
+    score = 60 + min(30.0, 10 * math.log10(max(skew, 1.0)))
+    return (
+        score,
+        f"server rank {rank} is the straggler: its RPC latency/retries "
+        "run far ahead of every peer — that server is slow, sick, or "
+        "behind a bad link",
+        evidence,
+    )
+
+
+def _r_slow_step(v: View):
+    """Steps much slower than the rolling median (flight slow_step)."""
+    trig = v.ledger_triggers().get("slow_step", 0)
+    durs = [r["dur"] for r in v.ledger
+            if r.get("k") == "step" and r.get("dur") is not None]
+    ev = []
+    ratio = 0.0
+    if len(durs) >= 8:
+        med = statistics.median(durs)
+        worst = max(durs)
+        ratio = worst / max(med, 1e-9)
+        if ratio >= 3.0:
+            ev.append(
+                f"ledger: worst step {worst:.3f}s vs median {med:.3f}s "
+                f"({ratio:.1f}x)"
+            )
+    if trig:
+        ev.append(f"slow_step trigger fired {trig}x on-node")
+    if not ev:
+        return None
+    return (
+        38 + min(10.0, ratio),
+        "individual steps are stalling far past the typical step time — "
+        "read the per-stage and per-server rows below this one to name "
+        "the hop",
+        ev,
+    )
+
+
+def _r_wire_bottleneck(v: View):
+    rpc = v.hist_top("rpc_round_trip_seconds")
+    srv = max(v.hist_top("server_sum_seconds"),
+              v.hist_top("native_server_sum_seconds"))
+    if rpc <= 0 or srv <= 0:
+        return None
+    if rpc < 5 * srv or rpc < 0.005:
+        return None
+    return (
+        34,
+        "the wire (or client overhead), not the server, is eating the "
+        f"round trip: RPC p99 {rpc:.4f}s vs server sum p99 {srv:.4f}s",
+        [f"rpc_round_trip_seconds p99 = {rpc:.4f}s",
+         f"server sum p99 = {srv:.4f}s"],
+    )
+
+
+def _r_stage_stall(v: View):
+    per = v.hist_by("stage_dwell_seconds", "stage")
+    hot = {s: d for s, d in per.items() if d.get("p99", 0.0) >= 1.0}
+    trig = v.ledger_triggers().get("queue_stall", 0)
+    if not hot and not trig:
+        return None
+    ev = [f"stage_dwell_seconds{{stage={s}}} p99 = {d['p99']:.2f}s"
+          for s, d in sorted(hot.items(), key=lambda kv: -kv[1]["p99"])]
+    if trig:
+        ev.append(f"queue_stall trigger fired {trig}x on-node")
+    worst = max(hot, key=lambda s: hot[s]["p99"]) if hot else "?"
+    return (
+        30 + min(10.0, max((d["p99"] for d in hot.values()), default=0.0)),
+        f"pipeline stage {worst} is where tasks park — queue wait is "
+        "inside the dwell, so this names the stalled stage directly",
+        ev,
+    )
+
+
+def _r_server_stall(v: View):
+    srv = max(v.hist_top("server_sum_seconds"),
+              v.hist_top("native_server_sum_seconds"),
+              v.hist_top("server_publish_seconds"),
+              v.hist_top("native_server_publish_seconds"))
+    rpc = v.hist_top("rpc_round_trip_seconds")
+    if srv < 0.05 or (rpc > 0 and srv < 0.5 * rpc):
+        return None
+    return (
+        33,
+        "the server-side ledger/summation path is the bottleneck "
+        f"(sum/publish p99 {srv:.4f}s)",
+        [f"server sum/publish p99 = {srv:.4f}s",
+         f"rpc_round_trip_seconds p99 = {rpc:.4f}s"],
+    )
+
+
+def _r_hot_stripe(v: View):
+    per = v.hist_by("native_stripe_sum_seconds", "stripe")
+    trig = v.ledger_triggers().get("hot_stripe", 0)
+    ev = []
+    if len(per) >= 2:
+        counts = {s: d.get("count", 0) for s, d in per.items()}
+        worst = max(counts, key=counts.get)
+        others = [c for s, c in counts.items() if s != worst]
+        med = statistics.median(others)
+        if counts[worst] >= 3 * max(med, 1):
+            ev.append(
+                f"native_stripe_sum_seconds counts: stripe {worst} = "
+                f"{int(counts[worst])} vs sibling median {med:.0f}"
+            )
+    if trig:
+        ev.append(f"hot_stripe trigger fired {trig}x on-node")
+    if not ev:
+        return None
+    return (
+        32,
+        "one native reducer stripe is doing most of the summation — the "
+        "key hash is aliasing hot keys onto one reducer",
+        ev,
+    )
+
+
+def _r_fusion_overhead(v: View):
+    frames = v.counter("fused_frames")
+    per = v.hists.get("fused_pack_keys", [])
+    if not frames or not per:
+        return None
+    p50 = max(s.get("p50", 0.0) for _l, s in per)
+    if p50 > 1.0:
+        return None
+    return (
+        15,
+        "fusion is pure overhead: packs carry one key at the median "
+        "(nothing coalesces)",
+        [f"fused_pack_keys p50 = {p50:.1f} over "
+         f"{int(frames)} fused frames"],
+    )
+
+
+def _r_retry_burn(v: View):
+    retries = v.counter("rpc_retry")
+    backoffs = v.hist_count("retry_backoff_seconds")
+    if retries < 3 and backoffs < 3:
+        return None
+    return (
+        22 + min(8.0, math.log10(max(retries, 1.0)) * 4),
+        "the job is spending wall time sitting out retry backoffs — find "
+        "the failing peer (straggler row) before raising the budget",
+        [f"rpc_retry_total = {int(retries)}",
+         f"retry_backoff_seconds count = {int(backoffs)}"],
+    )
+
+
+def _r_replay_landing(v: View):
+    dedup = v.counter("push_dedup", "native_push_dedup")
+    if dedup <= 0:
+        return None
+    return (
+        18,
+        "replayed pushes are landing (lost acks) — sums are safe "
+        "(exactly-once ledger) but latency is paying for re-sends; the "
+        "deadline may be tighter than the server's p99",
+        [f"push_dedup(+native) total = {int(dedup)}"],
+    )
+
+
+def _r_healed_in_place(v: View):
+    attempts = v.counter("resync_attempt")
+    giveups = v.counter("resync_giveup")
+    if attempts <= 0:
+        return None
+    ev = [f"resync_attempt_total = {int(attempts)}",
+          f"resync_replayed_rounds_total = "
+          f"{int(v.counter('resync_replayed_rounds'))}"]
+    if giveups > 0:
+        ev.append(f"resync_giveup_total = {int(giveups)} — heals FAILING")
+        return (
+            45,
+            "in-place heals are failing and the job fell back to re-init "
+            "— check whether the peer is actually down (eviction's job, "
+            "not resync's)",
+            ev,
+        )
+    per = v.labeled_by("resync_attempt", "server")
+    if per:
+        worst = max(per, key=per.get)
+        ev.append(f"heals target server {worst}")
+    return (
+        26,
+        "a worker healed in place: retries to one server exhausted, the "
+        "recovery plane resynced and replayed the journaled rounds",
+        ev,
+    )
+
+
+def _r_control_plane_stuck(v: View):
+    deg = v.gauge_max("control_plane_degraded")
+    flips = v.ledger_triggers().get("degraded_flip", 0)
+    if deg < 1 and not flips:
+        return None
+    ev = [f"control_plane_degraded = {int(deg)}"]
+    rc, rj = v.counter("sched_reconnect"), v.counter("sched_rejoin")
+    if rc:
+        ev.append(f"sched_reconnect_total = {int(rc)}, "
+                  f"sched_rejoin_total = {int(rj)}")
+    if flips:
+        ev.append(f"degraded_flip trigger fired {flips}x on-node")
+    score = 55 if deg >= 1 else 35
+    return (
+        score,
+        "the scheduler link is (or was) down: training continues on the "
+        "last book, but resize/evict/aggregate are frozen until the "
+        "reconnect machine rejoins",
+        ev,
+    )
+
+
+def _r_zombie_scheduler(v: View):
+    stale = v.counter("sched_stale_book")
+    if stale <= 0:
+        return None
+    return (
+        24,
+        "a zombie scheduler (the pre-restart instance) is still sending "
+        "books — harmless (incarnation-fenced) but kill the old process",
+        [f"sched_stale_book_total = {int(stale)}"],
+    )
+
+
+def _r_compression_loss(v: View):
+    off = v.counter("compression_auto_off")
+    if off <= 0:
+        return None
+    return (
+        14,
+        "the adaptive compression policy disabled loss-making codecs — "
+        "those keys' configured codec costs more wire than it saves",
+        [f"compression_auto_off_total = {int(off)}"],
+    )
+
+
+def _r_chaos_active(v: View):
+    total = v.counter("chaos_drop", "chaos_delay", "chaos_disconnect",
+                      "chaos_truncate", "chaos_corrupt")
+    if total <= 0:
+        return None
+    return (
+        10,
+        "the chaos van is armed and injected faults during this window — "
+        "anomalies above may be rehearsed, not organic (injected faults "
+        "are tagged `injected: true` on the merged timeline)",
+        [f"chaos_* injected faults = {int(total)}"],
+    )
+
+
+RULES: List[Rule] = [
+    Rule("straggler_server", _SLOW_ANCHOR,
+         "BYTEPS_DEAD_NODE_TIMEOUT_S (evict it) / fix the sick server",
+         _r_straggler_server),
+    Rule("slow_step", _SLOW_ANCHOR,
+         "BYTEPS_FLIGHT_SLOW_FACTOR (trigger sensitivity)", _r_slow_step),
+    Rule("wire_bottleneck", _SLOW_ANCHOR,
+         "BYTEPS_TCP_STREAMS / check shaping + DCN", _r_wire_bottleneck),
+    Rule("stage_stall", _SLOW_ANCHOR,
+         "per stage: BYTEPS_PARTITION_BYTES / BYTEPS_THREADPOOL_SIZE / "
+         "BYTEPS_MIN_COMPRESS_BYTES", _r_stage_stall),
+    Rule("server_stall", _SLOW_ANCHOR,
+         "BYTEPS_SERVER_ENGINE_THREAD / BYTEPS_SERVER_NATIVE=1 / "
+         "BYTEPS_KEY_HASH_FN=mixed", _r_server_stall),
+    Rule("hot_stripe", _SLOW_ANCHOR,
+         "BYTEPS_SERVER_STRIPES / BYTEPS_KEY_HASH_FN", _r_hot_stripe),
+    Rule("fusion_overhead", _SLOW_ANCHOR,
+         "BYTEPS_FUSION_CYCLE_MS up or BYTEPS_FUSION_THRESHOLD down",
+         _r_fusion_overhead),
+    Rule("retry_burn", _SLOW_ANCHOR,
+         "fix the failing peer first; then BYTEPS_RPC_RETRIES",
+         _r_retry_burn),
+    Rule("replay_landing", _SLOW_ANCHOR,
+         "BYTEPS_RPC_DEADLINE_S above the server's p99", _r_replay_landing),
+    Rule("healed_in_place", _SLOW_ANCHOR,
+         "BYTEPS_JOURNAL_ROUNDS / check the target server's health",
+         _r_healed_in_place),
+    Rule("control_plane_stuck", _SLOW_ANCHOR,
+         "restart the scheduler on the SAME address; "
+         "BYTEPS_SCHED_RECONNECT_RETRIES", _r_control_plane_stuck),
+    Rule("zombie_scheduler", _SLOW_ANCHOR,
+         "kill the superseded scheduler process", _r_zombie_scheduler),
+    Rule("compression_loss", _SLOW_ANCHOR,
+         "BYTEPS_COMPRESSION_AUTO_RATIO / pick a codec with a real win",
+         _r_compression_loss),
+    Rule("chaos_active", _SLOW_ANCHOR,
+         "unset BYTEPS_CHAOS_* if this is not a rehearsal",
+         _r_chaos_active),
+]
+
+
+def diagnose(view: View) -> List[Finding]:
+    """Run every rule; findings ranked most-severe first."""
+    findings = [f for f in (r.run(view) for r in RULES) if f is not None]
+    findings.sort(key=lambda f: -f.score)
+    return findings
+
+
+def render(findings: List[Finding], view: View) -> str:
+    lines = [
+        f"bps_doctor — {len(findings)} diagnosis(es) from "
+        f"{', '.join(view.sources) or 'nothing'}"
+    ]
+    if not findings:
+        lines.append("  nothing matched: no failure-mode signature in "
+                     "this window (or the bundle is empty)")
+    for i, f in enumerate(findings, 1):
+        lines.append(f"{i:3d}. [{f.rule} {f.score:5.1f}] {f.diagnosis}")
+        for ev in f.evidence:
+            lines.append(f"       evidence: {ev}")
+        lines.append(f"       read: {f.anchor}")
+        lines.append(f"       knob: {f.knob}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", nargs="*",
+                    help="flight-recorder bundle directory(ies)")
+    ap.add_argument("--live", nargs="+", default=[],
+                    help="scrape live Prometheus endpoints instead")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    if not args.bundle and not args.live:
+        ap.error("give a bundle directory or --live URLs")
+    view = View()
+    for b in args.bundle:
+        if not os.path.isdir(b):
+            print(f"not a bundle directory: {b}", file=sys.stderr)
+            return 2
+        view.load_bundle(b)
+    if args.live:
+        view.load_live(args.live)
+    findings = diagnose(view)
+    if args.json:
+        print(json.dumps(
+            [f.__dict__ for f in findings], indent=2, default=str
+        ))
+    else:
+        print(render(findings, view))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

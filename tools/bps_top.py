@@ -83,12 +83,70 @@ def _histo_rows(s: Sample) -> list:
     return rows
 
 
-def render(url: str, cur: Sample, prev: Sample, dt: float) -> str:
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals: list) -> str:
+    """Tiny unicode sparkline, scaled to the row's own max."""
+    if not vals:
+        return ""
+    top = max(vals) or 1.0
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int(v / top * (len(_SPARK) - 1)))]
+        for v in vals
+    )
+
+
+def render(url: str, cur: Sample, prev: Sample, dt: float,
+           hist: dict = None) -> str:
     lines = [f"── {url} " + "─" * max(0, 60 - len(url))]
     # gauges
     for (name, lbl), v in sorted(cur.items()):
         if name == "byteps_pushpull_mbps":
             lines.append(f"  push/pull throughput : {v:10.2f} MB/s")
+    # flight-recorder steps row (docs/observability.md "Flight recorder
+    # & doctor"): last-N step-time sparkline per node from the
+    # node_step_seconds gauge history across polls, the scheduler-marked
+    # straggler rank starred, and flight trigger counts per rule.  On a
+    # node endpoint the gauge is unlabeled; on the scheduler aggregate
+    # each node's series carries {role, rank}.
+    straggler = cur.get(("byteps_cluster_straggler_rank", ""), -1.0)
+    step_rows = []
+    for (name, lbl), v in cur.items():
+        if name != "byteps_node_step_seconds":
+            continue
+        series = None
+        if hist is not None:
+            series = hist.setdefault((name, lbl), [])
+            series.append(v)
+            del series[:-24]
+        rm = re.search(r'rank="(-?\d+)"', lbl)
+        role_m = re.search(r'role="([^"]*)"', lbl)
+        who = (
+            f"{role_m.group(1) if role_m else 'node'}"
+            f"{rm.group(1) if rm else ''}"
+        )
+        star = (
+            "*" if rm and (role_m is None or role_m.group(1) == "worker")
+            and float(rm.group(1)) == straggler else " "
+        )
+        step_rows.append((who, star, v, list(series or [v])))
+    if step_rows:
+        lines.append(f"  {'steps (sparkline = last polls)':42s} {'last':>9s}")
+        for who, star, v, series in sorted(step_rows):
+            lines.append(
+                f"  {who + star:10s} {_sparkline(series):24s}"
+                f" {_fmt_s(v):>12s}"
+            )
+        trig = {}
+        for (name, lbl), v in cur.items():
+            if name == "byteps_flight_trigger_labeled_total":
+                tm = re.search(r'rule="([^"]*)"', lbl)
+                if tm:
+                    trig[tm.group(1)] = trig.get(tm.group(1), 0) + int(v)
+        if trig:
+            cells = " ".join(f"{r}={n}" for r, n in sorted(trig.items()))
+            lines.append(f"  flight triggers      : {cells}")
     # reducer backlog of the key-striped native engine, one cell per
     # stripe — a persistently deep cell while its siblings sit at 0 is
     # the hot-stripe signature (docs/perf.md).  Sorted numerically (s2
@@ -217,6 +275,7 @@ def main(argv=None) -> int:
                     help="print one frame and exit (no screen clearing)")
     args = ap.parse_args(argv)
     prev: Dict[str, Sample] = {}
+    hist: Dict[str, dict] = {}
     t_prev = time.monotonic()
     while True:
         frames = []
@@ -228,7 +287,10 @@ def main(argv=None) -> int:
             except Exception as e:  # noqa: BLE001 — a dead peer is a display fact
                 frames.append(f"── {url}\n  unreachable: {e}")
                 continue
-            frames.append(render(url, cur, prev.get(url, {}), dt))
+            frames.append(render(
+                url, cur, prev.get(url, {}), dt,
+                hist=hist.setdefault(url, {}),
+            ))
             prev[url] = cur
         t_prev = now
         out = "\n\n".join(frames)

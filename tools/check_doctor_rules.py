@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""CI guard: bps_doctor's rule table and the troubleshooting field guide
+may never drift apart.
+
+The doctor (tools/bps_doctor.py) is docs/troubleshooting.md made
+executable — which only stays true if the binding is enforced, the same
+way tools/check_metrics_doc.py pins metric names and
+tools/check_env_doc.py pins env knobs.  Two directions:
+
+1. **rule → doc**: every rule's ``anchor`` must name a REAL heading in
+   docs/troubleshooting.md (slugs computed with the doctor's own
+   ``slugify``, so the two can't disagree), and every rule must be
+   cited by at least one ``<!-- rule: <name> -->`` marker in the doc.
+2. **doc → rule**: every row of a field-guide table (the table
+   following a ``<!-- doctor: field-guide -->`` sentinel) must carry a
+   ``<!-- rule: <name> -->`` marker naming an existing rule, or an
+   explicit ``<!-- no-rule: <reason> -->`` waiver — a failure mode
+   documented for humans but not codified for the doctor is a
+   conscious decision, never an accident.
+
+Wired into tier-1 as
+``tests/test_observability.py::test_doctor_rules_complete``.
+
+Usage: ``python tools/check_doctor_rules.py [--repo ROOT]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import re
+import sys
+
+_HEADING_RE = re.compile(r"^#{1,6}\s+(.*)$")
+_RULE_MARK_RE = re.compile(r"<!--\s*rule:\s*([a-z0-9_]+)\s*-->")
+_WAIVER_RE = re.compile(r"<!--\s*no-rule:\s*([^>]+?)\s*-->")
+_SENTINEL = "<!-- doctor: field-guide -->"
+
+
+def load_doctor(repo: str):
+    path = os.path.join(repo, "tools", "bps_doctor.py")
+    spec = importlib.util.spec_from_file_location("bps_doctor", path)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec: dataclass processing resolves the module via
+    # sys.modules on 3.10, and an unregistered module breaks it
+    sys.modules.setdefault("bps_doctor", mod)
+    spec.loader.exec_module(mod)
+    return sys.modules["bps_doctor"]
+
+
+def check(repo: str) -> list:
+    """Returns a list of problem strings (empty = green)."""
+    problems = []
+    doctor = load_doctor(repo)
+    rules = {r.name: r for r in doctor.RULES}
+    doc_path = os.path.join(repo, "docs", "troubleshooting.md")
+    if not os.path.exists(doc_path):
+        return [f"{doc_path} missing"]
+    with open(doc_path) as f:
+        lines = f.read().splitlines()
+
+    slugs = {
+        doctor.slugify(m.group(1))
+        for line in lines
+        if (m := _HEADING_RE.match(line)) is not None
+    }
+    cited = set()
+    for line in lines:
+        for name in _RULE_MARK_RE.findall(line):
+            cited.add(name)
+            if name not in rules:
+                problems.append(
+                    f"doc cites unknown rule {name!r} "
+                    "(markers must name a tools/bps_doctor.py RULES entry)"
+                )
+
+    # rule → doc
+    for name, rule in rules.items():
+        anchor = rule.anchor
+        if "#" in anchor:
+            anchor = anchor.split("#", 1)[1]
+        if anchor not in slugs:
+            problems.append(
+                f"rule {name!r} anchors to #{anchor}, which is not a "
+                "heading in docs/troubleshooting.md"
+            )
+        if name not in cited:
+            problems.append(
+                f"rule {name!r} is never cited by a <!-- rule: … --> "
+                "marker in docs/troubleshooting.md — the field guide "
+                "doesn't know this failure mode exists"
+            )
+
+    # doc → rule: every field-guide table row is marked or waived
+    i = 0
+    saw_sentinel = False
+    while i < len(lines):
+        if _SENTINEL not in lines[i]:
+            i += 1
+            continue
+        saw_sentinel = True
+        i += 1
+        # skip to the table (blank lines allowed between)
+        while i < len(lines) and not lines[i].lstrip().startswith("|"):
+            if lines[i].strip() and not lines[i].lstrip().startswith("<!--"):
+                problems.append(
+                    f"{_SENTINEL} at line {i} is not followed by a table"
+                )
+                break
+            i += 1
+        header_seen = 0
+        while i < len(lines) and lines[i].lstrip().startswith("|"):
+            row = lines[i]
+            i += 1
+            if header_seen < 2:
+                # header + |---| separator rows carry no failure mode
+                header_seen += 1
+                continue
+            if _RULE_MARK_RE.search(row) or _WAIVER_RE.search(row):
+                continue
+            cell = row.split("|")[1].strip() if "|" in row else row
+            problems.append(
+                "field-guide row without a <!-- rule: … --> marker or "
+                f"<!-- no-rule: … --> waiver: {cell[:70]!r}"
+            )
+    if not saw_sentinel:
+        problems.append(
+            f"docs/troubleshooting.md has no {_SENTINEL} sentinel — the "
+            "doctor's field guide table is unmarked"
+        )
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--repo",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    args = ap.parse_args(argv)
+    problems = check(args.repo)
+    if problems:
+        print("doctor rules and docs/troubleshooting.md have drifted:",
+              file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    doctor = load_doctor(args.repo)
+    print(f"doctor rules OK: {len(doctor.RULES)} rule(s) bound to the "
+          "field guide")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

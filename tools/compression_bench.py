@@ -6,7 +6,7 @@ small-tensor fusion used to EXCLUDE each other (a compressed partition
 always paid its own RPC; a fused frame always shipped raw fp32).  This
 bench drives the same deterministic workload — N medium tensors per step
 through a live in-process PS cluster over a rate-shaped van
-(``BYTEPS_VAN_RATE_MBPS``, the OVERLAP_r05 harness's link model) — in
+(``BYTEPS_VAN_RATE_MBYTES_S``, the OVERLAP_r05 harness's link model) — in
 every combination and reports wire RPC counts, actual bytes on the wire
 (``wire_tx/rx_bytes`` counters), and step-latency stats.
 
@@ -74,7 +74,7 @@ def run_mode(codec: str, threshold: int, keys: int, nbytes: int, steps: int,
         "BYTEPS_VAN": "tcp",
         "BYTEPS_FUSION_THRESHOLD": str(threshold),
         "BYTEPS_FUSION_CYCLE_MS": "2",
-        "BYTEPS_VAN_RATE_MBPS": str(rate_mbps),
+        "BYTEPS_VAN_RATE_MBYTES_S": str(rate_mbps),
         "BYTEPS_VAN_DELAY_MS": str(delay_ms),
         "BYTEPS_MIN_COMPRESS_BYTES": "0",
         "BYTEPS_COMPRESSION_AUTO": "1" if auto else "0",

@@ -70,7 +70,7 @@ def run_mode(threshold: int, keys: int, nbytes: int, steps: int,
     os.environ["BYTEPS_FUSION_THRESHOLD"] = str(threshold)
     os.environ["BYTEPS_FUSION_CYCLE_MS"] = "2"
     os.environ["BYTEPS_VAN_DELAY_MS"] = str(delay_ms)
-    os.environ["BYTEPS_VAN_RATE_MBPS"] = str(rate_mbps)
+    os.environ["BYTEPS_VAN_RATE_MBYTES_S"] = str(rate_mbps)
     if chaos:
         os.environ.update({
             "BYTEPS_VAN": "chaos:tcp",

@@ -177,7 +177,7 @@ def main() -> None:
 
     shaped = {
         "BYTEPS_VAN_DELAY_MS": str(args.delay_ms),
-        "BYTEPS_VAN_RATE_MBPS": str(args.rate_mbps),
+        "BYTEPS_VAN_RATE_MBYTES_S": str(args.rate_mbps),
         "BYTEPS_VAN_SHAPE_BUF_KB": "64",
     }
     nopart_bytes = str(64 << 20)  # larger than any tensor: partitioning off

@@ -47,7 +47,7 @@ def main() -> int:
     rng = np.random.default_rng(args.seed)
     if args.shaped:
         os.environ["BYTEPS_VAN_DELAY_MS"] = "2"
-        os.environ["BYTEPS_VAN_RATE_MBPS"] = "200"
+        os.environ["BYTEPS_VAN_RATE_MBYTES_S"] = "200"
     os.environ["BYTEPS_VAN"] = args.van
     os.environ["BYTEPS_MIN_COMPRESS_BYTES"] = "0"
     os.environ["BYTEPS_PARTITION_BYTES"] = "4096"
