@@ -593,6 +593,13 @@ class PSClient:
                         # (elastic world-size change, operations.cc:96-119)
                         "num_workers": self.cfg.num_worker,
                         "num_servers": self.cfg.num_server,
+                        # multi-tenant identity + QoS (docs/async.md): the
+                        # scheduler builds the per-job membership map and
+                        # the servers' service weights / admission quotas
+                        # from these
+                        "job": self.cfg.job_id,
+                        "job_priority": self.cfg.job_priority,
+                        "job_quota_mbps": self.cfg.job_quota_mbps,
                     }
                 ).encode(),
             ),
@@ -603,7 +610,7 @@ class PSClient:
             raise RuntimeError(f"scheduler refused registration: {err}")
         book = json.loads(resp.payload.decode())
         self.rank = book["rank"]
-        self.num_workers = book["num_workers"]
+        self.num_workers = self._book_num_workers(book)
         self.num_servers = book["num_servers"]
         self.is_recovery = book.get("is_recovery", False)
         self._fence_book(book)  # learn the scheduler's incarnation
@@ -707,6 +714,26 @@ class PSClient:
             if ev.get(role):
                 counters().set_floor(name, int(ev[role]))
 
+    def _book_num_workers(self, book: dict) -> int:
+        """The worker count THIS client aggregates over.  Multi-tenant
+        books (docs/async.md) carry a per-job membership map — a tenant
+        job's rounds involve only ITS workers, so averaging and the
+        round-completion expectation use the job's population, not the
+        fleet's.  Single-tenant books (no ``jobs`` field, or job 0 not
+        split out) fall back to the fleet total, the pre-tenancy
+        behavior."""
+        jobs = book.get("jobs")
+        if jobs:
+            # job 0 included: in a MIXED fleet (tenant workers present)
+            # the default-namespace job's rounds also complete against
+            # only ITS workers, so averaging over the fleet total would
+            # divide by the wrong population.  Single-job books yield
+            # len == num_workers, the pre-tenancy value.
+            mine = jobs.get(str(self.cfg.job_id))
+            if mine and mine.get("workers"):
+                return len(mine["workers"])
+        return book["num_workers"]
+
     def _ownership_from_book(self, book: Optional[dict]):
         """Build the book's OwnershipMap, or None (resharding off, or an
         older scheduler whose books carry no map)."""
@@ -767,7 +794,7 @@ class PSClient:
         book = json.loads(resp.payload.decode())
         if not self._fence_book(book):
             raise ConnectionError("resize book from a stale scheduler incarnation")
-        self.num_workers = book["num_workers"]
+        self.num_workers = self._book_num_workers(book)
         self._note_membership(book)
         with self._sched_cb_lock:
             self._book_token += 1
@@ -893,7 +920,7 @@ class PSClient:
                         # zombie scheduler racing its restarted
                         # successor: refuse the stale-incarnation book
                         continue
-                    self.num_workers = book["num_workers"]
+                    self.num_workers = self._book_num_workers(book)
                     self._note_membership(book)
                     new_addrs = [tuple(s) for s in book["servers"]]
                     # token = book arrival order on THIS (single) thread:
@@ -1070,6 +1097,9 @@ class PSClient:
                 # not run, so the scheduler must not arm the
                 # recovered-conn barrier bypass for this conn
                 "reconnect": True,
+                "job": self.cfg.job_id,
+                "job_priority": self.cfg.job_priority,
+                "job_quota_mbps": self.cfg.job_quota_mbps,
             }).encode()
             send_message(sock, Message(Op.REGISTER, payload=payload))
             resp = recv_message(sock)
@@ -1091,7 +1121,7 @@ class PSClient:
         the book (rank is stable — the scheduler honored the uid/rank
         report), restart the receiver, and wake barrier retries."""
         self.rank = book["rank"]
-        self.num_workers = book["num_workers"]
+        self.num_workers = self._book_num_workers(book)
         self.is_recovery = True
         self._note_membership(book)
         counters().bump("sched_rejoin")
@@ -1639,9 +1669,14 @@ class PSClient:
                     # the flight recorder's straggler rule needs "whose
                     # p99 ran away THIS step", which a flat family can
                     # never answer (docs/observability.md)
+                    rpc_labels = {"server": sid}
+                    if self.cfg.job_id:
+                        # per-tenant slice (docs/async.md); job 0 keeps
+                        # the pre-tenancy series shape
+                        rpc_labels["job"] = str(self.cfg.job_id)
                     metrics().observe(
                         "rpc_round_trip_seconds", time.monotonic() - t_sent,
-                        labels={"server": sid},
+                        labels=rpc_labels,
                     )
                     deliver(msg)
 
@@ -2132,7 +2167,9 @@ class PSClient:
     # --- data plane ------------------------------------------------------
 
     def init_tensor(self, key: int, num_elements: int, dtype_id: int,
-                    trace: Optional[tuple] = None) -> None:
+                    trace: Optional[tuple] = None,
+                    async_profile: bool = False,
+                    staleness: int = -1) -> None:
         """Blocking init-push; doubles as the cross-worker barrier for this
         key (InitTensor blocking ZPush, operations.cc:283-414).
 
@@ -2148,11 +2185,24 @@ class PSClient:
         arriving AFTER the barrier released is acked from the server's
         completed-barrier record instead of re-parked — without it, the
         retrier's released peers never re-init the key and the short
-        barrier strands the retry until its budget dies."""
+        barrier strands the retry until its budget dies.
+
+        ``async_profile`` (docs/async.md): the key is declared ASYNC —
+        the server applies its pushes immediately and serves pulls from
+        current state, bounded by ``staleness`` (-1 = unbounded).  The
+        profile rides a 5-byte payload extension (u8 profile + i32
+        staleness) that sync keys never send, so pre-tenancy servers
+        keep seeing the exact 12-byte INIT they always parsed — and the
+        native C++ engine, which has no async plane, rejects the
+        extended form with a clean ``status=1`` echo (the Python-engine
+        fallback rule, docs/async.md)."""
         import struct
 
         token = self._init_token(key)
-        self._blocking_request_retrying(
+        payload = struct.pack("!QI", num_elements, dtype_id)
+        if async_profile:
+            payload += struct.pack("!Bi", 1, int(staleness))
+        resp = self._blocking_request_retrying(
             key,
             lambda seq: Message(
                 Op.INIT,
@@ -2160,7 +2210,7 @@ class PSClient:
                 seq=seq,
                 flags=self._worker_flag(),
                 version=token,
-                payload=struct.pack("!QI", num_elements, dtype_id),
+                payload=payload,
                 trace=trace,
             ),
             f"server connection lost during init of key {key}",
@@ -2168,6 +2218,29 @@ class PSClient:
             # per-attempt deadline would punish stragglers' peers
             use_deadline=False,
         )
+        if resp is not None and resp.status != 0:
+            # the server REFUSED this init with a clean status echo —
+            # the native C++ engine rejecting an async profile or a
+            # job-namespaced key (docs/async.md), or a genuinely
+            # incompatible server.  Failing fast here is the whole
+            # point of the clean rejection: training on would leave
+            # every later push/pull status-echoed too, and the job
+            # would silently run on uninitialized state.
+            from byteps_tpu.common.tenancy import job_of_key
+
+            if async_profile:
+                why = ("async push_pull needs Python-engine servers "
+                       "— see docs/async.md")
+            elif job_of_key(key):
+                why = (f"job {job_of_key(key)} keys need Python-engine "
+                       "servers (multi-tenant namespaces are rejected "
+                       "by the C++ engine) — see docs/async.md")
+            else:
+                why = "server refused the init"
+            raise RuntimeError(
+                f"server refused init for key {key} (status "
+                f"{resp.status}): {why}"
+            )
 
     def push(
         self,

@@ -87,6 +87,13 @@ class _Node:
     conn: Any
     send_lock: Any
     uid: str
+    # multi-tenant identity + QoS (docs/async.md): which job this
+    # worker belongs to and the job's declared weighted share /
+    # admission quota — aggregated into every book's ``jobs`` map so
+    # servers can weight service and meter admission per tenant
+    job: int = 0
+    job_priority: int = 1
+    job_quota_mbps: float = 0.0
 
 
 class Scheduler:
@@ -558,6 +565,20 @@ class Scheduler:
             if hint is not None and hint < 0:
                 hint = None
         rejoiner = info.get("last_rank") is not None
+        # multi-tenant identity (docs/async.md): tenant workers carry
+        # their job id + QoS declaration; job 0 is the single-tenant
+        # default namespace
+        job = int(info.get("job", 0) or 0)
+        job_priority = max(1, int(info.get("job_priority", 1) or 1))
+        job_quota = max(0.0, float(info.get("job_quota_mbps", 0) or 0))
+
+        def mk_node(rank: int) -> _Node:
+            return _Node(
+                rank, info["host"], info["port"], conn, send_lock, uid,
+                job=job, job_priority=job_priority,
+                job_quota_mbps=job_quota,
+            )
+
         # a control-plane RECONNECT (the node's reconnect machine, not a
         # process restart): the client did not tear its runtime down and
         # will NOT run connect()'s re-init barrier — so its conn must not
@@ -580,10 +601,13 @@ class Scheduler:
                 # is adopted (no-op on a live scheduler — the book is
                 # already out)
                 self._arm_rejoin_grace_locked()
-                if not self._addrbook_sent and role == "worker":
+                if not self._addrbook_sent and role == "worker" and not job:
                     # the cluster may have been resized since this
                     # scheduler's env was written; the survivors know
-                    # the live topology — adopt their expectation
+                    # the live topology — adopt their expectation.
+                    # TENANT workers (job != 0) report their JOB's
+                    # worker count, not the fleet's — never adopt it
+                    # (docs/async.md: jobs cannot resize the fleet)
                     nw_r, ns_r = info.get("num_workers"), info.get("num_servers")
                     if nw_r:
                         self.num_workers = int(nw_r)
@@ -594,8 +618,11 @@ class Scheduler:
             # with a DIFFERENT expected topology updates the cluster's
             # expectation.  Dead entries are pruned so their ranks free up;
             # live nodes keep their ranks (stable keys depend on it).
-            nw = info.get("num_workers")
-            ns = info.get("num_servers")
+            # tenant workers never resize the fleet: their num_workers is
+            # the JOB's size (the averaging population), not a topology
+            # expectation for the shared servers (docs/async.md)
+            nw = info.get("num_workers") if not job else None
+            ns = info.get("num_servers") if not job else None
             if self._addrbook_sent and role == "worker" and (
                 (nw and int(nw) != self.num_workers)
                 or (ns and int(ns) != self.num_servers)
@@ -651,9 +678,7 @@ class Scheduler:
                 # the barrier without its peers and skewing the round
                 # counter (the same hazard eviction scrubs for)
                 self._scrub_barrier_waiters_locked(node.conn)
-                nodes[nodes.index(node)] = _Node(
-                    rank, info["host"], info["port"], conn, send_lock, uid
-                )
+                nodes[nodes.index(node)] = mk_node(rank)
                 recovery = True
                 if not reconnect:
                     self._recovered_conns.add(conn)
@@ -683,9 +708,7 @@ class Scheduler:
                 elif len(nodes) < expected:
                     used = {n.rank for n in nodes}
                     rank = next(r for r in range(expected) if r not in used)
-                    nodes.append(
-                        _Node(rank, info["host"], info["port"], conn, send_lock, uid)
-                    )
+                    nodes.append(mk_node(rank))
                     # the live rank set GREW: peers (and especially the
                     # servers' zombie fence) must learn the new member's
                     # rank is legitimate — broadcast like an adoption
@@ -697,9 +720,7 @@ class Scheduler:
                     # back and re-admit it rather than refusing a member
                     # that merely reconnected slowly
                     rank = hint
-                    nodes.append(
-                        _Node(rank, info["host"], info["port"], conn, send_lock, uid)
-                    )
+                    nodes.append(mk_node(rank))
                     if role == "worker":
                         self.num_workers += 1
                     else:
@@ -740,9 +761,7 @@ class Scheduler:
                 rank = node.rank
                 self._conn_ids.pop(node.conn, None)
                 self._scrub_barrier_waiters_locked(node.conn)
-                nodes[nodes.index(node)] = _Node(
-                    rank, info["host"], info["port"], conn, send_lock, uid
-                )
+                nodes[nodes.index(node)] = mk_node(rank)
             else:
                 # initial fill.  A rejoiner's rank hint is honored when
                 # free (rank-stable rebirth: keys, ledgers, and barrier
@@ -756,9 +775,7 @@ class Scheduler:
                     rank = next(
                         r for r in range(len(nodes) + 1) if r not in used
                     )
-                nodes.append(
-                    _Node(rank, info["host"], info["port"], conn, send_lock, uid)
-                )
+                nodes.append(mk_node(rank))
             self._conn_ids[conn] = (role, rank)
             self._last_seen[(role, rank)] = time.monotonic()
             full = (
@@ -926,6 +943,12 @@ class Scheduler:
             # recovery"): nodes track the highest incarnation seen and
             # refuse books stamped with an older one
             "sched_incarnation": self.incarnation,
+            # multi-tenant membership + QoS map (docs/async.md): which
+            # worker ranks belong to which job, plus the job's weighted
+            # share and admission quota.  Workers aggregate over their
+            # OWN job's population; servers size per-key rounds/barriers
+            # per job and weight/meter service accordingly.
+            "jobs": self._jobs_map_locked(),
         }
         if drain:
             book["drain"] = True
@@ -937,6 +960,24 @@ class Scheduler:
             )
         except (ConnectionError, OSError):
             pass
+
+    def _jobs_map_locked(self) -> Dict[str, dict]:
+        """``{job: {"workers": [ranks], "priority": w, "quota_mbps": q}}``
+        from the live worker registrations.  Priority/quota take the MAX
+        any of the job's workers declared (one straggling env var must
+        not silently zero a job's share)."""
+        jobs: Dict[str, dict] = {}
+        for n in self._nodes["worker"]:
+            j = jobs.setdefault(
+                str(n.job),
+                {"workers": [], "priority": 1, "quota_mbps": 0.0},
+            )
+            j["workers"].append(n.rank)
+            j["priority"] = max(j["priority"], n.job_priority)
+            j["quota_mbps"] = max(j["quota_mbps"], n.job_quota_mbps)
+        for j in jobs.values():
+            j["workers"].sort()
+        return jobs
 
     def _group_size(self, group: int) -> int:
         return {

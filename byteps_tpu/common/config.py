@@ -122,6 +122,45 @@ class Config:
     server_engine_threads: int = 4  # BYTEPS_SERVER_ENGINE_THREAD
     server_enable_schedule: bool = False  # BYTEPS_SERVER_ENABLE_SCHEDULE
     enable_async: bool = False  # BYTEPS_ENABLE_ASYNC
+
+    # --- multi-tenancy + asynchrony (docs/async.md) ---
+    # job id this process belongs to (0 = the default single-tenant
+    # namespace): every declared tensor's keys carry it in the top 16
+    # bits of the wire key, so several jobs share one PS fleet without
+    # key collisions (common/tenancy.py).  Nonzero jobs are a
+    # Python-engine-only surface — the C++ server rejects their frames
+    # cleanly (ROADMAP: native multi-tenant parity).
+    job_id: int = 0  # BYTEPS_JOB_ID
+    # weighted share of this job in the scheduler queues (client WFQ)
+    # and the server's per-job service weighting — higher = more of the
+    # fleet under contention.  Shares are proportional, never absolute:
+    # a weight-1 job always progresses (starvation-free WFQ).
+    job_priority: int = 1  # BYTEPS_JOB_PRIORITY
+    # server-side admission quota for this job's request bytes, in
+    # megaBYTES/s (same unit family as BYTEPS_VAN_RATE_MBYTES_S); 0 =
+    # unlimited.  Excess requests are DELAYED (token bucket), never
+    # dropped — job_quota_deferred counts the deferrals.
+    job_quota_mbps: float = 0.0  # BYTEPS_JOB_QUOTA_MBPS
+    # per-tenant gate credits in the client scheduler queues: this job's
+    # in-flight byte budget (0 = only the global BYTEPS_SCHEDULING_CREDIT
+    # applies).  The per-job dimension matters when one queue carries
+    # several tenants (in-process fleets, tests).
+    job_credit_bytes: int = 0  # BYTEPS_JOB_CREDIT_BYTES
+    # async push_pull profile (docs/async.md): this worker's keys are
+    # initialized async — the server applies pushes immediately to the
+    # authoritative store and pulls return current state, no round
+    # barrier.  Per-tensor overridable via declare kwargs
+    # (byteps_async="0"/"1").
+    async_mode: bool = False  # BYTEPS_ASYNC
+    # bounded staleness for async keys (SSP): a pull at round v parks
+    # until every peer worker's applied-push version is >= v - N.
+    # -1 = unbounded (pure async); 0 degenerates to sequential
+    # consistency (every pull waits for all of its round's pushes).
+    staleness_bound: int = -1  # BYTEPS_STALENESS_BOUND
+    # per-job step-time SLO in seconds (0 = off): a completed step
+    # slower than this fires the flight recorder's slo_breach trigger
+    # (rate-limited bundle, flight_trigger{rule="slo_breach"}).
+    job_slo_s: float = 0.0  # BYTEPS_JOB_SLO_S
     # --- failure detection (ps-lite heartbeats, SURVEY §5.3) ---
     heartbeat_interval: float = 5.0  # BYTEPS_HEARTBEAT_INTERVAL; 0 disables
     # scheduler-side liveness policy: a registered node whose heartbeat
@@ -310,6 +349,19 @@ class Config:
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
             server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+            job_id=min(
+                (1 << 16) - 1, max(0, _env_int("BYTEPS_JOB_ID", 0))
+            ),
+            job_priority=max(1, _env_int("BYTEPS_JOB_PRIORITY", 1)),
+            job_quota_mbps=max(0.0, float(
+                os.environ.get("BYTEPS_JOB_QUOTA_MBPS", "0") or "0"
+            )),
+            job_credit_bytes=max(0, _env_int("BYTEPS_JOB_CREDIT_BYTES", 0)),
+            async_mode=_env_bool("BYTEPS_ASYNC"),
+            staleness_bound=max(-1, _env_int("BYTEPS_STALENESS_BOUND", -1)),
+            job_slo_s=max(0.0, float(
+                os.environ.get("BYTEPS_JOB_SLO_S", "0") or "0"
+            )),
             heartbeat_interval=float(
                 os.environ.get("BYTEPS_HEARTBEAT_INTERVAL", "5") or "5"
             ),

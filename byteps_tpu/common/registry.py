@@ -47,10 +47,18 @@ class TensorContext:
     # with fresh stores, so a ctx from a previous engine must re-init
     # (-1 = never)
     engine_epoch: int = -1
+    # multi-tenant namespace (common/tenancy.py): the job id carried in
+    # the top 16 bits of every wire key this tensor communicates under.
+    # Stamped at declare time from BYTEPS_JOB_ID (per-tensor overridable
+    # via the byteps_job declare kwarg); job 0 keys are bit-identical to
+    # the pre-tenancy layout.
+    job: int = 0
 
     @property
     def base_key(self) -> int:
-        return self.declared_key << 16
+        from byteps_tpu.common.tenancy import job_key
+
+        return job_key(self.job, self.declared_key << 16)
 
     def key_for_part(self, i: int) -> int:
         if i >= MAX_PARTS_PER_TENSOR:
@@ -76,18 +84,36 @@ class TensorRegistry:
 
     def declare(self, name: str, **kwargs: str) -> TensorContext:
         """Declare (or fetch) a named tensor (IsTensorDeclared +
-        DeclareTensor, global.cc:412-429)."""
+        DeclareTensor, global.cc:412-429).  The tensor's key namespace
+        (its job id, docs/async.md) is fixed at first declaration:
+        ``byteps_job`` in the kwargs overrides the process-wide
+        ``BYTEPS_JOB_ID`` — the in-process multi-job hook tests and
+        embedded fleets use."""
         with self._lock:
             ctx = self._contexts.get(name)
             if ctx is not None:
                 if kwargs:
                     ctx.kwargs.update(kwargs)
                 return ctx
-            ctx = TensorContext(name=name, declared_key=self._next_key, kwargs=dict(kwargs))
+            ctx = TensorContext(
+                name=name, declared_key=self._next_key, kwargs=dict(kwargs),
+                job=self._job_for(kwargs),
+            )
             self._next_key += 1
             self._contexts[name] = ctx
             self._order.append(name)
             return ctx
+
+    @staticmethod
+    def _job_for(kwargs: dict) -> int:
+        """Resolve a declaration's job id: explicit ``byteps_job`` kwarg
+        wins, else the process config's ``BYTEPS_JOB_ID``."""
+        raw = kwargs.get("byteps_job")
+        if raw is not None:
+            return max(0, int(raw))
+        from byteps_tpu.common.config import get_config
+
+        return get_config().job_id
 
     def get(self, name: str) -> TensorContext:
         with self._lock:
@@ -109,7 +135,8 @@ class TensorRegistry:
             for name in order:
                 prev = old[name]
                 ctx = TensorContext(
-                    name=name, declared_key=self._next_key, kwargs=dict(prev.kwargs)
+                    name=name, declared_key=self._next_key,
+                    kwargs=dict(prev.kwargs), job=prev.job,
                 )
                 self._next_key += 1
                 self._contexts[name] = ctx

@@ -1,0 +1,51 @@
+"""Job-id key namespacing — the multi-tenant dimension (docs/async.md).
+
+The ROADMAP's "millions of users" regime means many concurrent JOBS
+sharing one PS fleet, not one synchronous job.  The isolation primitive
+is the communication key itself: every declared tensor's keys carry the
+job id in the TOP 16 BITS of the u64 wire key, so two jobs that both
+declare ``"grad.layer0"`` land on disjoint server state with zero wire
+changes — the key field was always 64 bits wide, and everything keyed by
+it (server KeyState, the exactly-once ledger, the ownership ring, the
+worker journal, resync, migration) namespaces for free.
+
+Layout (bits, most-significant first)::
+
+    [ job id : 16 ][ declared_key : 32 ][ partition : 16 ]
+
+Job 0 is the default single-tenant namespace: its keys are bit-identical
+to the pre-tenancy layout, so existing deployments, golden wire
+fixtures, and the native C++ engine see exactly the frames they always
+did.  Nonzero jobs are a Python-engine-only surface for now: the C++
+server rejects job-namespaced frames with a clean ``status=1`` echo
+(log-once) so a misrouted tenant fails fast instead of corrupting a
+shared store (ROADMAP: native multi-tenant parity).
+"""
+
+from __future__ import annotations
+
+#: bit position of the job id inside a wire key
+JOB_SHIFT = 48
+#: job ids are 16-bit: 0 (the default single-tenant namespace) .. 65535
+MAX_JOB_ID = (1 << 16) - 1
+#: mask selecting the tenant-free part of a key
+BASE_KEY_MASK = (1 << JOB_SHIFT) - 1
+
+
+def job_key(job: int, key: int) -> int:
+    """Namespace ``key`` under ``job`` (identity for job 0)."""
+    if not 0 <= job <= MAX_JOB_ID:
+        raise ValueError(f"job id {job} outside 0..{MAX_JOB_ID}")
+    if key & ~BASE_KEY_MASK:
+        raise ValueError(f"key {key:#x} already carries job bits")
+    return (job << JOB_SHIFT) | key
+
+
+def job_of_key(key: int) -> int:
+    """The job id a wire key belongs to (0 = the default namespace)."""
+    return (key >> JOB_SHIFT) & MAX_JOB_ID
+
+
+def base_key(key: int) -> int:
+    """``key`` with the job bits stripped (the single-tenant key)."""
+    return key & BASE_KEY_MASK

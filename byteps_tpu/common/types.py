@@ -278,6 +278,10 @@ class TensorTableEntry:
     # span timeline (cross-process alignment)
     enqueued_at: float = 0.0
     enqueued_wall: float = 0.0
+    # multi-tenant dimension (common/tenancy.py): the job id the task's
+    # key is namespaced under — the scheduler's per-tenant weighted-fair
+    # queues and per-job gate credits key on it (docs/async.md)
+    job: int = 0
 
     def current_stage(self) -> Optional[QueueType]:
         return self.queue_list[0] if self.queue_list else None

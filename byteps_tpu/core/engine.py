@@ -137,16 +137,22 @@ class _Fuser:
         self._engine = engine
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._bufs: Dict[int, _FusionBuffer] = {}
+        #: (destination server, job) → accumulating pack
+        self._bufs: Dict[tuple, _FusionBuffer] = {}
         self._cycle_thread: Optional[threading.Thread] = None
 
     def add(self, task: TensorTableEntry, payload) -> None:
-        sid = self._engine.client.server_for(task.key)
+        # packs are keyed by (destination server, JOB): a process
+        # hosting several tenants (byteps_job declare kwarg) must not
+        # mix jobs in one frame — the pack competes in the WFQ, spends
+        # gate credits, and is admission-metered under ONE job, so a
+        # mixed pack would ride the wrong tenant's share
+        bkey = (self._engine.client.server_for(task.key), task.job)
         full = None
         with self._lock:
-            buf = self._bufs.get(sid)
+            buf = self._bufs.get(bkey)
             if buf is None:
-                buf = self._bufs[sid] = _FusionBuffer()
+                buf = self._bufs[bkey] = _FusionBuffer()
                 # wake the cycle thread: it sleeps indefinitely while
                 # every buffer is empty, and must now arm this pack's
                 # BYTEPS_FUSION_CYCLE_MS deadline
@@ -155,7 +161,7 @@ class _Fuser:
             buf.nbytes += len(payload)
             buf.max_priority = max(buf.max_priority, task.priority)
             if buf.nbytes >= self._engine.cfg.fusion_bytes:
-                full = self._bufs.pop(sid)
+                full = self._bufs.pop(bkey)
         if full is not None:
             self._emit(full, "full")
         self._ensure_cycle_thread()
@@ -199,11 +205,11 @@ class _Fuser:
                 if soonest > now:
                     self._cv.wait(soonest - now)
                     continue
-                for sid in [
-                    s for s, b in self._bufs.items()
+                for bkey in [
+                    k for k, b in self._bufs.items()
                     if now - b.oldest >= cycle_s
                 ]:
-                    aged.append(self._bufs.pop(sid))
+                    aged.append(self._bufs.pop(bkey))
             for buf in aged:
                 self._emit(buf, "cycle")
 
@@ -239,6 +245,9 @@ class _Fuser:
             queue_list=[QueueType.PUSH],
             context=_FusionGroup(members),
             gate_exempt=True,
+            # members share one process (= one tenant); the pack
+            # competes in the WFQ under its members' job
+            job=members[0][0].job,
         )
         self._engine.queues[QueueType.PUSH].add_task(group)
 
@@ -330,6 +339,18 @@ class PipelineEngine:
         self._push_ready = ReadyTable(ready_count=1, name="push")
         self._seeded: set = set()  # keys whose gate this engine has seeded
         disc = cfg.scheduling
+        # per-tenant QoS in the stage queues (docs/async.md): this
+        # process's job registers its weighted share, and an optional
+        # per-job in-flight byte budget bounds the tenant the way the
+        # global credit bounds the queue.  With one job per process
+        # (the default) the WFQ layer is inert.
+        from byteps_tpu.core.scheduler import set_job_weight
+
+        set_job_weight(cfg.job_id, max(1, cfg.job_priority))
+        job_credits = (
+            {cfg.job_id: cfg.job_credit_bytes}
+            if cfg.job_credit_bytes > 0 else None
+        )
         self.queues: Dict[QueueType, Any] = {
             QueueType.COPYD2H: ScheduledQueue(QueueType.COPYD2H, discipline=disc),
             QueueType.COMPRESS: _StripedStage(QueueType.COMPRESS, pool),
@@ -339,6 +360,7 @@ class PipelineEngine:
                 ready_table=self._push_ready,
                 version_gated=True,
                 discipline=disc,
+                job_credits=job_credits,
             ),
             # FUSE shares the PUSH round gate: a fused member obeys the
             # same per-key round order as an unfused push — the gate just
@@ -348,6 +370,7 @@ class PipelineEngine:
                 ready_table=self._push_ready,
                 version_gated=True,
                 discipline=disc,
+                job_credits=job_credits,
             ),
             QueueType.PULL: ScheduledQueue(QueueType.PULL, discipline=disc),
             QueueType.DECOMPRESS: _StripedStage(QueueType.DECOMPRESS, pool),
@@ -562,6 +585,7 @@ class PipelineEngine:
                 queue_list=list(qlist),
                 context=job,
                 fuse_staged=bool(small),
+                job=ctx.job,
             )
             self._stamp_task_trace(task, job)
             self.queues[QueueType.COPYD2H].add_task(task)
@@ -621,6 +645,15 @@ class PipelineEngine:
                     # numbering must never replay into the new one
                     for part in ctx.partitions:
                         self._journal.clear_key(part.key)
+                is_async, staleness = self._async_profile(ctx)
+                # the async kwargs ride only on async inits: sync keys
+                # keep the classic call shape (and the classic 12-byte
+                # wire payload), so stub clients and old transports
+                # never see the extension
+                akw = (
+                    {"async_profile": True, "staleness": staleness}
+                    if is_async else {}
+                )
                 for part in ctx.partitions:
                     if self._traced():
                         from byteps_tpu.core.tracing import (
@@ -632,14 +665,16 @@ class PipelineEngine:
                         t0 = time.time()
                         self.client.init_tensor(
                             part.key, part.length, dtype_id,
-                            trace=(t_id, s_id),
+                            trace=(t_id, s_id), **akw,
                         )
                         self.tracer.record_span(
                             ctx.name, "INIT", t0, time.time() - t0,
                             span_args(t_id, s_id, key=part.key),
                         )
                     else:
-                        self.client.init_tensor(part.key, part.length, dtype_id)
+                        self.client.init_tensor(
+                            part.key, part.length, dtype_id, **akw,
+                        )
                 if ctx.initialized:
                     if (on_first_init is not None and not any(
                             p.key in self._compressors
@@ -733,6 +768,7 @@ class PipelineEngine:
             total_partnum=1,
             queue_list=[QueueType.PUSH, QueueType.PULL],
             context=job,
+            job=ctx.job,
         )
         self._stamp_task_trace(task, job)
         self.queues[QueueType.PUSH].add_task(task)
@@ -823,6 +859,32 @@ class PipelineEngine:
             self.client.set_compression_lr(self._compression_lr)
             self._lr_sent_to_servers = self._compression_lr
 
+    def _async_profile(self, ctx) -> tuple:
+        """(async?, staleness bound) for a tensor's keys (docs/async.md):
+        the declare-time ``byteps_async`` / ``byteps_staleness`` kwargs
+        override the process-wide ``BYTEPS_ASYNC`` /
+        ``BYTEPS_STALENESS_BOUND`` — per-key profiles on one worker."""
+        raw = ctx.kwargs.get("byteps_async")
+        if raw is None or raw == "":
+            is_async = self.cfg.async_mode
+        else:
+            is_async = str(raw).lower() not in ("0", "false", "no", "off")
+        if not is_async:
+            return False, -1
+        raw_s = ctx.kwargs.get("byteps_staleness")
+        bound = (
+            int(raw_s) if raw_s not in (None, "")
+            else self.cfg.staleness_bound
+        )
+        return True, max(-1, bound)
+
+    @staticmethod
+    def _job_labels(job: int):
+        """``{"job": ...}`` for a tenant task, None for the default
+        namespace — job 0 mints no extra label series, so single-tenant
+        deployments see exactly the pre-tenancy families."""
+        return {"job": str(job)} if job else None
+
     # --- observability helpers (docs/observability.md) -------------------
 
     def _step_begin(self) -> None:
@@ -850,8 +912,21 @@ class PipelineEngine:
             self._step_open -= 1
             done = self._step_open == 0
             dur = time.monotonic() - self._step_t0
-        if done and self._flight is not None and self._flight.enabled:
-            self._flight.record_step(dur)
+        if done:
+            if self.cfg.job_id:
+                # per-tenant step-time slice (docs/async.md): the
+                # histogram feeds the cluster aggregate's per-job p99,
+                # the gauge is the live value bps_top sparklines.  Job 0
+                # (the single-tenant default) mints no extra series.
+                from byteps_tpu.core.telemetry import metrics
+
+                labels = {"job": str(self.cfg.job_id)}
+                metrics().observe("job_step_seconds", dur, labels=labels)
+                metrics().gauge_set(
+                    "job_step_last_seconds", dur, labels=labels
+                )
+            if self._flight is not None and self._flight.enabled:
+                self._flight.record_step(dur)
 
     def _traced(self) -> bool:
         return (
@@ -1387,7 +1462,8 @@ class PipelineEngine:
             self.telemetry.record(nbytes)
         counters().bump("fused_frames")
         counters().bump("fused_keys", len(members))
-        counters().bump("wire_tx_bytes", nbytes)
+        counters().bump("wire_tx_bytes", nbytes,
+                        labels=self._job_labels(group_task.job))
         if self._journal is not None:
             # each member journals individually: a resync replay re-sends
             # them as plain per-key pushes, which the server sums through
@@ -1507,7 +1583,8 @@ class PipelineEngine:
             self.telemetry.record(len(payload))
         from byteps_tpu.core.telemetry import counters
 
-        counters().bump("wire_tx_bytes", len(payload))
+        counters().bump("wire_tx_bytes", len(payload),
+                        labels=self._job_labels(task.job))
         if self._journal is not None:
             # recovery plane: journal the exact wire payload BEFORE the
             # send, so a give-up on this very RPC can already replay it
@@ -1550,7 +1627,8 @@ class PipelineEngine:
                 self.telemetry.record(len(payload))
             from byteps_tpu.core.telemetry import counters
 
-            counters().bump("wire_rx_bytes", len(payload))
+            counters().bump("wire_rx_bytes", len(payload),
+                            labels=self._job_labels(task.job))
             if compressed:
                 task.compressed = payload  # decoded by DECOMPRESS stage
             else:
@@ -1567,7 +1645,8 @@ class PipelineEngine:
 
                 if self.telemetry is not None:
                     self.telemetry.record(len(payload))
-                counters().bump("wire_rx_bytes", len(payload))
+                counters().bump("wire_rx_bytes", len(payload),
+                            labels=self._job_labels(task.job))
                 arr = np.frombuffer(payload, dtype=job.np_dtype)
                 job.result[: arr.size] = arr
                 self._proceed(task)
@@ -1609,7 +1688,8 @@ class PipelineEngine:
             )
             if self.telemetry is not None:
                 self.telemetry.record(nbytes)
-            counters().bump("wire_rx_bytes", nbytes)
+            counters().bump("wire_rx_bytes", nbytes,
+                            labels=self._job_labels(task.job))
             if payload is _ZERO_COPIED:
                 pass  # already in job.result via the sink
             elif compressed:

@@ -74,7 +74,7 @@ _HIST_FAMILIES = (
 )
 
 #: record keys kept in the compact heartbeat-tail form (plus "rpc" p99s)
-_COMPACT_KEYS = ("step", "k", "t", "dur", "deg", "trig")
+_COMPACT_KEYS = ("step", "k", "t", "dur", "deg", "trig", "job")
 
 
 def _env_float(name: str, default: float) -> float:
@@ -139,6 +139,13 @@ class FlightRecorder:
             float(_fb) if _fb is not None
             else _env_float("BYTEPS_FLIGHT_BUNDLE_S", 60.0)
         )
+        #: per-job step-time SLO (docs/async.md): a completed step
+        #: slower than this fires slo_breach (0 = rule off)
+        self.slo_s = (
+            getattr(cfg, "job_slo_s", None)
+            if cfg is not None and getattr(cfg, "job_slo_s", None)
+            else _env_float("BYTEPS_JOB_SLO_S", 0.0)
+        )
         #: min prior samples before the rolling-median rules may fire
         self.min_history = 8
         self._context_fn = context_fn
@@ -199,6 +206,10 @@ class FlightRecorder:
             "map_epoch": int(ctx.get("map_epoch", 0)),
             "incarnation": int(ctx.get("incarnation", 0)),
             "deg": int(ctx.get("degraded", 0)),
+            # multi-tenant dimension (docs/async.md): which job this
+            # node's steps belong to (0 = single-tenant default) — the
+            # per-tenant SLO rule and the cluster step matrix slice on it
+            "job": int(ctx.get("job", 0)),
             "trig": [],
         }
         with self._lock:
@@ -483,12 +494,28 @@ def _rule_degraded_flip(rec: "FlightRecorder", r: dict) -> Optional[dict]:
     return None
 
 
+def _rule_slo_breach(rec: "FlightRecorder", r: dict) -> Optional[dict]:
+    """Per-tenant SLO (docs/async.md): a completed step blew the
+    configured ``BYTEPS_JOB_SLO_S`` bound.  Unlike slow_step (relative
+    to the rolling median — a uniformly slow job never fires it), this
+    is the ABSOLUTE latency contract a tenant declared, so a bulk
+    neighbor saturating the shared fleet shows up here first."""
+    dur = r.get("dur")
+    if dur is None or rec.slo_s <= 0 or dur <= rec.slo_s:
+        return None
+    return {
+        "job": r.get("job", 0), "dur": dur, "slo_s": rec.slo_s,
+        "over": round(dur / rec.slo_s, 3),
+    }
+
+
 _RULES: Tuple[Tuple[str, Callable], ...] = (
     ("slow_step", _rule_slow_step),
     ("straggler_server", _rule_straggler_server),
     ("hot_stripe", _rule_hot_stripe),
     ("queue_stall", _rule_queue_stall),
     ("degraded_flip", _rule_degraded_flip),
+    ("slo_breach", _rule_slo_breach),
 )
 
 

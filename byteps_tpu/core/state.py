@@ -171,12 +171,16 @@ def init_state(fresh_env: bool = True) -> RuntimeState:
 
             client = st.ps_client
 
-            def _flight_ctx(c=client):
+            def _flight_ctx(c=client, job=cfg.job_id):
                 return {
                     "epoch": c.membership_epoch,
                     "map_epoch": max(c.map_epoch, c._seen_map_epoch),
                     "incarnation": c.sched_incarnation,
                     "degraded": 0 if c._sched_up.is_set() else 1,
+                    # multi-tenant dimension (docs/async.md): per-step
+                    # records carry the job for the slo_breach rule and
+                    # the cluster matrix's per-tenant slice
+                    "job": job,
                 }
 
             st.flightrec = ensure_process_recorder(

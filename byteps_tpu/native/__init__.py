@@ -292,6 +292,8 @@ NATIVE_COUNTER_NAMES = (
     "native_zombie_reject",
     "native_span_drop",
     "native_wrong_owner",
+    "native_job_reject",
+    "native_async_reject",
 )
 
 

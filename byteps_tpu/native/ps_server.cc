@@ -122,6 +122,9 @@ enum NativeCounter {
   kCtrZombieReject,   // pushes rejected by the live-rank fence
   kCtrSpanDrop,       // span records dropped on a full trace ring
   kCtrWrongOwner,     // requests redirected by the ownership map
+  kCtrJobReject,      // job-namespaced frames refused (multi-tenant is
+                      // Python-engine-only; docs/async.md)
+  kCtrAsyncReject,    // async-profile INITs refused (no async plane)
   kCtrCount,
 };
 
@@ -134,7 +137,7 @@ const char* const kCounterNames[kCtrCount] = {
     "native_wire_rpc",        "native_fused_frames",  "native_fused_keys",
     "native_push_dedup",      "native_init_replay_ack",
     "native_resync_query",    "native_zombie_reject", "native_span_drop",
-    "native_wrong_owner",
+    "native_wrong_owner",     "native_job_reject",    "native_async_reject",
 };
 
 // ---------------------------------------------------------------------------
@@ -1691,6 +1694,28 @@ class NativeServer {
       uint64_t len = be64toh(h.length);
       payload.resize(len);
       if (len && !conn->recv_exact(payload.data(), len)) break;
+      // Multi-tenant fence (docs/async.md): keys carry their job id in
+      // the top 16 bits, and this engine has no per-job round sizing,
+      // QoS weighting, or admission metering — summing an unknown
+      // tenant's frames against the fleet-wide worker count would
+      // corrupt its rounds silently.  The payload is already consumed
+      // (stream stays framed); reject CLEANLY with the nonzero-status
+      // echo, log once, and keep serving job-0 traffic.  Run
+      // Python-engine servers for BYTEPS_JOB_ID != 0 fleets.
+      if ((key >> 48) != 0 && h.op != kPing && h.op != kShutdown) {
+        static std::atomic<bool> warned_job{false};
+        if (!warned_job.exchange(true)) {
+          fprintf(stderr,
+                  "byteps-native: rejecting frame for job %llu (key "
+                  "%llx) — multi-tenant job namespaces are "
+                  "Python-engine-only (docs/async.md)\n",
+                  (unsigned long long)(key >> 48),
+                  (unsigned long long)key);
+        }
+        ctr_[kCtrJobReject].fetch_add(1, std::memory_order_relaxed);
+        send_msg(conn, h.op, seq, key, 0, nullptr, 0, /*status=*/1);
+        continue;
+      }
       switch (h.op) {
         case kPing:
           send_msg(conn, kPing, seq, 0, 0, nullptr, 0);
@@ -1821,6 +1846,26 @@ class NativeServer {
     // malformed init must not silently strand the barrier: drop the
     // connection so the worker sees EOF instead of hanging forever
     if (payload.size() < 12) return false;
+    // Async-profile extension (docs/async.md): byte 12 bit 0 declares
+    // the key ASYNC (pushes apply immediately, pulls gated by a
+    // staleness bound).  This engine has no async plane — accepting the
+    // INIT and then running sync rounds would silently violate the
+    // consistency contract the worker asked for, so reject CLEANLY with
+    // the nonzero-status echo (the worker surfaces "run Python-engine
+    // servers"); log once.  Sync keys never send the extension.
+    if (payload.size() >= 13 && (payload[12] & 1)) {
+      static std::atomic<bool> warned_async{false};
+      if (!warned_async.exchange(true)) {
+        fprintf(stderr,
+                "byteps-native: rejecting async-profile init (key %llx) "
+                "— the async push_pull plane is Python-engine-only "
+                "(docs/async.md)\n",
+                (unsigned long long)key);
+      }
+      ctr_[kCtrAsyncReject].fetch_add(1, std::memory_order_relaxed);
+      send_msg(conn, kInit, seq, key, 0, nullptr, 0, /*status=*/1);
+      return true;
+    }
     uint64_t n;
     uint32_t dt;
     std::memcpy(&n, payload.data(), 8);

@@ -85,6 +85,9 @@ class _KeyState:
         "raw_version",
         "migrated_to",
         "migrate_epoch",
+        "job",
+        "async_mode",
+        "staleness",
         "lock",
     )
 
@@ -138,6 +141,16 @@ class _KeyState:
         # worker knows which book to wait for before chasing
         self.migrated_to: Optional[int] = None
         self.migrate_epoch = 0
+        # multi-tenant + async profile (docs/async.md): the job id the
+        # key is namespaced under (top 16 key bits; set at _key_state),
+        # whether its INIT declared the ASYNC profile (pushes apply
+        # immediately, pulls serve current state), and the bounded-
+        # staleness window for its pulls (-1 = unbounded; 0 = a pull at
+        # round v waits until every job worker applied round v —
+        # sequential consistency)
+        self.job = 0
+        self.async_mode = False
+        self.staleness = -1
         self.lock = threading.Lock()
 
     def wire_payload(self, compressed: bool, async_mode: bool = False) -> bytes:
@@ -239,27 +252,172 @@ class _EngineQueue:
 
     With scheduling enabled, pops the task whose key has the fewest
     accumulated pushes (anti-starvation, queue.h:49-97); otherwise FIFO.
+
+    Multi-tenant dimension (docs/async.md): tasks carry the JOB their
+    key is namespaced under, and the queue runs weighted fair queuing
+    ACROSS jobs — each job's lane accumulates served bytes divided by
+    its weight (the book's per-job ``priority``), and the pop serves
+    the lane with the lowest virtual time.  With a single job (the
+    pre-tenancy default) the WFQ layer is inert and the order is
+    identical to the classic per-thread queue, so a bulk tenant's
+    backlog can never sit in front of a latency tenant's requests
+    beyond its weighted share.
     """
 
-    def __init__(self, enable_schedule: bool) -> None:
+    def __init__(self, enable_schedule: bool, weight_fn=None) -> None:
         self.enable_schedule = enable_schedule
+        self._weight_fn = weight_fn or (lambda job: 1.0)
         self._cv = threading.Condition()
-        self._heap: List = []
+        #: job → [heap, vtime]; the heap entries are
+        #: (prio, arrival counter, item, cost bytes)
+        self._lanes: Dict[int, list] = {}
         self._counter = itertools.count()
+        self._size = 0
 
-    def put(self, prio: int, item) -> None:
+    def _weight(self, job: int) -> float:
+        try:
+            return max(0.001, float(self._weight_fn(job)))
+        except Exception:  # noqa: BLE001 — a QoS lookup bug ≠ a stall
+            return 1.0
+
+    def put(self, prio: int, item, job: int = 0, cost: int = 1) -> None:
         with self._cv:
-            heapq.heappush(self._heap, (prio if self.enable_schedule else 0, next(self._counter), item))
+            lane = self._lanes.get(job)
+            if lane is None:
+                lane = self._lanes[job] = [[], 0.0]
+            if not lane[0]:
+                # WFQ virtual-time join (see core/scheduler.py): an
+                # idle tenant re-activates at the live clock floor —
+                # neither a monopoly debt nor a starvation credit
+                active = [
+                    ln[1] / self._weight(j)
+                    for j, ln in self._lanes.items() if ln[0]
+                ]
+                if active:
+                    lane[1] = max(lane[1], min(active) * self._weight(job))
+            heapq.heappush(
+                lane[0],
+                (prio if self.enable_schedule else 0,
+                 next(self._counter), item, max(1, cost)),
+            )
+            self._size += 1
             self._cv.notify()
 
     def get(self, timeout: Optional[float] = None):
         # wait_for (not a single wait): a spurious wakeup must re-wait the
         # remaining budget, not cost a whole idle poll tick of tail latency
         with self._cv:
-            self._cv.wait_for(lambda: bool(self._heap), timeout)
-            if not self._heap:
+            self._cv.wait_for(lambda: self._size > 0, timeout)
+            if self._size == 0:
                 return None
-            return heapq.heappop(self._heap)[2]
+            job = min(
+                (j for j, ln in self._lanes.items() if ln[0]),
+                key=lambda j: self._lanes[j][1] / self._weight(j),
+            )
+            lane = self._lanes[job]
+            _prio, _cnt, item, cost = heapq.heappop(lane[0])
+            lane[1] += cost
+            self._size -= 1
+            return item
+
+
+class _ConnWriter:
+    """Per-connection reply writer — tenant response isolation
+    (docs/async.md).
+
+    The engine threads used to write replies INLINE; on a shared fleet
+    that is a cross-tenant head-of-line block no queue discipline can
+    fix: a bulk tenant whose (shaped / congested) socket buffer is full
+    parks the engine thread in ``sendall`` mid-item, and every other
+    tenant's queued requests wait out the block — WFQ reorders the
+    queue, not a thread stuck in a syscall.  With QoS active, engine
+    replies route through one writer thread per connection instead, so
+    a slow tenant's wire backs up ITS OWN writer only.
+
+    Bounded: past ``max_bytes`` of queued replies the producer blocks
+    (the engine thread then waits on that one conn — the pre-writer
+    behavior — rather than the process growing without bound; the
+    admission quota upstream keeps a metered tenant far from the cap).
+    The writer reaps itself after ``idle_s`` without traffic; a dead or
+    reaped writer is replaced lazily by :meth:`PSServer._reply_writer`.
+    """
+
+    __slots__ = ("_q", "_cv", "_bytes", "max_bytes", "idle_s", "dead")
+
+    def __init__(self, max_bytes: int = 16 << 20,
+                 idle_s: float = 5.0) -> None:
+        self._q: List = []
+        self._cv = threading.Condition()
+        self._bytes = 0
+        self.max_bytes = max_bytes
+        self.idle_s = idle_s
+        self.dead = False
+        threading.Thread(
+            target=self._loop, name="ps-reply-writer", daemon=True
+        ).start()
+
+    def submit(self, fn, nbytes: int) -> bool:
+        """Queue one send closure; False when this writer is dead (the
+        caller creates a fresh one).  Blocks past the byte cap."""
+        with self._cv:
+            while not self.dead and self._bytes >= self.max_bytes:
+                self._cv.wait(0.1)
+            if self.dead:
+                return False
+            self._q.append((fn, nbytes))
+            self._bytes += nbytes
+            self._cv.notify_all()
+            return True
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q:
+                    if not self._cv.wait(self.idle_s) and not self._q:
+                        self.dead = True  # idle: reap this thread
+                        return
+                fn, nbytes = self._q.pop(0)
+            try:
+                fn()
+            except (ConnectionError, OSError):
+                # conn died: drop the backlog — the peer's retry path
+                # owns recovery, exactly as with inline sends
+                with self._cv:
+                    self.dead = True
+                    self._q.clear()
+                    self._bytes = 0
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                self._bytes -= nbytes
+                self._cv.notify_all()
+
+
+class _QuotaBucket:
+    """Per-job admission meter (``BYTEPS_JOB_QUOTA_MBPS``,
+    docs/async.md): a virtual-wire token bucket over request payload
+    bytes.  ``reserve(n)`` returns how long the caller must DEFER the
+    request before serving it — excess traffic is delayed (backpressure
+    through the socket, exactly like a slow link), never dropped, so
+    retry/dedupe semantics are untouched."""
+
+    __slots__ = ("rate", "burst_s", "_free_at", "lock")
+
+    def __init__(self, mbps: float, burst_s: float = 0.25) -> None:
+        self.rate = max(1.0, mbps * 1e6)  # bytes/s (megaBYTES/s knob)
+        self.burst_s = burst_s
+        self._free_at = 0.0
+        self.lock = threading.Lock()
+
+    def reserve(self, nbytes: int) -> float:
+        with self.lock:
+            now = time.monotonic()
+            # idle credit is capped at one burst window: a job that went
+            # quiet may burst briefly, not bank unlimited backlog
+            self._free_at = max(self._free_at, now - self.burst_s)
+            admit_at = self._free_at
+            self._free_at += nbytes / self.rate
+            return max(0.0, admit_at - now)
 
 
 class PSServer:
@@ -283,8 +441,25 @@ class PSServer:
         self._tid_cache: Dict[int, int] = {}
         self._tid_load: List[int] = [0] * max(1, cfg.server_engine_threads)
         self._tid_lock = threading.Lock()
+        # --- multi-tenant plane (docs/async.md) ---
+        # per-job membership (worker FLAGS = rank+1) + QoS adopted from
+        # every book's ``jobs`` map: per-key rounds/barriers complete
+        # against the key's JOB population, the engine queues weight
+        # service per job, and the admission meter defers a job's
+        # requests past its quota
+        self._job_workers: Dict[int, set] = {}
+        self._job_qos: Dict[int, dict] = {}
+        self._job_quota: Dict[int, _QuotaBucket] = {}
+        self._qos_active = False
+        # per-connection reply writers (tenant response isolation): with
+        # QoS active, engine threads hand replies to one writer thread
+        # per conn instead of blocking in sendall on a slow tenant's
+        # socket — see _ConnWriter
+        self._writers: Dict[int, _ConnWriter] = {}
+        self._writers_lock = threading.Lock()
         self._queues = [
-            _EngineQueue(cfg.server_enable_schedule)
+            _EngineQueue(cfg.server_enable_schedule,
+                         weight_fn=self._job_weight)
             for _ in range(max(1, cfg.server_engine_threads))
         ]
         self.rank: Optional[int] = None
@@ -505,6 +680,7 @@ class PSServer:
             close_socket(self._sched_conn)  # dead link's fd: don't leak it
         self._sched_conn = conn
         self.rank = book["rank"]
+        self._adopt_jobs(book)  # before any round-completion check
         if initial:
             self.num_workers = book["num_workers"]
         else:
@@ -563,6 +739,7 @@ class PSServer:
             if not self._fence_book(book):
                 return  # stale-incarnation book refused (zombie fence)
             self._note_book(book)
+            self._adopt_jobs(book)  # membership map BEFORE round checks
             self.update_num_workers(book["num_workers"])
             self._adopt_worker_ranks(book)
             # ownership adoption LAST: a drain book's migration wave
@@ -700,6 +877,167 @@ class PSServer:
             {r + 1 for r in ranks if 0 <= r < 255} if ranks is not None
             else None
         )
+
+    # --- multi-tenant plane (docs/async.md) ------------------------------
+
+    def _adopt_jobs(self, book: dict) -> None:
+        """Adopt a book's per-job membership + QoS map: each job's
+        worker flags size that job's rounds/barriers, its priority
+        weights the engine queues, and a declared quota (MB/s) arms the
+        admission meter.  Books without a ``jobs`` field (older
+        schedulers) leave the single-tenant behavior in place."""
+        jobs = book.get("jobs")
+        if not isinstance(jobs, dict):
+            return
+        workers: Dict[int, set] = {}
+        qos: Dict[int, dict] = {}
+        for raw_job, info in jobs.items():
+            try:
+                job = int(raw_job)
+            except (TypeError, ValueError):
+                continue
+            flags = {
+                r + 1 for r in (info.get("workers") or []) if 0 <= r < 255
+            }
+            if flags:
+                workers[job] = flags
+            qos[job] = {
+                "priority": max(1, int(info.get("priority", 1) or 1)),
+                "quota_mbps": max(
+                    0.0, float(info.get("quota_mbps", 0) or 0)
+                ),
+            }
+        self._job_workers = workers
+        self._job_qos = qos
+        # the WFQ lanes engage only when some tenant actually DECLARED
+        # QoS (a priority above the default or a quota): with no
+        # declaration the engine queues stay job-blind — byte-fair
+        # service is a policy change, and "QoS off" must mean the exact
+        # legacy order (the honest A/B baseline tools/qos_bench.py runs)
+        self._qos_active = any(
+            q["priority"] > 1 or q["quota_mbps"] > 0 for q in qos.values()
+        )
+        # (re-)arm the admission meters; a quota change replaces the
+        # bucket (fresh burst window) and a dropped quota disarms it
+        quota: Dict[int, _QuotaBucket] = {}
+        from byteps_tpu.core.telemetry import metrics
+
+        for job, q in qos.items():
+            mbps = q["quota_mbps"]
+            if mbps <= 0:
+                continue
+            old = self._job_quota.get(job)
+            quota[job] = (
+                old if old is not None and abs(old.rate - mbps * 1e6) < 1.0
+                else _QuotaBucket(mbps)
+            )
+            metrics().gauge_set(
+                "server_job_quota_mbps", mbps, labels={"job": str(job)}
+            )
+        for job in self._job_quota:
+            if job not in quota:
+                # the job's quota was dropped: the ceiling gauge must
+                # go with it, or dashboards keep scoring utilization
+                # against a limit that no longer exists
+                metrics().gauge_remove(
+                    "server_job_quota_mbps", labels={"job": str(job)}
+                )
+        self._job_quota = quota
+
+    def _job_weight(self, job: int) -> float:
+        """WFQ weight of a tenant in the engine queues (the book's
+        per-job ``priority``; 1.0 for unknown jobs)."""
+        q = self._job_qos.get(job)
+        return float(q["priority"]) if q else 1.0
+
+    def _workers_for_ks(self, ks: "_KeyState") -> int:
+        """The worker population a key's rounds and init barriers
+        complete against: its JOB's registered workers when the book
+        carries a membership map, else the fleet total (single-tenant
+        behavior)."""
+        flags = self._job_workers.get(ks.job)
+        return len(flags) if flags else self.num_workers
+
+    def _async_ks(self, ks: "_KeyState") -> bool:
+        """Whether a key runs the async profile: its INIT declared it
+        (per-key, docs/async.md), or the whole server runs legacy
+        ``BYTEPS_ENABLE_ASYNC`` mode."""
+        return ks.async_mode or self.cfg.enable_async
+
+    def _min_applied_locked(self, ks: "_KeyState") -> int:
+        """The slowest job worker's newest APPLIED push version for an
+        async key — what the bounded-staleness gate compares pull
+        rounds against.  Workers that never pushed count as version 0.
+        Caller holds ``ks.lock``."""
+        flags = self._job_workers.get(ks.job)
+        if flags:
+            return min(ks.push_seen.get(w, 0) for w in flags)
+        n = self._workers_for_ks(ks)
+        if n <= 0:
+            return 0
+        vals = sorted(ks.push_seen.values(), reverse=True)[:n]
+        vals += [0] * (n - len(vals))
+        return min(vals)
+
+    def _staleness_ready_locked(self, ks: "_KeyState", version: int) -> bool:
+        """Bounded-staleness gate (docs/async.md): a pull at round
+        ``version`` may be served iff every job worker's applied-push
+        version is within ``ks.staleness`` rounds of it.  -1 =
+        unbounded (pure async); 0 degenerates to sequential
+        consistency.  Caller holds ``ks.lock``."""
+        if ks.staleness < 0:
+            return True
+        return self._min_applied_locked(ks) >= version - ks.staleness
+
+    def _flush_async_waiters_locked(self, ks: "_KeyState") -> List:
+        """Pulls (and fused pull-halves) parked behind the staleness
+        bound whose gate now opens — called after an async push applied
+        (the peer push IS the unblocking event) and after a membership
+        shrink.  Caller holds ``ks.lock``; returns the flush list."""
+        return self._drain_waiters_locked(
+            ks, lambda v: self._staleness_ready_locked(ks, v),
+            async_mode=True,
+        )
+
+    def _drain_waiters_locked(self, ks: "_KeyState", ready,
+                              async_mode: bool) -> List:
+        """The ONE pending-pull/fused-waiter drain, shared by the sync
+        round publish and the async staleness flush — only the
+        readiness predicate and the wire-payload mode differ.  A
+        malformed row-sparse gather drops THAT puller's connection and
+        keeps serving the rest.  Caller holds ``ks.lock``."""
+        flush: List = []
+        still_pending = []
+        for entry in ks.pending_pulls:
+            version, pconn, plock, pseq, pcomp, rs_req = entry
+            if ready(version):
+                try:
+                    payload = (
+                        self._rowsparse_gather(ks, rs_req)
+                        if rs_req is not None
+                        else ks.wire_payload(pcomp, async_mode)
+                    )
+                except RuntimeError:
+                    close_socket(pconn)
+                    continue
+                flush.append(
+                    (pconn, plock, pseq, payload, ks.store_version)
+                )
+            else:
+                still_pending.append(entry)
+        ks.pending_pulls = still_pending
+        still_fused = []
+        for version, reply, slot, pcomp in ks.fused_waiters:
+            if ready(version):
+                if reply.fill(
+                    slot, ks.wire_payload(pcomp, async_mode),
+                    ks.store_version,
+                ):
+                    flush.append(reply)
+            else:
+                still_fused.append((version, reply, slot, pcomp))
+        ks.fused_waiters = still_fused
+        return flush
 
     # --- elastic resharding (docs/robustness.md "migration flow") --------
 
@@ -860,6 +1198,11 @@ class PSServer:
                 "push_seen": {str(w): int(v) for w, v in ks.push_seen.items()},
                 "init_done": {str(w): int(v) for w, v in ks.init_done.items()},
                 "compressor_kwargs": dict(ks.compressor_kwargs),
+                # async profile rides the migration (docs/async.md): the
+                # new owner must keep applying pushes immediately and
+                # gating pulls on the same staleness bound
+                "async_mode": bool(ks.async_mode),
+                "staleness": int(ks.staleness),
             }
             store_b = ks.store.tobytes()
             accum_b = ks.accum.tobytes() if ks.recv_count else b""
@@ -1125,7 +1468,10 @@ class PSServer:
         with self._awaiting_lock:
             parked = self._awaiting.pop(key, [])
         for _t, m, c, sl in parked:
-            self._enqueue(m, c, sl)
+            # metered=True: these requests were accounted (and
+            # admission-delayed) on their ORIGINAL arrival — the
+            # migration park must not charge the tenant twice
+            self._enqueue(m, c, sl, metered=True)
         self._update_owned_gauge()
 
     def _install_migrated_locked(self, ks: _KeyState, epoch: int, dtype,
@@ -1166,6 +1512,9 @@ class PSServer:
                 str(k): str(v)
                 for k, v in (meta.get("compressor_kwargs") or {}).items()
             }
+            if meta.get("async_mode"):
+                ks.async_mode = True
+                ks.staleness = max(-1, int(meta.get("staleness", -1)))
             ks.compressor = None
             if ks.compressor_kwargs:
                 from byteps_tpu.compression.registry import create_compressor
@@ -1304,10 +1653,13 @@ class PSServer:
         )
 
     def _key_state(self, key: int) -> _KeyState:
+        from byteps_tpu.common.tenancy import job_of_key
+
         with self._keys_lock:
             ks = self._keys.get(key)
             if ks is None:
                 ks = self._keys[key] = _KeyState()
+                ks.job = job_of_key(key)
             return ks
 
     def _thread_for(self, key: int, length: int) -> int:
@@ -1319,15 +1671,50 @@ class PSServer:
             self._tid_load[tid] += length
             return tid
 
-    def _enqueue(self, msg: Message, conn, send_lock) -> None:
-        tid = self._thread_for(msg.key, len(msg.payload))
+    def _enqueue(self, msg: Message, conn, send_lock,
+                 metered: bool = False) -> None:
         ks = self._key_state(msg.key)
+        job = ks.job
+        if job and not metered:
+            # per-tenant accounting + admission (docs/async.md): the
+            # job's data-plane bytes feed the utilization surface, and
+            # a declared quota DELAYS excess requests (token bucket) —
+            # INIT/control frames never meter (a barrier must not
+            # starve behind a bulk push backlog)
+            from byteps_tpu.core.telemetry import counters
+
+            labels = {"job": str(job)}
+            counters().bump("server_job_requests", labels=labels)
+            counters().bump(
+                "server_job_bytes", len(msg.payload), labels=labels
+            )
+            bucket = self._job_quota.get(job)
+            if bucket is not None and msg.op != Op.INIT:
+                delay = bucket.reserve(len(msg.payload))
+                if delay > 0:
+                    # admission BACKPRESSURE, not a parked copy: hold
+                    # this connection's serve thread (a data conn is
+                    # single-tenant) so the overloaded job's own frame
+                    # stream throttles — exactly a slower link.  A
+                    # parked-copy design double-charged the bucket when
+                    # a client deadline/retry re-sent the frame and
+                    # accumulated duplicate payloads server-side; here
+                    # overload self-clocks (the sleep throttles
+                    # arrivals, so per-frame delay stays ~one
+                    # serialization slot) and dedupe semantics are the
+                    # plain retry path's.
+                    counters().bump("job_quota_deferred", labels=labels)
+                    if self._stop.wait(delay):
+                        return
+        tid = self._thread_for(msg.key, len(msg.payload))
         # anti-starvation: fewest accumulated pushes first (queue.h:49-97).
         # The wall-clock stamp bounds the "recv" child span: engine-queue
         # dwell is part of the server-side latency a worker observes.
         self._queues[tid].put(
-            ks.pushed_total, (msg, conn, send_lock, time.time())
+            ks.pushed_total, (msg, conn, send_lock, time.time()),
+            job=job if self._qos_active else 0, cost=len(msg.payload),
         )
+
 
     # --- engine plane ----------------------------------------------------
 
@@ -1365,15 +1752,34 @@ class PSServer:
 
     def _handle_init(self, msg: Message, conn, send_lock) -> None:
         """Init push = allocate + cross-worker barrier (server.cc:266-295).
-        Payload: u64 nelems + u32 dtype, network order."""
+        Payload: u64 nelems + u32 dtype, network order — plus the
+        OPTIONAL async-profile extension (docs/async.md): u8 profile
+        (bit 0 = async) + i32 staleness bound.  Sync keys never send
+        the extension, so pre-async decoders (and the native C++
+        engine, which rejects it) see the classic 12-byte frame."""
         import struct
 
-        n, dtype_id = struct.unpack("!QI", msg.payload)
+        n, dtype_id = struct.unpack_from("!QI", msg.payload, 0)
+        async_profile = False
+        staleness = -1
+        if len(msg.payload) >= 17:
+            profile, staleness = struct.unpack_from("!Bi", msg.payload, 12)
+            async_profile = bool(profile & 1)
         ks = self._key_state(msg.key)
         wid = msg.flags
         token = msg.version
         created = False
         with ks.lock:
+            # per-key async profile + staleness bound, adopted from
+            # EVERY init: a re-init generation that drops the extension
+            # returns the key to sync semantics (KeyState outlives
+            # client shutdown()/init() cycles, so a sticky flag would
+            # leave a nominally-sync rerun training async).  Every job
+            # worker's INIT carries the same declaration (the env /
+            # declare kwargs are job-wide), so last-writer-wins is
+            # deterministic.
+            ks.async_mode = async_profile
+            ks.staleness = max(-1, int(staleness)) if async_profile else -1
             redirect = self._redirect_locked(msg.key, ks)
             if redirect is None and ks.store is None:
                 created = True
@@ -1432,8 +1838,10 @@ class PSServer:
     def _complete_init_barrier_locked(self, ks: "_KeyState"):
         """If the key's init barrier is full, consume it and reset the
         round state; returns the waiters to release, or None if the
-        barrier is still short.  Caller holds ks.lock."""
-        if not (0 < self.num_workers <= len(ks.init_waiters)):
+        barrier is still short.  The barrier completes against the
+        key's JOB population (docs/async.md) — a tenant's init must
+        never wait for another job's workers.  Caller holds ks.lock."""
+        if not (0 < self._workers_for_ks(ks) <= len(ks.init_waiters)):
             return None
         waiters, ks.init_waiters = ks.init_waiters, []
         # record each waiter's init token: a retried INIT landing AFTER
@@ -1539,20 +1947,62 @@ class PSServer:
         if msg.flags and msg.version > 0:
             ks.push_seen[msg.flags] = msg.version
 
-    @staticmethod
-    def _flush_pulls(key: int, flush: List) -> None:
+    def _reply_writer(self, conn) -> _ConnWriter:
+        """The connection's reply writer, created (or replaced after a
+        reap/death) lazily."""
+        key = id(conn)
+        with self._writers_lock:
+            w = self._writers.get(key)
+            if w is None or w.dead:
+                # opportunistic sweep: idle-reaped / dead-conn writers
+                # must not accumulate for the life of the server (one
+                # per connection ever seen, under reconnect churn)
+                for k in [k for k, ww in self._writers.items() if ww.dead]:
+                    del self._writers[k]
+                w = self._writers[key] = _ConnWriter()
+            return w
+
+    def _send_reply(self, conn, msg: Message, send_lock) -> None:
+        """Send one engine-thread reply.  QoS active → routed through
+        the connection's writer so a slow tenant's socket never blocks
+        the shared engine thread (docs/async.md); otherwise the classic
+        inline send, bit-identical single-tenant behavior."""
+        if not self._qos_active:
+            send_message(conn, msg, send_lock)
+            return
+        self._submit_reply(
+            conn, lambda: send_message(conn, msg, send_lock),
+            len(msg.payload) + 64,
+        )
+
+    def _submit_reply(self, conn, fn, nbytes: int) -> None:
+        """Queue one reply closure on the conn's writer, replacing a
+        writer that died/reaped between lookup and submit (the reply
+        must not vanish into a dead thread — the peer would wait out a
+        whole deadline for nothing)."""
+        if not self._reply_writer(conn).submit(fn, nbytes):
+            self._reply_writer(conn).submit(fn, nbytes)
+
+    def _flush_pulls(self, key: int, flush: List) -> None:
         """Answer flushed pending pulls — 5-tuples for plain pulls,
         :class:`_FusedReply` objects for completed fused frames —
         tolerating dead pullers: one torn connection (its worker is
         already re-pulling on a fresh one) must not strand the responses
-        queued behind it."""
+        queued behind it.  Under QoS the sends ride each connection's
+        reply writer (tenant response isolation)."""
         for entry in flush:
             try:
                 if isinstance(entry, _FusedReply):
-                    entry.send()
+                    if self._qos_active:
+                        self._submit_reply(
+                            entry.conn, entry.send,
+                            sum(len(s) for s in entry.slots if s) + 64,
+                        )
+                    else:
+                        entry.send()
                     continue
                 pconn, plock, pseq, payload, ver = entry
-                send_message(
+                self._send_reply(
                     pconn,
                     Message(Op.PULL, key=key, payload=payload, seq=pseq,
                             version=ver),
@@ -1569,7 +2019,7 @@ class PSServer:
         SUM_RECVs into the accumulator.  Records the replay-ledger entry
         only AFTER the summation succeeded (a sum that raises must leave
         the retry eligible)."""
-        if self.cfg.enable_async:
+        if self._async_ks(ks):
             # async mode: parameter store, sum deltas in place
             # (server.cc:315-319)
             if compressed:
@@ -1643,10 +2093,15 @@ class PSServer:
                 pass  # replied below, outside the lock
             elif self._is_replayed_push_locked(ks, msg):
                 dedupe = True  # ack-only (below): the original was summed
+            elif self._async_ks(ks):
+                self._sum_push_locked(ks, msg, compressed, arr)
+                # this push may be the one a staleness-parked pull was
+                # waiting on — the "unblocks on peer push" contract
+                # (docs/async.md)
+                flush.extend(self._flush_async_waiters_locked(ks))
             else:
                 self._sum_push_locked(ks, msg, compressed, arr)
-                if (not self.cfg.enable_async
-                        and ks.recv_count >= self.num_workers):
+                if ks.recv_count >= self._workers_for_ks(ks):
                     p0 = time.time()
                     flush.extend(self._publish_round_locked(ks, compressed))
                     published = time.time() - p0
@@ -1662,7 +2117,7 @@ class PSServer:
             metrics().observe("server_publish_seconds", published)
             self._child_span(msg.trace, msg.key, "publish",
                              t_summed - published, published)
-        send_message(conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version), send_lock)
+        self._send_reply(conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version), send_lock)
         self._child_span(msg.trace, msg.key, "reply", t_summed,
                          time.time() - t_summed)
         self._flush_pulls(msg.key, flush)
@@ -1741,23 +2196,31 @@ class PSServer:
                             f"push for uninitialized key {key}"
                         )
                 if redirect is None and not park:
+                    is_async = self._async_ks(ks)
                     if self._is_replayed_push_locked(ks, sub):
                         dedupe = True
                     else:
                         self._sum_push_locked(ks, sub, compressed, arr)
-                        if (not self.cfg.enable_async
-                                and ks.recv_count >= self.num_workers):
+                        if is_async:
+                            flush.extend(
+                                self._flush_async_waiters_locked(ks)
+                            )
+                        elif ks.recv_count >= self._workers_for_ks(ks):
                             p0 = time.time()
                             flush.extend(
                                 self._publish_round_locked(ks, compressed)
                             )
                             published = time.time() - p0
-                    # this member's pull half: answered now if its round is
-                    # published (async mode always is), else parked on the key
-                    if self.cfg.enable_async or version <= ks.store_version:
+                    # this member's pull half: answered now if its round
+                    # is published (async mode: when within the
+                    # staleness bound), else parked on the key
+                    if (
+                        self._staleness_ready_locked(ks, version)
+                        if is_async else version <= ks.store_version
+                    ):
                         if reply.fill(
                             slot,
-                            ks.wire_payload(compressed, self.cfg.enable_async),
+                            ks.wire_payload(compressed, is_async),
                             ks.store_version,
                         ):
                             flush.append(reply)
@@ -1829,7 +2292,7 @@ class PSServer:
         if redirect is not None:
             self._send_wrong_owner(conn, send_lock, msg, redirect)
             return
-        send_message(
+        self._send_reply(
             conn, Message(Op.PUSH, key=msg.key, seq=msg.seq, version=msg.version),
             send_lock,
         )
@@ -1854,12 +2317,13 @@ class PSServer:
             )
         if self._is_replayed_push_locked(ks, msg):
             pass  # ack-only: the original scatter-sum already landed
-        elif self.cfg.enable_async:
+        elif self._async_ks(ks):
             # async parameter store: scatter deltas in place
             np.add.at(ks.store.reshape(total_rows, row_len), idx, vals)
             ks.store_version += 1
             ks.pushed_total += 1
             self._record_push_locked(ks, msg)
+            flush.extend(self._flush_async_waiters_locked(ks))
         else:
             if ks.recv_count == 0:
                 # sparse COPY_FIRST: rows this worker does NOT touch
@@ -1870,7 +2334,7 @@ class PSServer:
             ks.recv_count += 1
             ks.pushed_total += 1
             self._record_push_locked(ks, msg)
-            if ks.recv_count >= self.num_workers:
+            if ks.recv_count >= self._workers_for_ks(ks):
                 flush.extend(self._publish_round_locked(ks, False))
 
     def _rowsparse_gather(self, ks: "_KeyState", req_payload: bytes) -> bytes:
@@ -1896,37 +2360,11 @@ class PSServer:
             # (server.cc:348-370)
             ks.pull_payload = ks.compressor.compress(ks.store)
             ks.pull_version = ks.store_version
-        flush: List = []
-        still_pending = []
-        for version, pconn, plock, pseq, pcomp, rs_req in ks.pending_pulls:
-            if version <= ks.store_version:
-                try:
-                    payload = (
-                        self._rowsparse_gather(ks, rs_req)
-                        if rs_req is not None
-                        else ks.wire_payload(pcomp)
-                    )
-                except RuntimeError:
-                    # malformed RS gather request: drop THAT connection (the
-                    # worker's on_error fires instead of hanging forever) —
-                    # and keep serving the rest of the flush list
-                    close_socket(pconn)
-                    continue
-                flush.append((pconn, plock, pseq, payload, ks.store_version))
-            else:
-                still_pending.append((version, pconn, plock, pseq, pcomp, rs_req))
-        ks.pending_pulls = still_pending
-        # fused pull-halves parked on this key: fill their reply slots;
-        # a fill that COMPLETES its frame queues the whole reply for send
-        still_fused = []
-        for version, reply, slot, pcomp in ks.fused_waiters:
-            if version <= ks.store_version:
-                if reply.fill(slot, ks.wire_payload(pcomp), ks.store_version):
-                    flush.append(reply)
-            else:
-                still_fused.append((version, reply, slot, pcomp))
-        ks.fused_waiters = still_fused
-        return flush
+        # answer buffered pulls + fill parked fused reply slots (a fill
+        # that COMPLETES its frame queues the whole reply for send)
+        return self._drain_waiters_locked(
+            ks, lambda v: v <= ks.store_version, async_mode=False,
+        )
 
     def update_num_workers(self, n: int) -> None:
         """Adopt a resized worker population (elastic scale-up/down).  A
@@ -1941,13 +2379,20 @@ class PSServer:
                 waiters = self._complete_init_barrier_locked(ks)
             if waiters:
                 self._release_init_waiters(key, waiters)
-        if self.cfg.enable_async:
-            return
         for key, ks in list(self._keys.items()):
             flush: List = []
             with ks.lock:
-                if ks.store is not None and 0 < n <= ks.recv_count:
-                    flush = self._publish_round_locked(ks, ks.compressor is not None)
+                if ks.store is None:
+                    pass
+                elif self._async_ks(ks):
+                    # a membership shrink can open the staleness gate
+                    # (the departed worker no longer counts toward the
+                    # slowest-peer minimum)
+                    flush = self._flush_async_waiters_locked(ks)
+                elif 0 < self._workers_for_ks(ks) <= ks.recv_count:
+                    flush = self._publish_round_locked(
+                        ks, ks.compressor is not None
+                    )
             self._flush_pulls(key, flush)
 
     def _handle_resync(self, msg: Message, conn, send_lock) -> None:
@@ -2021,19 +2466,23 @@ class PSServer:
                     self._park_awaiting(msg.key, msg, conn, send_lock)
                     return
                 raise RuntimeError(f"pull for uninitialized key {msg.key}")
+            is_async = self._async_ks(ks)
             if redirect is not None:
                 ready = False  # replied below (never parked on this key)
+            elif is_async:
+                # async profile: current state, gated only by the
+                # bounded-staleness window (docs/async.md) — a pull past
+                # the bound parks until the lagging peer's push applies
+                ready = self._staleness_ready_locked(ks, msg.version)
             else:
-                ready = (
-                    self.cfg.enable_async or msg.version <= ks.store_version
-                )
+                ready = msg.version <= ks.store_version
             if redirect is not None:
                 pass
             elif ready:
                 payload = (
                     self._rowsparse_gather(ks, msg.payload)
                     if rowsparse
-                    else ks.wire_payload(wants_compressed, self.cfg.enable_async)
+                    else ks.wire_payload(wants_compressed, is_async)
                 )
                 ver = ks.store_version
             else:
@@ -2049,7 +2498,7 @@ class PSServer:
             self._send_wrong_owner(conn, send_lock, msg, redirect)
             return
         t_ready = time.time()
-        send_message(
+        self._send_reply(
             conn, Message(Op.PULL, key=msg.key, payload=payload, seq=msg.seq, version=ver), send_lock
         )
         self._child_span(msg.trace, msg.key, "reply", t_ready,
@@ -2143,6 +2592,11 @@ class NativePSServer:
         self.rank: Optional[int] = None
         self.num_workers = cfg.num_worker
         self._live_worker_flags: Optional[set] = None
+        # multi-tenant book state (the borrowed _adopt_jobs writes these;
+        # the C++ data plane itself rejects job-namespaced frames)
+        self._job_workers: Dict[int, set] = {}
+        self._job_qos: Dict[int, dict] = {}
+        self._job_quota: Dict[int, "_QuotaBucket"] = {}
         self._stop = threading.Event()
         self._sched_conn: Optional[socket.socket] = None
         # control-plane recovery state (docs/robustness.md) — same
@@ -2381,6 +2835,10 @@ class NativePSServer:
     _handle_control = PSServer._handle_control
     _fence_book = PSServer._fence_book
     _note_book = PSServer._note_book
+    # multi-tenant book map (docs/async.md): adopted for observability
+    # only — the C++ data plane REJECTS job-namespaced frames (clean
+    # status=1 echo), so the weights/quotas never engage natively
+    _adopt_jobs = PSServer._adopt_jobs
 
     def start(self, register: bool = True) -> None:
         # scrape surface with the C++ data plane: the process-global

@@ -222,7 +222,7 @@ class TestHeartbeatTailMerge:
         assert len(tail) == 16
         assert tail[-1]["step"] == 40 and tail[0]["step"] == 25
         assert set(tail[0]) == {"step", "k", "t", "dur", "deg", "trig",
-                                "rpc"}
+                                "job", "rpc"}
 
     def test_cluster_matrix_dedupes_reshipped_windows(self):
         cf = ClusterFlight()

@@ -568,6 +568,78 @@ def _r_chaos_active(v: View):
     )
 
 
+_TENANT_ANCHOR = slugify("Multi-tenant: a job is starved or missing its SLO")
+
+
+def _r_quota_starved(v: View):
+    """One tenant's requests are mostly sitting in the admission meter:
+    its offered load exceeds BYTEPS_JOB_QUOTA_MBPS, so the server defers
+    (token bucket) a large share of them — the job sees its own quota,
+    not the fleet, as the bottleneck."""
+    deferred = v.labeled_by("job_quota_deferred", "job")
+    served = v.labeled_by("server_job_requests", "job")
+    worst, ratio = None, 0.0
+    for job, d in deferred.items():
+        tot = max(1.0, served.get(job, d))
+        r = d / tot
+        if d >= 10 and r > ratio:
+            worst, ratio = job, r
+    if worst is None or ratio < 0.2:
+        return None
+    quotas = {
+        labels.get("job"): val
+        for labels, val in v.gauges.get("server_job_quota_mbps", [])
+    }
+    evidence = [
+        f"job_quota_deferred{{job={worst}}} = {deferred[worst]:.0f} "
+        f"(~{100 * ratio:.0f}% of its {served.get(worst, 0):.0f} "
+        "data-plane requests deferred by the admission meter)"
+    ]
+    if quotas.get(worst):
+        evidence.append(
+            f"server_job_quota_mbps{{job={worst}}} = {quotas[worst]:g} MB/s"
+            " — the configured ceiling"
+        )
+    return (
+        40 + min(40.0, 100 * ratio),
+        f"job {worst} is quota-starved: its offered load exceeds its "
+        "admission quota, so the server is deliberately delaying it "
+        "(neighbors are protected; THIS job is rate-limited)",
+        evidence,
+    )
+
+
+def _r_slo_breach(v: View):
+    """A tenant's declared step-time SLO (BYTEPS_JOB_SLO_S) was blown —
+    the flight recorder's slo_breach trigger fired."""
+    fired = v.labeled_by("flight_trigger", "rule").get("slo_breach", 0.0)
+    led = v.ledger_triggers().get("slo_breach", 0)
+    n = max(fired, float(led))
+    if n <= 0:
+        return None
+    evidence = [f"flight_trigger{{rule=slo_breach}} = {n:.0f}"]
+    jobs = sorted({
+        str(r.get("job")) for r in v.ledger
+        if "slo_breach" in (r.get("trig") or ())
+    })
+    if jobs:
+        evidence.append("breaching job(s): " + ", ".join(jobs))
+    worst = max(
+        (r.get("dur") or 0.0 for r in v.ledger
+         if "slo_breach" in (r.get("trig") or ())),
+        default=0.0,
+    )
+    if worst:
+        evidence.append(f"worst breaching step: {worst:.3f}s")
+    return (
+        45 + min(30.0, 5 * n),
+        "a tenant blew its step-time SLO (BYTEPS_JOB_SLO_S) — check "
+        "whether a bulk neighbor saturates the shared fleet (give the "
+        "latency job a higher BYTEPS_JOB_PRIORITY / quota the bulk job)",
+        evidence,
+    )
+
+
 RULES: List[Rule] = [
     Rule("straggler_server", _SLOW_ANCHOR,
          "BYTEPS_DEAD_NODE_TIMEOUT_S (evict it) / fix the sick server",
@@ -606,6 +678,13 @@ RULES: List[Rule] = [
     Rule("chaos_active", _SLOW_ANCHOR,
          "unset BYTEPS_CHAOS_* if this is not a rehearsal",
          _r_chaos_active),
+    Rule("quota_starved", _TENANT_ANCHOR,
+         "BYTEPS_JOB_QUOTA_MBPS up (or shed the job's offered load)",
+         _r_quota_starved),
+    Rule("slo_breach", _TENANT_ANCHOR,
+         "BYTEPS_JOB_PRIORITY up for the latency job / "
+         "BYTEPS_JOB_QUOTA_MBPS down for the bulk neighbor",
+         _r_slo_breach),
 ]
 
 

@@ -147,6 +147,60 @@ def render(url: str, cur: Sample, prev: Sample, dt: float,
         if trig:
             cells = " ".join(f"{r}={n}" for r, n in sorted(trig.items()))
             lines.append(f"  flight triggers      : {cells}")
+    # per-tenant row (docs/async.md): one line per JOB sharing the fleet
+    # — last-N step-time sparkline (job_step_last_seconds gauge history
+    # across polls) plus quota utilization, the delta rate of the job's
+    # served bytes against its configured server_job_quota_mbps ceiling.
+    tenant_rows = {}
+    for (name, lbl), v in cur.items():
+        if name != "byteps_job_step_last_seconds":
+            continue
+        jm = re.search(r'job="([^"]*)"', lbl)
+        if not jm:
+            continue
+        series = None
+        if hist is not None:
+            series = hist.setdefault((name, lbl), [])
+            series.append(v)
+            del series[:-24]
+        row = tenant_rows.setdefault(
+            jm.group(1), {"last": 0.0, "series": [], "util": None}
+        )
+        row["last"] = max(row["last"], v)
+        row["series"] = list(series or [v])
+    quotas, rates = {}, {}
+    for (name, lbl), v in cur.items():
+        jm = re.search(r'job="([^"]*)"', lbl)
+        if not jm:
+            continue
+        if name == "byteps_server_job_quota_mbps":
+            # quotas are enforced PER SERVER (ROADMAP note), and the
+            # aggregate carries one series per server rank — the fleet
+            # ceiling the summed byte rate compares against is the SUM
+            quotas[jm.group(1)] = quotas.get(jm.group(1), 0.0) + v
+        elif name == "byteps_server_job_bytes_labeled_total" and dt > 0:
+            d = v - prev.get((name, lbl), 0.0)
+            rates[jm.group(1)] = rates.get(jm.group(1), 0.0) + max(0.0, d) / dt
+    for job, mbps in quotas.items():
+        row = tenant_rows.setdefault(
+            job, {"last": 0.0, "series": [], "util": None}
+        )
+        rate = rates.get(job, 0.0) / 1e6  # bytes/s → MB/s
+        row["util"] = (rate, mbps)
+    if tenant_rows:
+        lines.append(
+            f"  {'tenants (job: steps | quota use)':42s} {'last':>9s}"
+        )
+        for job in sorted(tenant_rows, key=lambda j: int(j) if j.isdigit() else 0):
+            row = tenant_rows[job]
+            cell = f"  job {job:<6s} {_sparkline(row['series']):24s}"
+            if row["last"]:
+                cell += f" {_fmt_s(row['last']):>12s}"
+            if row["util"] is not None:
+                rate, mbps = row["util"]
+                pct = 100.0 * rate / mbps if mbps > 0 else 0.0
+                cell += f"  quota {rate:6.2f}/{mbps:g} MB/s ({pct:3.0f}%)"
+            lines.append(cell)
     # reducer backlog of the key-striped native engine, one cell per
     # stripe — a persistently deep cell while its siblings sit at 0 is
     # the hot-stripe signature (docs/perf.md).  Sorted numerically (s2

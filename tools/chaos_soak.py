@@ -351,6 +351,127 @@ def run_soak(
     }
 
 
+def run_multi_tenant_soak(
+    steps: int = 60,
+    seed: int = 7,
+    servers: int = 2,
+    drop: float = 0.05,
+    delay: float = 0.05,
+    dim: int = 1024,
+) -> dict:
+    """Two concurrent JOBS through chaos faults on one PS fleet
+    (docs/async.md): job 1 trains SYNC (per-step aggregation must stay
+    BITWISE — a cross-tenant key collision or a double-summed replay
+    shows up immediately), job 2 trains ASYNC (the server's
+    authoritative store must equal the exact running sum of every
+    applied push, and its version must advance once per push — lost
+    pushes and broken ledger dedupe both break the equality).  Faults
+    are retryable classes only (drop/delay): a degraded re-init reset
+    is a legitimate fallback but would wipe the async store's history
+    and turn this invariant check into noise."""
+    os.environ.update(
+        {
+            "BYTEPS_VAN": "chaos:tcp",
+            "BYTEPS_CHAOS_SEED": str(seed),
+            "BYTEPS_CHAOS_DROP": str(drop),
+            "BYTEPS_CHAOS_DELAY": str(delay),
+            "BYTEPS_CHAOS_DELAY_MS": "10",
+            "BYTEPS_CHAOS_DISCONNECT": "0",
+            "BYTEPS_CHAOS_TRUNCATE": "0",
+            "BYTEPS_CHAOS_CORRUPT": "0",
+            "BYTEPS_RPC_DEADLINE_S": "0.3",
+            "BYTEPS_INIT_DEADLINE_S": "0.5",
+            "BYTEPS_RPC_RETRIES": "8",
+            "BYTEPS_RPC_BACKOFF_S": "0.05",
+            "BYTEPS_CONNECT_RETRY_S": "0.2",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.5",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+            "DMLC_NUM_WORKER": "1",
+            "DMLC_NUM_SERVER": str(servers),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+        }
+    )
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.common.tenancy import job_of_key
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.core.telemetry import counters
+    from byteps_tpu.server.server import PSServer
+
+    counters().reset()
+    sched = Scheduler(num_workers=1, num_servers=servers, host="127.0.0.1")
+    sched.start()
+    os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+    fleet = [PSServer(Config.from_env()) for _ in range(servers)]
+    for srv in fleet:
+        threading.Thread(target=srv.start, daemon=True).start()
+
+    import byteps_tpu as bps
+    from byteps_tpu.common.registry import get_registry
+
+    rng = np.random.default_rng(seed)
+    w_sync = rng.standard_normal(dim).astype(np.float32)
+    loss0 = float(w_sync @ w_sync)
+    lr = 0.05
+    running = np.zeros(dim, dtype=np.float32)
+    try:
+        bps.init()
+        # one worker PROCESS hosting two tenants via the per-tensor
+        # declare hooks: job 1 sync, job 2 async (unbounded staleness)
+        get_registry().declare("mt.sync", byteps_job="1")
+        get_registry().declare(
+            "mt.async", byteps_job="2", byteps_async="1",
+            byteps_staleness="-1",
+        )
+        for step in range(steps):
+            # --- sync tenant: per-step bitwise aggregation ---
+            grad = 2.0 * w_sync
+            agg = np.asarray(bps.push_pull(grad, name="mt.sync",
+                                           average=True))
+            np.testing.assert_array_equal(agg, grad)
+            w_sync = w_sync - lr * agg
+            # --- async tenant: the pulled state must equal the exact
+            # running sum of the applied pushes (same accumulation
+            # order server-side: store += delta per push) ---
+            delta = rng.standard_normal(dim).astype(np.float32)
+            pulled = np.asarray(bps.push_pull(delta, name="mt.async",
+                                              average=False))
+            running = running + delta
+            np.testing.assert_array_equal(pulled, running)
+        loss1 = float(w_sync @ w_sync)
+        snap = bps.get_robustness_counters()
+        # monotone version progress on the async key: exactly one
+        # applied push per round — a lost push OR a double-summed
+        # replay would leave store_version != steps
+        async_states = [
+            (key, ks) for srv in fleet for key, ks in srv._keys.items()
+            if ks.store is not None and job_of_key(key) == 2
+        ]
+        assert async_states, "async tenant's key never materialized"
+        for key, ks in async_states:
+            assert ks.async_mode, f"key {key:#x} lost its async profile"
+            assert ks.store_version == steps, (
+                f"async key {key:#x}: store_version {ks.store_version} "
+                f"!= {steps} applied pushes (lost push or broken dedupe)"
+            )
+    finally:
+        bps.shutdown()
+        for srv in fleet:
+            srv.stop()
+        sched.stop()
+
+    assert loss1 < loss0, f"sync tenant did not learn: {loss0} -> {loss1}"
+    injected = sum(v for k, v in snap.items() if k.startswith("chaos_"))
+    if drop or delay:
+        assert injected > 0, f"no faults injected: {snap}"
+    return {
+        "steps": steps,
+        "loss0": loss0,
+        "loss1": loss1,
+        "counters": snap,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=60)
@@ -380,6 +501,12 @@ def main() -> int:
                          "window with zero spurious evictions, and a "
                          "subsequent --reshard scale-up still work "
                          "against the reborn scheduler")
+    ap.add_argument("--multi-tenant", action="store_true",
+                    help="two concurrent jobs (sync + async, "
+                         "job-namespaced keys) through chaos faults on "
+                         "one fleet: per-job bitwise correctness in sync "
+                         "mode, exact running-sum state + monotone "
+                         "version progress in async mode (docs/async.md)")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="watchdog: the soak must finish within this")
     args = ap.parse_args()
@@ -389,6 +516,15 @@ def main() -> int:
 
     def body() -> None:
         try:
+            if args.multi_tenant:
+                result.update(
+                    run_multi_tenant_soak(
+                        steps=args.steps, seed=args.seed,
+                        servers=args.servers, drop=args.drop,
+                        delay=args.delay,
+                    )
+                )
+                return
             result.update(
                 run_soak(
                     steps=args.steps, seed=args.seed, servers=args.servers,

@@ -1,0 +1,269 @@
+"""Per-tenant QoS A/B: two shaped jobs sharing one PS fleet.
+
+The multi-tenant contract (docs/async.md): a latency-sensitive job's
+p99 step time stays flat while a bulk job saturates the rest of the
+fleet — IF the operator declares QoS (``BYTEPS_JOB_PRIORITY`` weights
+the client/server queues, ``BYTEPS_JOB_QUOTA_MBPS`` meters admission).
+This bench measures exactly that claim on a rate-shaped loopback link:
+
+- **solo**:       the latency job alone on 2 servers — its baseline.
+- **noqos**:      latency job + a bulk job flooding many in-flight
+                  partitions, neither declaring QoS — the bulk backlog
+                  sits in front of the latency job's requests on the
+                  (single-threaded, shaped) server engine queue.
+- **qos**:        same contention, latency job at priority 100, bulk
+                  job metered by an admission quota — the server's WFQ
+                  lanes + token bucket protect the latency job.
+
+Each phase runs a fresh in-process fleet (scheduler + 2 Python-engine
+PSServers) with the two jobs as SUBPROCESS workers (their own
+``BYTEPS_JOB_ID`` env — real tenant isolation, not declare-kwarg
+emulation).  Output: ``QOS_BENCH_r01.json`` with per-job step-time
+p50/p99 per phase and the headline ratios.
+
+    python tools/qos_bench.py --out QOS_BENCH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: shaped link rate (MB/s) — slow enough that a bulk flood visibly
+#: queues, fast enough that the bench stays under a minute
+RATE_MBYTES_S = 8.0
+
+_WORKER_BODY = r"""
+import json, os, sys, time
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import byteps_tpu as bps
+
+role = os.environ["QOS_BENCH_ROLE"]
+steps = int(os.environ["QOS_BENCH_STEPS"])
+dim = int(os.environ["QOS_BENCH_DIM"])
+delay = float(os.environ.get("QOS_BENCH_WARM_DELAY_S", "0") or 0)
+bps.init()
+x = np.ones(dim, dtype=np.float32)
+times = []
+# one warm-up round covers init barriers + first-round allocation
+bps.push_pull(x, name=f"qos.{role}", average=False)
+if delay > 0:
+    # measurement must start INSIDE the contended window: the bulk
+    # neighbor's first multi-MB round takes ~1s on the shaped link, so
+    # a short latency phase starting right after the bring-up barrier
+    # could finish before the flood even arrives
+    time.sleep(delay)
+for s in range(steps):
+    t0 = time.monotonic()
+    bps.push_pull(x, name=f"qos.{role}", average=False)
+    times.append(time.monotonic() - t0)
+# per-tenant SLO surface (docs/async.md): how often the flight
+# recorder's slo_breach rule fired, and how many bundles the rate
+# limiter actually let through
+from byteps_tpu.core.flightrec import get_process_recorder
+from byteps_tpu.core.telemetry import counters
+labeled = counters().snapshot_labeled().get("flight_trigger", {})
+slo_fired = sum(
+    v for lkey, v in labeled.items()
+    if dict(lkey).get("rule") == "slo_breach"
+)
+rec = get_process_recorder()
+bundles = sum(
+    1 for p in (rec.bundles_written if rec is not None else ())
+    if "-slo_breach-" in p
+)
+print("QOS_RESULT " + json.dumps({
+    "role": role, "times": times,
+    "slo_breach_fired": slo_fired, "bundles": bundles,
+}))
+sys.stdout.flush()
+bps.shutdown()
+"""
+
+
+def _percentile(vals, q):
+    """Floor-interpolated percentile: at bench-sized n the p99 is the
+    second-worst sample, not the max — one OS scheduling blip must not
+    dominate a tail estimate built from tens of samples."""
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    i = min(len(vals) - 1, int(q * (len(vals) - 1)))
+    return vals[i]
+
+
+def run_phase(name: str, bulk: bool, qos: bool, steps: int = 40,
+              bulk_dim: int = 1 << 20, lat_dim: int = 1 << 14,
+              lat_priority: int = None, bulk_quota: float = None,
+              lat_slo_s: float = 0.0) -> dict:
+    """One fleet bring-up + measurement; returns per-job stats."""
+    env_base = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "BYTEPS_VAN": "tcp",
+        "BYTEPS_VAN_RATE_MBYTES_S": str(RATE_MBYTES_S),
+        # one engine thread per server: the shared service point where a
+        # bulk backlog can actually sit in front of the latency job
+        "BYTEPS_SERVER_ENGINE_THREAD": "1",
+        # many in-flight bulk partitions = a real backlog
+        "BYTEPS_PARTITION_BYTES": str(256 * 1024),
+        # a shaping buffer SMALLER than a bulk reply: every 256KB pull
+        # reply genuinely occupies the sender until the wire drains, so
+        # the inline-send head-of-line block (the thing QoS's reply
+        # writers remove) is deterministic, not a burst-timing lottery
+        "BYTEPS_VAN_SHAPE_BUF_KB": "64",
+        "BYTEPS_HEARTBEAT_INTERVAL": "1",
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+        "DMLC_NUM_WORKER": "2" if bulk else "1",
+        "DMLC_NUM_SERVER": "2",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+    }
+    env_base.pop("BYTEPS_JOB_ID", None)
+    os.environ.update({k: env_base[k] for k in (
+        "BYTEPS_VAN", "BYTEPS_VAN_RATE_MBYTES_S",
+        "BYTEPS_VAN_SHAPE_BUF_KB",
+        "BYTEPS_SERVER_ENGINE_THREAD", "BYTEPS_PARTITION_BYTES",
+        "DMLC_NUM_WORKER", "DMLC_NUM_SERVER", "DMLC_PS_ROOT_URI",
+    )})
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.server.server import PSServer
+
+    sched = Scheduler(num_workers=2 if bulk else 1, num_servers=2,
+                      host="127.0.0.1")
+    sched.start()
+    os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+    env_base["DMLC_PS_ROOT_PORT"] = str(sched.port)
+    fleet = [PSServer(Config.from_env()) for _ in range(2)]
+    for srv in fleet:
+        threading.Thread(target=srv.start, daemon=True).start()
+
+    def spawn(role: str, job: int, wsteps: int, dim: int,
+              priority: int, quota: float, slo: float = 0.0) -> subprocess.Popen:
+        env = dict(env_base)
+        env.update({
+            "BYTEPS_JOB_ID": str(job),
+            "BYTEPS_JOB_PRIORITY": str(priority),
+            "BYTEPS_JOB_QUOTA_MBPS": str(quota),
+            "BYTEPS_JOB_SLO_S": str(slo),
+            "QOS_BENCH_ROLE": role,
+            "QOS_BENCH_STEPS": str(wsteps),
+            "QOS_BENCH_DIM": str(dim),
+            # latency job only: start measuring once the bulk flood is
+            # established (every phase gets the same delay so the
+            # baselines stay comparable)
+            "QOS_BENCH_WARM_DELAY_S": "1.5" if role == "latency" else "0",
+        })
+        return subprocess.Popen(
+            [sys.executable, "-c", _WORKER_BODY], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd=REPO,
+        )
+
+    if lat_priority is None:
+        lat_priority = 100 if qos else 1
+    if bulk_quota is None:
+        bulk_quota = RATE_MBYTES_S / 2 if qos else 0.0
+    procs = {
+        "latency": spawn("latency", 1, steps, lat_dim,
+                         priority=lat_priority, quota=0.0,
+                         slo=lat_slo_s),
+    }
+    if bulk:
+        # the bulk job steps "forever" (generous count); it is
+        # terminated once the latency job finishes measuring
+        procs["bulk"] = spawn(
+            "bulk", 2, 10_000, bulk_dim,
+            priority=1, quota=bulk_quota,
+        )
+
+    results = {}
+    try:
+        out, _ = procs["latency"].communicate(timeout=600)
+        for line in out.splitlines():
+            if line.startswith("QOS_RESULT "):
+                results["latency"] = json.loads(line[len("QOS_RESULT "):])
+        if procs["latency"].returncode != 0:
+            raise RuntimeError(f"latency worker failed in phase {name}")
+    finally:
+        for key, p in procs.items():
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for srv in fleet:
+            srv.stop()
+        sched.stop()
+
+    if "latency" not in results:
+        raise RuntimeError(f"phase {name}: no latency result line")
+    times = results["latency"]["times"]
+    stats = {
+        "steps": len(times),
+        "p50_ms": round(_percentile(times, 0.50) * 1e3, 2),
+        "p90_ms": round(_percentile(times, 0.90) * 1e3, 2),
+        "p99_ms": round(_percentile(times, 0.99) * 1e3, 2),
+        "mean_ms": round(statistics.fmean(times) * 1e3, 2),
+        "slo_breach_fired": results["latency"].get("slo_breach_fired", 0),
+        "slo_bundles": results["latency"].get("bundles", 0),
+    }
+    print(f"  phase {name:8s}: latency-job p50={stats['p50_ms']}ms "
+          f"p99={stats['p99_ms']}ms over {stats['steps']} steps")
+    return stats
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--out", default="QOS_BENCH_r01.json")
+    args = ap.parse_args()
+
+    print(f"qos_bench: shaped link {RATE_MBYTES_S} MB/s, 2 servers, "
+          "1 engine thread")
+    solo = run_phase("solo", bulk=False, qos=False, steps=args.steps)
+    noqos = run_phase("noqos", bulk=True, qos=False, steps=args.steps)
+    qos = run_phase("qos", bulk=True, qos=True, steps=args.steps)
+
+    result = {
+        "config": {
+            "rate_mbytes_s": RATE_MBYTES_S,
+            "servers": 2,
+            "engine_threads": 1,
+            "latency_job": {"dim": 1 << 14, "priority_qos": 100},
+            "bulk_job": {"dim": 1 << 20,
+                         "quota_mbps_qos": RATE_MBYTES_S / 2},
+            "steps": args.steps,
+        },
+        "phases": {"solo": solo, "noqos": noqos, "qos": qos},
+        "headline": {
+            "p99_noqos_over_solo": round(
+                noqos["p99_ms"] / max(0.01, solo["p99_ms"]), 2
+            ),
+            "p99_qos_over_solo": round(
+                qos["p99_ms"] / max(0.01, solo["p99_ms"]), 2
+            ),
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result["headline"], indent=2))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
