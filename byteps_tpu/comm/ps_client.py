@@ -448,6 +448,12 @@ class PSClient:
     _sched_terminal = False
     _seen_map_epoch = 0
     _reconnect_token = 0
+    #: adaptive control plane (docs/autotune.md): the newest adopted
+    #: ``tuning`` section + its epoch; class-level defaults keep
+    #: __new__-built test stubs and pre-tuner pickles safe
+    tuning: Optional[dict] = None
+    _tuning_epoch = 0
+    _tuning_listeners: tuple = ()
 
     def __init__(self, cfg: Config, node_uid: Optional[str] = None) -> None:
         self.cfg = cfg
@@ -567,6 +573,13 @@ class PSClient:
         self._init_seq_lock = threading.Lock()
         self._init_seqs: Dict[int, int] = {}
         self._init_salt = _random.SystemRandom().getrandbits(16)
+        # --- adaptive control plane (docs/autotune.md) ---
+        # listeners (the engine) run on every NEWER tuning adoption;
+        # registration replays the current section so an engine built
+        # after connect() (the normal init order) still sees it
+        self.tuning = None
+        self._tuning_epoch = 0
+        self._tuning_listeners: list = []
 
     # --- rendezvous ------------------------------------------------------
 
@@ -692,6 +705,17 @@ class PSClient:
             counters().bump("sched_stale_book")
             return False
         if inc > self.sched_incarnation:
+            if self.sched_incarnation:
+                # scheduler REBIRTH: its tuner restarts at tuning epoch
+                # 0, so the monotone adoption fence must re-arm or every
+                # new decision (epochs 1..N-1) would be refused while
+                # the dead incarnation's tuning stayed live forever.
+                # -1 (not 0) so even the successor's initial epoch-0
+                # section adopts — its empty state reverts fleet
+                # decisions (engine restores launch values on absent
+                # fields; overridden keys migrate home via the fenced
+                # map epoch).
+                self._tuning_epoch = -1
             self.sched_incarnation = inc
         return True
 
@@ -713,6 +737,63 @@ class PSClient:
                            ("server", "server_evicted")):
             if ev.get(role):
                 counters().set_floor(name, int(ev[role]))
+        self._adopt_tuning(book)
+
+    def _adopt_tuning(self, book: dict) -> None:
+        """Adopt a book's ``tuning`` section (docs/autotune.md) when it
+        is NEWER than the one already applied — monotone by tuning
+        epoch, so a re-broadcast or a racing stale book can never roll
+        a fleet decision back.  Listeners (the engine's _apply_tuning)
+        run outside any routing lock; a listener error must never
+        poison book adoption."""
+        t = book.get("tuning")
+        if not isinstance(t, dict):
+            if self.tuning is not None:
+                # the control plane no longer runs a tuner (toggled off,
+                # or a reborn scheduler without BYTEPS_AUTOTUNE): revert
+                # to legacy — an empty section makes the engine restore
+                # its launch fusion threshold and re-enable fleet-
+                # disabled codecs.  Once, not per book.
+                self.tuning = None
+                self._tuning_epoch = 0
+                for cb in tuple(self._tuning_listeners):
+                    try:
+                        cb({})
+                    except Exception as e:  # noqa: BLE001
+                        from byteps_tpu.common import logging as bpslog
+
+                        bpslog.warning("tuning listener failed: %r", e)
+            return
+        try:
+            epoch = int(t.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        if self.tuning is not None and epoch <= self._tuning_epoch:
+            return
+        self._tuning_epoch = epoch
+        self.tuning = dict(t)
+        for cb in tuple(self._tuning_listeners):
+            try:
+                cb(self.tuning)
+            except Exception as e:  # noqa: BLE001
+                from byteps_tpu.common import logging as bpslog
+
+                bpslog.warning("tuning listener failed: %r", e)
+
+    def add_tuning_listener(self, cb) -> None:
+        """Register a fleet-tuning consumer; replays the current
+        section immediately (the initial book lands in connect(),
+        BEFORE the engine exists to listen)."""
+        if not isinstance(self._tuning_listeners, list):
+            self._tuning_listeners = []  # stub built via __new__
+        self._tuning_listeners.append(cb)
+        if self.tuning is not None:
+            try:
+                cb(self.tuning)
+            except Exception as e:  # noqa: BLE001
+                from byteps_tpu.common import logging as bpslog
+
+                bpslog.warning("tuning listener failed: %r", e)
 
     def _book_num_workers(self, book: dict) -> int:
         """The worker count THIS client aggregates over.  Multi-tenant
@@ -746,7 +827,11 @@ class PSClient:
         from byteps_tpu.common.hashing import OwnershipMap
 
         return OwnershipMap(
-            ranks, epoch=int(epoch), vnodes=self.cfg.ring_vnodes
+            ranks, epoch=int(epoch), vnodes=self.cfg.ring_vnodes,
+            # autotuner hot-key rebalance (docs/autotune.md): per-key
+            # placement overrides ride beside the map epoch as one
+            # versioned placement
+            overrides=book.get("ring_overrides"),
         )
 
     def _install_routing(self, servers, ranks, omap) -> None:
@@ -877,10 +962,18 @@ class PSClient:
             from byteps_tpu.core.flightrec import get_process_recorder
 
             rec = get_process_recorder()
+            ups = None
             if rec is not None and rec.enabled:
                 tail = rec.ledger_tail()
                 if tail:
                     delta["fr"] = tail
+                # fleet-central bundle upload (BYTEPS_FLIGHT_UPLOAD):
+                # compact trigger bundles ride the beat to the
+                # scheduler's BYTEPS_FLIGHT_DIR.  Taken (not re-shipped
+                # like the tail) — a failed beat gives them back below.
+                ups = rec.take_uploads()
+                if ups:
+                    delta["fb"] = ups
             try:
                 payload = json.dumps(delta).encode() if delta else b""
                 # bounded wait: a chaos-dropped PING on a healthy link
@@ -899,6 +992,8 @@ class PSClient:
                 # deliberate over-count bias — losing increments would
                 # silently understate degradation, which is worse.
                 metrics().requeue_delta(delta)
+                if ups and rec is not None:
+                    rec.requeue_uploads(ups)
                 continue
 
     def _sched_recv_loop(self) -> None:

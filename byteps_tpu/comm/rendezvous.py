@@ -238,6 +238,25 @@ class Scheduler:
 
         self.flight = ClusterFlight()
         self.flight.attach(self.metrics_agg)
+        # adaptive control plane (docs/autotune.md): with BYTEPS_AUTOTUNE
+        # the scheduler hosts a closed-loop policy engine that consumes
+        # the cluster aggregate + flight matrix + server hot-key reports
+        # each sweep and ships fleet decisions as a versioned ``tuning``
+        # section (plus ``ring_overrides``) in every book.  Off (the
+        # default): self.tuner is None and books stay byte-for-byte the
+        # legacy shape.
+        from byteps_tpu.core.autotune import tuner_enabled
+
+        self.tuner = None
+        if tuner_enabled():
+            from byteps_tpu.core.autotune import AutoTuner
+
+            self.tuner = AutoTuner(
+                registry=self.metrics_agg, reshard=self.reshard
+            )
+            self.metrics_agg.gauge_fn(
+                "cluster_tuning_epoch", lambda: self.tuner.state.epoch
+            )
         self._metrics_http = None
         # scheduler-link fault injection (BYTEPS_CHAOS_SCHED under a
         # chaos van): accepted control connections get the same
@@ -272,6 +291,12 @@ class Scheduler:
             )
             m.start()
             self._threads.append(m)
+        if self.tuner is not None:
+            a = threading.Thread(
+                target=self._tuner_loop, name="sched-autotune", daemon=True
+            )
+            a.start()
+            self._threads.append(a)
         port = int(os.environ.get("BYTEPS_METRICS_PORT", "0") or 0)
         if port > 0:
             from byteps_tpu.core.telemetry import serve_metrics
@@ -279,6 +304,115 @@ class Scheduler:
             self._metrics_http = serve_metrics(
                 port, self.metrics_agg.render_prometheus
             )
+
+    # --- adaptive control plane (docs/autotune.md) -----------------------
+
+    def _tuner_loop(self) -> None:
+        while not self._stop.wait(self.tuner.cfg.interval_s):
+            try:
+                self._tuner_sweep_once()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                from byteps_tpu.common import logging as bpslog
+
+                bpslog.warning("autotune sweep error: %r", e)
+
+    def _tuner_view(self) -> dict:
+        """Assemble one sweep's input view: per-server load + hottest
+        keys (heartbeat hot reports), latest per-worker step seconds
+        (cluster flight matrix), fusion counter totals + the fleet
+        fusion-threshold gauge, and the per-codec
+        ``compression_auto_off`` vote counts — all from surfaces the
+        telemetry plane already maintains."""
+        loads, hot_keys, owned = self.tuner.drain_hot()
+        steps: Dict[int, float] = {}
+        for who, recs in self.flight.matrix().items():
+            if not who.startswith("worker"):
+                continue
+            for r in reversed(recs):
+                if r.get("k") == "step" and r.get("dur"):
+                    steps[who] = float(r["dur"])
+                    break
+        flat = self.metrics_agg.counters.snapshot()
+        labeled = self.metrics_agg.counters.snapshot_labeled()
+        votes: Dict[str, set] = {}
+        for lkey, v in (labeled.get("compression_auto_off") or {}).items():
+            ld = dict(lkey)
+            codec = ld.get("codec")
+            if v > 0 and codec and ld.get("role", "worker") == "worker":
+                votes.setdefault(codec, set()).add(ld.get("rank", "?"))
+        # the fleet fusion threshold the workers actually run (gauge
+        # per {role, rank}; max is the fleet value — launch configs
+        # agree in practice, and the tuner's own state wins once set).
+        # Copied under the registry lock: heartbeat merges resize the
+        # dict concurrently.
+        with self.metrics_agg._lock:
+            gauges = dict(self.metrics_agg._gauges)
+        thr = 0.0
+        for (name, _lk), v in gauges.items():
+            if name == "fusion_threshold_bytes":
+                thr = max(thr, float(v))
+        with self._lock:
+            ranks = [n.rank for n in self._nodes["server"]]
+            nw = len(self._nodes["worker"])
+        return {
+            "server_ranks": ranks,
+            "num_workers": nw,
+            "steps": steps,
+            "server_load": loads,
+            "hot_keys": hot_keys,
+            "owned": owned,
+            "fusion": {
+                "threshold": thr,
+                "wire_rpc": flat.get("wire_rpc", 0),
+                "fused_frames": flat.get("fused_frames", 0),
+                "fused_keys": flat.get("fused_keys", 0),
+            },
+            "codec_votes": {c: len(rs) for c, rs in votes.items()},
+        }
+
+    def _tuner_sweep_once(self) -> None:
+        res = self.tuner.sweep(self._tuner_view())
+        if not res["changed"]:
+            return
+        with self._lock:
+            if res["map_changed"]:
+                # key placement changed (rebalance or its rollback): the
+                # ownership epoch moves WITH the override set so servers
+                # start a migration wave and stale clients chase — the
+                # exact PR 8 plane, tuner-initiated
+                self.map_epoch += 1
+            if not self._addrbook_sent:
+                return  # bring-up: the first books carry the state
+            for r in ("worker", "server"):
+                for node in self._nodes[r]:
+                    self._send_addrbook_to(
+                        node.conn, node.send_lock, r, node.rank, RESIZE_SEQ
+                    )
+
+    def _store_uploaded_bundles(self, ident, bundles) -> None:
+        """Fleet-central flight bundles (docs/observability.md "Flight
+        recorder & doctor"): nodes with ``BYTEPS_FLIGHT_UPLOAD`` attach
+        compact trigger bundles to their heartbeat; they land under the
+        scheduler's ``BYTEPS_FLIGHT_DIR`` beside the tuner's decision
+        bundles, so an incident's evidence and the control loop's
+        reaction sit in one place."""
+        base = os.environ.get("BYTEPS_FLIGHT_DIR") or "./flight_bundles"
+        who = f"{ident[0]}{ident[1]}" if ident else "unknown"
+        for b in bundles or ():
+            if not isinstance(b, dict):
+                continue
+            try:
+                path = os.path.join(
+                    base,
+                    f"{time.strftime('%Y%m%d-%H%M%S')}-{who}"
+                    f"-step{b.get('step', 0)}-{b.get('rule', 'trigger')}",
+                )
+                os.makedirs(path, exist_ok=True)
+                with open(os.path.join(path, "trigger.json"), "w") as f:
+                    json.dump(b, f, indent=2, default=str)
+            except OSError:
+                continue
+            self.metrics_agg.counters.bump("flight_bundle_rx")
 
     # --- liveness policy (BYTEPS_DEAD_NODE_TIMEOUT_S) --------------------
 
@@ -519,6 +653,21 @@ class Scheduler:
                 from byteps_tpu.common import logging as bpslog
 
                 bpslog.warning("flight tail merge failed: %r", e)
+        # server hot-key report → the autotuner's rebalance input
+        # (docs/autotune.md); dropped when the tuner is off (a stale
+        # server may keep shipping for a beat after a toggle)
+        hot = delta.pop("hot", None)
+        if hot and ident and ident[0] == "server" and self.tuner is not None:
+            self.tuner.note_hot(ident[1], hot)
+        # uploaded flight bundles → fleet-central storage
+        fb = delta.pop("fb", None)
+        if fb and ident:
+            try:
+                self._store_uploaded_bundles(ident, fb)
+            except Exception as e:  # noqa: BLE001
+                from byteps_tpu.common import logging as bpslog
+
+                bpslog.warning("flight bundle store failed: %r", e)
         try:
             self.metrics_agg.merge_delta(delta, labels=labels)
         except Exception as e:  # noqa: BLE001
@@ -950,6 +1099,12 @@ class Scheduler:
             # per job and weight/meter service accordingly.
             "jobs": self._jobs_map_locked(),
         }
+        if self.tuner is not None:
+            # adaptive control plane (docs/autotune.md): the versioned
+            # ``tuning`` section + any live ``ring_overrides``, filtered
+            # to this book's own rank list.  With the tuner off the book
+            # is byte-for-byte the legacy shape.
+            book.update(self.tuner.book_extras(book["server_ranks"]))
         if drain:
             book["drain"] = True
         try:
@@ -975,8 +1130,18 @@ class Scheduler:
             j["workers"].append(n.rank)
             j["priority"] = max(j["priority"], n.job_priority)
             j["quota_mbps"] = max(j["quota_mbps"], n.job_quota_mbps)
+        ns = max(1, len(self._nodes["server"]))
         for j in jobs.values():
             j["workers"].sort()
+            if j["quota_mbps"] > 0:
+                # fleet-coordinated admission (docs/async.md): the
+                # declared BYTEPS_JOB_QUOTA_MBPS is the job's FLEET-wide
+                # budget — each server enforces an equal share, so the
+                # aggregate cap equals the declaration instead of
+                # quota × servers.  Re-divided automatically: this map
+                # is rebuilt into every book a server-set change ships.
+                j["quota_mbps_total"] = j["quota_mbps"]
+                j["quota_mbps"] = j["quota_mbps"] / ns
         return jobs
 
     def _group_size(self, group: int) -> int:

@@ -263,6 +263,10 @@ class Config:
     # per-rule bundle rate limit: one dump per rule per this many seconds
     # (triggers past the limit still count in flight_trigger{rule})
     flight_bundle_s: float = 60.0  # BYTEPS_FLIGHT_BUNDLE_S
+    # upload dumped trigger bundles (compact form) over the control
+    # plane into the SCHEDULER's BYTEPS_FLIGHT_DIR — fleet-central
+    # incident evidence beside the autotuner's decision bundles
+    flight_upload: bool = False  # BYTEPS_FLIGHT_UPLOAD
 
     # --- debug / trace / observability (global.cc:113-124; docs/observability.md) ---
     log_level: str = "WARNING"
@@ -411,6 +415,7 @@ class Config:
                 os.environ.get("BYTEPS_FLIGHT_STALL_S", "5") or "5"
             )),
             flight_dir=_env_str("BYTEPS_FLIGHT_DIR", ""),
+            flight_upload=_env_bool("BYTEPS_FLIGHT_UPLOAD"),
             flight_bundle_s=max(0.0, float(
                 os.environ.get("BYTEPS_FLIGHT_BUNDLE_S", "60") or "60"
             )),

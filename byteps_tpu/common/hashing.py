@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _MASK64 = 0xFFFFFFFFFFFFFFFF
 
@@ -193,23 +193,39 @@ class OwnershipMap:
     (epoch, ranks) in address books; workers route by it, servers ship
     each re-homed key's state to its new owner and answer stale-map
     requests with ``Op.WRONG_OWNER`` carrying the epoch.  Ownership is
-    always the consistent-hash ring (minimal movement); the legacy
-    modulo hash fns remain the non-elastic default routing.
+    the consistent-hash ring (minimal movement) **overlaid with an
+    optional per-key override table** — the autotuner's weighted ring
+    override (docs/autotune.md "hot_key_rebalance"): the scheduler
+    ships ``ring_overrides`` beside the map epoch, and an overridden
+    key is owned by its override rank instead of its ring arc.  The
+    epoch covers ring AND overrides as one versioned placement, so a
+    rebalance (or its rollback) rides the exact same adopt → migrate →
+    redirect plane a server-set change does.  The legacy modulo hash
+    fns remain the non-elastic default routing.
     """
 
-    __slots__ = ("epoch", "ring")
+    __slots__ = ("epoch", "ring", "overrides")
 
     def __init__(self, ranks: Sequence[int], epoch: int = 0,
-                 vnodes: int = 64) -> None:
+                 vnodes: int = 64,
+                 overrides: Optional[Dict[int, int]] = None) -> None:
         self.epoch = int(epoch)
         self.ring = HashRing(ranks, vnodes=vnodes)
+        rankset = set(self.ring.ranks)
+        # overrides naming a rank outside this map's list are dropped —
+        # a book can never route a key at a server it doesn't carry
+        self.overrides: Dict[int, int] = {
+            int(k): int(r) for k, r in (overrides or {}).items()
+            if int(r) in rankset
+        }
 
     @property
     def ranks(self) -> Tuple[int, ...]:
         return self.ring.ranks
 
     def owner(self, key: int) -> int:
-        return self.ring.owner(key)
+        ov = self.overrides.get(int(key))
+        return ov if ov is not None else self.ring.owner(key)
 
 
 #: rings for fn="ring" routing, keyed by (num_servers, vnodes) — ring

@@ -412,6 +412,28 @@ class PipelineEngine:
         self._auto_stats: Dict[int, list] = {}
         self._compression_lr: float = 1.0
         self._lr_sent_to_servers: float = 1.0
+        # --- fleet tuning adoption (docs/autotune.md) ---
+        # the scheduler's autotuner ships a versioned ``tuning`` section
+        # in every book; the PS client replays it here.  Fleet codec
+        # disables are tracked per codec name → the keys THIS engine
+        # disabled for it, so a rollback re-enables exactly those.
+        self._fuse_enabled = cfg.fusion_threshold > 0
+        # the launch value: a tuning section WITHOUT a fusion_threshold
+        # field means "untouched/legacy" — adoption restores this, so a
+        # reborn scheduler's empty tuning state (or a rollback to the
+        # pre-tuner value) actually lands fleet-wide
+        self._launch_fusion_threshold = cfg.fusion_threshold
+        self._codec_names: Dict[int, str] = {}
+        self._fleet_codec_off: Dict[str, set] = {}
+        self._tuning_lock = threading.Lock()
+        # the fleet fusion-threshold gauge feeds the tuner's walk (the
+        # scheduler reads the aggregate's max as the fleet value)
+        from byteps_tpu.core.telemetry import metrics as _metrics
+
+        _metrics().gauge_set("fusion_threshold_bytes", cfg.fusion_threshold)
+        add_listener = getattr(ps_client, "add_tuning_listener", None)
+        if add_listener is not None:
+            add_listener(self._apply_tuning)
         # tensor names whose last job failed degraded: their next submit
         # re-runs the init-push barrier, which resets the key's round
         # numbering on the (possibly healed) owners — without this the
@@ -788,12 +810,26 @@ class PipelineEngine:
             return
         if nbytes < self.cfg.min_compress_bytes:
             return
+        ctype = str(
+            ctx.kwargs.get("byteps_compressor_type")
+            or ctx.kwargs.get("compressor") or "?"
+        )
         for part in ctx.partitions:
             codec = create_compressor(ctx.kwargs, part.length, server=False)
             if codec is None:
                 return
             self._ensure_compress_threads()
             self._compressors[part.key] = codec
+            # codec identity for the fleet consensus plane
+            # (docs/autotune.md): the per-key local verdicts are labeled
+            # with it, and a fleet codec_off decision matches keys by it
+            self._codec_names[part.key] = ctype
+            with self._tuning_lock:
+                if ctype in self._fleet_codec_off:
+                    # registered AFTER the fleet flipped this codec off:
+                    # join the decision immediately
+                    self._fleet_codec_off[ctype].add(part.key)
+                    self._compression_auto_off.add(part.key)
             # a chain created after set_compression_lr must still honor it
             self._apply_lr_to_chain(codec, self._compression_lr)
             # BYTEPS_COMPRESSION_AUTO, static fast path: every shipped
@@ -1296,6 +1332,64 @@ class PipelineEngine:
         self._note_compression(task.key, raw_nbytes, len(task.compressed))
         self._proceed(task)
 
+    def _apply_tuning(self, t: dict) -> None:
+        """Adopt one fleet ``tuning`` section (docs/autotune.md) —
+        invoked by the PS client on every newer-epoch book (and once at
+        registration with the current section).  The fusion threshold
+        is a single int store each submit() reads fresh, so adoption is
+        atomic per round; codec flips move keys in/out of the
+        auto-off set under the tuning lock."""
+        from byteps_tpu.common import logging as bpslog
+        from byteps_tpu.core.telemetry import counters, metrics
+
+        ft = t.get("fusion_threshold")
+        if ft is None:
+            # field absent = "untouched": restore the launch value (a
+            # reborn scheduler's fresh tuning state, or a tuner that
+            # reverted to pre-tuner placement, must actually land)
+            ft = self._launch_fusion_threshold
+        if self._fuse_enabled:
+            # never turns fusion ON from 0: the FUSE stage only exists
+            # when the launch config enabled it (start() spawns no
+            # poller otherwise) — the tuner's policy holds the same line
+            try:
+                ft = int(ft)
+            except (TypeError, ValueError):
+                ft = 0
+            if ft > 0 and ft != self.cfg.fusion_threshold:
+                bpslog.warning(
+                    "autotune: fleet fusion threshold %d -> %d bytes",
+                    self.cfg.fusion_threshold, ft,
+                )
+                self.cfg.fusion_threshold = ft
+                metrics().gauge_set("fusion_threshold_bytes", ft)
+        off = {str(n) for n in (t.get("codec_off") or ())}
+        with self._tuning_lock:
+            for name in sorted(off - set(self._fleet_codec_off)):
+                keys = {
+                    k for k, n in self._codec_names.items()
+                    if n == name and k not in self._compression_auto_off
+                }
+                self._fleet_codec_off[name] = keys
+                self._compression_auto_off.update(keys)
+                if keys:
+                    counters().bump(
+                        "tune_codec_off", len(keys), labels={"codec": name}
+                    )
+                bpslog.warning(
+                    "autotune: fleet codec consensus disabled %r "
+                    "(%d local keys flip to raw)", name, len(keys),
+                )
+            for name in sorted(set(self._fleet_codec_off) - off):
+                # rollback: re-enable exactly the keys the FLEET
+                # decision disabled — locally-verdicted keys stay off
+                keys = self._fleet_codec_off.pop(name)
+                self._compression_auto_off.difference_update(keys)
+                bpslog.warning(
+                    "autotune: fleet codec decision on %r rolled back "
+                    "(%d keys compress again)", name, len(keys),
+                )
+
     def _auto_static_verdict(self, key: int, codec) -> None:
         """Registration-time verdict of the adaptive-compression policy
         for a size-deterministic codec: the exact wire ratio is
@@ -1311,7 +1405,13 @@ class PipelineEngine:
         if ratio < self.cfg.compression_auto_ratio:
             return
         self._compression_auto_off.add(key)
-        counters().bump("compression_auto_off")
+        # codec-labeled so the scheduler's codec_consensus policy can
+        # count verdicts per codec per worker (docs/autotune.md); the
+        # flat family keeps the pre-tuner totals
+        counters().bump(
+            "compression_auto_off",
+            labels={"codec": self._codec_names.get(key, "?")},
+        )
         from byteps_tpu.common import logging as bpslog
 
         bpslog.warning(
@@ -1368,7 +1468,10 @@ class PipelineEngine:
         # codecs' wire sizes are size-deterministic, so the observed
         # ratio cannot drift across the cutoff later
         self._compression_auto_off.add(key)
-        counters().bump("compression_auto_off")
+        counters().bump(
+            "compression_auto_off",
+            labels={"codec": self._codec_names.get(key, "?")},
+        )
         bpslog.warning(
             "compression auto-disabled for key %d: observed wire "
             "ratio %.3f >= %.3f over %d rounds (BYTEPS_COMPRESSION_"

@@ -139,6 +139,17 @@ class FlightRecorder:
             float(_fb) if _fb is not None
             else _env_float("BYTEPS_FLIGHT_BUNDLE_S", 60.0)
         )
+        #: fleet-central upload (BYTEPS_FLIGHT_UPLOAD, docs/
+        #: observability.md): dumped trigger bundles additionally queue
+        #: a COMPACT form (rule + evidence + firing record) that the
+        #: heartbeat loop ships to the scheduler's BYTEPS_FLIGHT_DIR —
+        #: tuner decisions and their trigger evidence land in one place
+        self.upload = bool(
+            getattr(cfg, "flight_upload", False)
+            or os.environ.get("BYTEPS_FLIGHT_UPLOAD", "").lower()
+            not in ("", "0", "false", "no", "off")
+        )
+        self._uploads: List[dict] = []
         #: per-job step-time SLO (docs/async.md): a completed step
         #: slower than this fires slo_breach (0 = rule off)
         self.slo_s = (
@@ -323,6 +334,18 @@ class FlightRecorder:
             out.append(c)
         return out
 
+    def take_uploads(self) -> List[dict]:
+        """Drain the pending compact-bundle uploads (the heartbeat loop
+        attaches them to the next beat as the ``fb`` field); a failed
+        beat gives them back via :meth:`requeue_uploads`."""
+        with self._lock:
+            ups, self._uploads = self._uploads, []
+            return ups
+
+    def requeue_uploads(self, ups: List[dict]) -> None:
+        with self._lock:
+            self._uploads = (list(ups) + self._uploads)[-8:]
+
     # --- trigger engine --------------------------------------------------
 
     def _evaluate(self, rec: dict) -> None:
@@ -350,6 +373,18 @@ class FlightRecorder:
             bpslog.warning("flight bundle dump failed: %r", e)
             return
         self._counters.bump("flight_bundle")
+        if self.upload:
+            with self._lock:
+                self._uploads.append({
+                    "rule": rule,
+                    "step": rec.get("step", 0),
+                    "t": rec.get("t"),
+                    "evidence": evidence,
+                    "record": {k: rec.get(k) for k in _COMPACT_KEYS},
+                    "bundle": os.path.basename(path),
+                })
+                # bounded: a heartbeat outage must not grow this forever
+                del self._uploads[:-8]
         from byteps_tpu.common import logging as bpslog
 
         bpslog.warning(

@@ -88,6 +88,7 @@ class _KeyState:
         "job",
         "async_mode",
         "staleness",
+        "req_bytes",
         "lock",
     )
 
@@ -151,6 +152,11 @@ class _KeyState:
         self.job = 0
         self.async_mode = False
         self.staleness = -1
+        # cumulative data-plane request bytes (docs/autotune.md): fed by
+        # _enqueue on the serve threads, read per heartbeat by the
+        # hot-key report.  Bare += across threads may lose an increment
+        # under contention — load *statistics*, not an exact ledger.
+        self.req_bytes = 0
         self.lock = threading.Lock()
 
     def wire_payload(self, compressed: bool, async_mode: bool = False) -> bytes:
@@ -730,6 +736,63 @@ class PSServer:
         me = book.get("map_epoch")
         if me is not None and int(me) > getattr(self, "_map_epoch", 0):
             self._map_epoch = int(me)
+        self._adopt_tuning(book)
+
+    def _adopt_tuning(self, book: dict) -> None:
+        """Note a book's ``tuning`` section (docs/autotune.md).  The
+        server's only fleet-tuned knobs today are placement overrides
+        (which ride the ownership fields, adopted in _adopt_book); what
+        this arms is the heartbeat **hot-key report** — the rebalance
+        policy's input.  Tracks the book, both directions: a book
+        WITHOUT the section (tuner toggled off, or a reborn scheduler
+        without BYTEPS_AUTOTUNE) disarms, so beats return to the
+        byte-identical legacy wire instead of shipping reports nobody
+        consumes.  (Re-)arming re-baselines the per-key counters so the
+        first report carries only traffic observed under the armed
+        tuner, not the accumulated gap."""
+        on = isinstance(book.get("tuning"), dict)
+        if on and not getattr(self, "_tuning_on", False) and hasattr(
+            self, "_keys_lock"
+        ):
+            with self._keys_lock:
+                self._hot_last = {
+                    k: ks.req_bytes for k, ks in self._keys.items()
+                }
+        self._tuning_on = on
+
+    def _hot_report(self):
+        """Per-beat hot-key report for the scheduler's autotuner: the
+        per-key request-byte DELTAS since the last beat (top 8 + the
+        total) and the owned-key count.  Called from the control-plane
+        thread only.  Includes redirected traffic on tombstoned keys —
+        stale-map chatter IS load this server served."""
+        if not getattr(self, "_tuning_on", False):
+            return None
+        last = getattr(self, "_hot_last", None)
+        if last is None:
+            last = {}
+        with self._keys_lock:
+            cur = {k: ks.req_bytes for k, ks in self._keys.items()}
+            owned = sum(
+                1 for ks in self._keys.values()
+                if ks.store is not None and ks.migrated_to is None
+            )
+        self._hot_last = cur
+        if not cur:
+            return None
+        deltas = {}
+        total = 0
+        for k, v in cur.items():
+            d = v - last.get(k, 0)
+            if d > 0:
+                deltas[k] = d
+                total += d
+        top = sorted(deltas.items(), key=lambda kv: -kv[1])[:8]
+        return {
+            "total": int(total),
+            "keys": [[int(k), int(v)] for k, v in top],
+            "owned": int(owned),
+        }
 
     def _handle_control(self, conn, msg) -> None:
         from byteps_tpu.comm.rendezvous import RESIZE_SEQ
@@ -776,6 +839,7 @@ class PSServer:
         while not self._stop.is_set():
             next_beat = time.monotonic() + hb if hb > 0 else None
             delta: dict = {}
+            pend_ups = None
             try:
                 while not self._stop.is_set():
                     now = time.monotonic()
@@ -810,6 +874,25 @@ class PSServer:
                             tail = rec.ledger_tail()
                             if tail:
                                 delta["fr"] = tail
+                            hb_ups = rec.take_uploads()
+                            if hb_ups:
+                                # fleet-central bundle upload
+                                # (BYTEPS_FLIGHT_UPLOAD); failed beats
+                                # give these back in the except below
+                                delta["fb"] = hb_ups
+                                pend_ups = hb_ups
+                        # hot-key report (docs/autotune.md): armed only
+                        # after a book carried a tuning section — legacy
+                        # beats stay byte-identical.  getattr: this loop
+                        # is borrowed by NativePSServer, which has no
+                        # key table and ships no report (the native
+                        # engine cannot migrate state, so the rebalance
+                        # policy never considers it).
+                        hot_fn = getattr(self, "_hot_report", None)
+                        if hot_fn is not None:
+                            hot = hot_fn()
+                            if hot:
+                                delta["hot"] = hot
                         send_message(
                             conn,
                             Message(
@@ -819,6 +902,7 @@ class PSServer:
                             ),
                         )
                         delta = {}  # delivered (send_all returned)
+                        pend_ups = None
                         next_beat = now + hb
                     readable, _, _ = _select.select([conn], [], [], 0.3)
                     if readable:
@@ -827,6 +911,14 @@ class PSServer:
                 # a delta consumed but not delivered rides the next
                 # successful beat instead of vanishing
                 metrics().requeue_delta(delta)
+                if pend_ups:
+                    from byteps_tpu.core.flightrec import (
+                        get_process_recorder,
+                    )
+
+                    fr = get_process_recorder()
+                    if fr is not None:
+                        fr.requeue_uploads(pend_ups)
                 if self._stop.is_set() or getattr(self, "_sched_shutdown", False):
                     return
                 conn = self._sched_reconnect()
@@ -1063,7 +1155,11 @@ class PSServer:
             if cur is not None and int(epoch) <= cur.epoch and not drain:
                 return  # stale or repeated book
             new_map = OwnershipMap(
-                ranks, epoch=int(epoch), vnodes=self.cfg.ring_vnodes
+                ranks, epoch=int(epoch), vnodes=self.cfg.ring_vnodes,
+                # autotuner rebalance (docs/autotune.md): per-key
+                # placement overrides are part of the versioned map —
+                # the wave below ships any key the override re-homes
+                overrides=book.get("ring_overrides"),
             )
             self._prev_ownership = cur
             self._ownership = new_map
@@ -1674,6 +1770,7 @@ class PSServer:
     def _enqueue(self, msg: Message, conn, send_lock,
                  metered: bool = False) -> None:
         ks = self._key_state(msg.key)
+        ks.req_bytes += len(msg.payload)  # hot-key load surface
         job = ks.job
         if job and not metered:
             # per-tenant accounting + admission (docs/async.md): the
@@ -2802,6 +2899,20 @@ class NativePSServer:
                 self.rank,
             )
             return
+        if book.get("ring_overrides") and not getattr(
+            self, "_warned_overrides", False
+        ):
+            # the C++ ownership check is ring-only; it cannot ship or
+            # receive key state either, so the tuner's rebalance policy
+            # never sources or targets native ranks (they send no hot
+            # reports) — this fires only in unsupported mixed fleets
+            self._warned_overrides = True
+            bpslog.warning(
+                "native server rank=%s: book carries ring_overrides "
+                "(autotune rebalance) which the C++ engine cannot honor "
+                "— run Python-engine servers with BYTEPS_AUTOTUNE "
+                "rebalance (docs/autotune.md)", self.rank,
+            )
         if not hasattr(self._lib, "bps_native_server_set_ownership"):
             bpslog.warning(
                 "native lib predates the resharding plane; ownership "
@@ -2835,6 +2946,11 @@ class NativePSServer:
     _handle_control = PSServer._handle_control
     _fence_book = PSServer._fence_book
     _note_book = PSServer._note_book
+    # tuning-section awareness only (docs/autotune.md): the flag is
+    # harmless here — with no _hot_report the borrowed control loop
+    # never ships a hot report, keeping native ranks out of the
+    # rebalance policy's candidate set
+    _adopt_tuning = PSServer._adopt_tuning
     # multi-tenant book map (docs/async.md): adopted for observability
     # only — the C++ data plane REJECTS job-namespaced frames (clean
     # status=1 echo), so the weights/quotas never engage natively

@@ -1,0 +1,744 @@
+"""Adaptive control plane (docs/autotune.md): the scheduler-hosted
+closed-loop autotuner.
+
+Layers under test:
+
+- deterministic policy units on synthetic views: hot-key rebalance
+  (streak/factor/budget/target selection), fusion-threshold walk
+  (hysteresis band, bounds, never-on-from-0), codec consensus (quorum),
+  and the canary engine (rollback on regression, pass without, no
+  baseline → no rollback, cooldown escalation);
+- the book surface: ``BYTEPS_AUTOTUNE=0`` keeps books byte-for-byte the
+  legacy shape; armed tuners add the versioned ``tuning`` section and
+  rank-filtered ``ring_overrides``;
+- ownership overrides: ``OwnershipMap`` routes overridden keys to their
+  override rank, drops overrides naming absent ranks;
+- fleet-coordinated job quotas: the scheduler divides each job's
+  declared ``BYTEPS_JOB_QUOTA_MBPS`` across the live servers;
+- node-side adoption: PS client tuning-epoch monotonicity + listener
+  replay, engine fusion/codec application, server hot-report arming;
+- fleet-central flight-bundle upload (``BYTEPS_FLIGHT_UPLOAD``);
+- the ``tools/check_tune_rules.py`` rot guard (tier-1 binding);
+- end-to-end: a skewed load on a live fleet triggers a tuner-initiated
+  rebalance that migrates hot keys through the PR 8 plane — bitwise
+  pulls through the move, exactly-once sums, NO re-init barrier.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.hashing import HashRing, OwnershipMap
+from byteps_tpu.common.types import DataType
+from byteps_tpu.core.autotune import AutoTuner, TunerConfig, TuningState
+from byteps_tpu.core.telemetry import counters
+
+F32 = int(DataType.FLOAT32)
+
+
+def mk_tuner(clock, reshard=True, **kw):
+    defaults = dict(
+        interval_s=0.1, factor=2.0, sweeps=2, cooldown_s=10.0,
+        canary_sweeps=2, regress=1.3, budget=1, max_moves=2,
+        quorum=0.5, bundle_dir="",
+    )
+    defaults.update(kw)
+    return AutoTuner(
+        cfg=TunerConfig(**defaults), reshard=reshard,
+        now_fn=lambda: clock[0],
+    )
+
+
+def hot_view(load0=1000.0, load1=100.0, steps=None):
+    return {
+        "server_ranks": [0, 1],
+        "num_workers": 2,
+        "steps": dict(
+            steps if steps is not None else {"w0": 0.1, "w1": 0.1}
+        ),
+        "server_load": {0: load0, 1: load1},
+        "hot_keys": {0: [(65536, load0 * 0.7), (131072, load0 * 0.2)]},
+        "fusion": {},
+        "codec_votes": {},
+    }
+
+
+class TestHotKeyRebalance:
+    def test_fires_after_streak_and_moves_to_least_loaded(self):
+        t = mk_tuner([0.0])
+        assert not t.sweep(hot_view())["actions"]  # streak 1 < 2
+        res = t.sweep(hot_view())
+        assert [a["rule"] for a in res["actions"]] == ["hot_key_rebalance"]
+        assert res["map_changed"] and res["changed"]
+        assert t.state.overrides == {65536: 1, 131072: 1}
+        assert res["actions"][0]["evidence"]["target"] == 1
+
+    def test_no_action_below_factor(self):
+        t = mk_tuner([0.0])
+        for _ in range(5):
+            assert not t.sweep(hot_view(load0=150.0))["actions"]
+
+    def test_calm_sweep_resets_streak(self):
+        t = mk_tuner([0.0], sweeps=2)
+        t.sweep(hot_view())
+        t.sweep(hot_view(load0=100.0))  # calm: streak resets
+        assert not t.sweep(hot_view())["actions"]  # streak back to 1
+
+    def test_reshard_off_never_moves_keys(self):
+        t = mk_tuner([0.0], reshard=False)
+        for _ in range(5):
+            assert not t.sweep(hot_view())["actions"]
+
+    def test_cooldown_blocks_second_action(self):
+        clock = [0.0]
+        t = mk_tuner(clock, cooldown_s=10.0, canary_sweeps=100)
+        t.sweep(hot_view())
+        assert t.sweep(hot_view())["actions"]
+        v = hot_view()
+        v["hot_keys"] = {0: [(999 << 16, 500.0)]}
+        for _ in range(4):
+            assert not t.sweep(v)["actions"]  # cooling
+        clock[0] = 11.0
+        t.sweep(v)
+        assert t.sweep(v)["actions"]  # streak rebuilt + cooldown passed
+
+    def test_max_moves_caps_keys(self):
+        t = mk_tuner([0.0], max_moves=1)
+        t.sweep(hot_view())
+        t.sweep(hot_view())
+        assert len(t.state.overrides) == 1  # hottest key only
+        assert t.state.overrides == {65536: 1}
+
+    def test_dead_target_rank_pruned(self):
+        t = mk_tuner([0.0])
+        t.sweep(hot_view())
+        t.sweep(hot_view())
+        assert t.state.overrides
+        epoch0 = t.state.epoch
+        v = hot_view()
+        v["server_ranks"] = [0, 2]  # rank 1 (the target) left
+        res = t.sweep(v)
+        assert not t.state.overrides
+        assert res["map_changed"] and t.state.epoch > epoch0
+
+
+class TestFusionWalk:
+    def fusion_view(self, thr, rpc, fused, keys):
+        return {
+            "steps": {}, "num_workers": 2, "codec_votes": {},
+            "fusion": {"threshold": thr, "wire_rpc": rpc,
+                       "fused_frames": fused, "fused_keys": keys},
+        }
+
+    def test_raise_on_pressure_with_saturated_packs(self):
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.fusion_view(65536, 0, 0, 0))  # delta baseline
+        res = t.sweep(self.fusion_view(65536, 500, 10, 100))
+        assert res["actions"][0]["set"]["fusion_threshold"] == 131072
+
+    def test_shrink_when_packs_degenerate(self):
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.fusion_view(65536, 0, 0, 0))
+        res = t.sweep(self.fusion_view(65536, 100, 100, 110))  # avg 1.1
+        assert res["actions"][0]["set"]["fusion_threshold"] == 32768
+
+    def test_rollback_restores_concrete_previous_value(self):
+        # the undo must carry the OBSERVED pre-action threshold, never
+        # None: a None patch makes the book omit the field, which
+        # workers read as "untouched" — the regressed value would
+        # survive its own rollback
+        t = mk_tuner([0.0], cooldown_s=0.0, canary_sweeps=1, regress=1.3)
+        t.sweep({**self.fusion_view(65536, 0, 0, 0),
+                 "steps": {"w0": 0.1}})
+        res = t.sweep({**self.fusion_view(65536, 500, 10, 100),
+                       "steps": {"w0": 0.1}})
+        assert res["actions"][0]["undo"] == {"fusion_threshold": 65536}
+        assert t.state.fusion_threshold == 131072
+        res = t.sweep({**self.fusion_view(65536, 0, 0, 0),
+                       "steps": {"w0": 9.0}})
+        assert res["rollbacks"]
+        assert t.state.fusion_threshold == 65536  # concrete, not None
+
+    def test_hysteresis_dead_zone_no_action(self):
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.fusion_view(65536, 0, 0, 0))
+        # avg pack 3 (between 1.5 and 6), rpc below the pressure bar
+        assert not t.sweep(self.fusion_view(65536, 30, 10, 30))["actions"]
+
+    def test_never_turns_fusion_on_from_zero(self):
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.fusion_view(0, 0, 0, 0))
+        assert not t.sweep(self.fusion_view(0, 5000, 0, 0))["actions"]
+
+    def test_bounds_clamp(self):
+        t = mk_tuner([0.0], cooldown_s=0.0, canary_sweeps=1000)
+        t.state.fusion_threshold = TunerConfig().fusion_max
+        t.sweep(self.fusion_view(0, 0, 0, 0))
+        assert not t.sweep(self.fusion_view(0, 5000, 0, 0))["actions"]
+
+
+class TestCodecConsensus:
+    def codec_view(self, votes, nw):
+        return {"steps": {}, "fusion": {}, "codec_votes": votes,
+                "num_workers": nw}
+
+    def test_quorum_flips_fleet(self):
+        t = mk_tuner([0.0])
+        res = t.sweep(self.codec_view({"topk": 2}, 3))
+        assert res["actions"][0]["set"] == {"codec_off_add": ["topk"]}
+        assert t.state.codec_off == ["topk"]
+        assert t.tuning_dict()["codec_off"] == ["topk"]
+
+    def test_below_quorum_waits(self):
+        t = mk_tuner([0.0])
+        assert not t.sweep(self.codec_view({"topk": 1}, 4))["actions"]
+
+    def test_single_worker_is_not_a_fleet(self):
+        t = mk_tuner([0.0])
+        assert not t.sweep(self.codec_view({"topk": 1}, 1))["actions"]
+
+    def test_already_off_not_reflipped(self):
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.codec_view({"topk": 2}, 2))
+        assert not t.sweep(self.codec_view({"topk": 2}, 2))["actions"]
+
+
+class TestCanaryRollback:
+    def test_regression_rolls_back_and_escalates_cooldown(self):
+        clock = [0.0]
+        t = mk_tuner(clock, canary_sweeps=2, regress=1.3)
+        t.sweep(hot_view())
+        t.sweep(hot_view())  # action at sweep 2, baseline 0.1
+        assert t.state.overrides
+        slow = {"w0": 0.5, "w1": 0.5}
+        t.sweep(hot_view(load0=100.0, steps=slow))
+        res = t.sweep(hot_view(load0=100.0, steps=slow))  # deadline sweep
+        assert [c["rule"] for c in res["rollbacks"]] == ["hot_key_rebalance"]
+        assert res["map_changed"] and not t.state.overrides
+        assert t._cooldown_mult["hot_key_rebalance"] == 4.0
+
+    def test_healthy_canary_decision_stands(self):
+        t = mk_tuner([0.0], canary_sweeps=2)
+        t.sweep(hot_view())
+        t.sweep(hot_view())
+        for _ in range(4):
+            res = t.sweep(hot_view(load0=100.0))
+            assert not res["rollbacks"]
+        assert t.state.overrides  # decision survived its window
+
+    def test_no_baseline_means_no_rollback(self):
+        t = mk_tuner([0.0], canary_sweeps=1)
+        v = hot_view(steps={})
+        t.sweep(v)
+        t.sweep(v)  # action with no visible worker steps
+        res = t.sweep(hot_view(load0=100.0, steps={"w0": 99.0, "w1": 99.0}))
+        assert not res["rollbacks"] and t.state.overrides
+
+    def test_forced_action_drills_the_rollback_path(self):
+        clock = [0.0]
+        t = mk_tuner(clock, canary_sweeps=1, force="fusion_threshold=65536")
+        base = {"steps": {"w0": 0.1}, "fusion": {}, "codec_votes": {},
+                "num_workers": 1}
+        res = t.sweep(dict(base))
+        assert res["actions"][0]["rule"] == "fusion_threshold"
+        assert t.state.fusion_threshold == 65536
+        res = t.sweep({**base, "steps": {"w0": 9.9}})
+        assert res["rollbacks"] and t.state.fusion_threshold is None
+
+
+class TestTuningStateAndBook:
+    def test_epoch_bumps_on_every_patch(self):
+        st = TuningState()
+        assert not st.apply_patch({"fusion_threshold": 1024})
+        assert st.epoch == 1
+        assert st.apply_patch({"overrides_set": {5: 1}})
+        assert st.epoch == 2 and st.overrides == {5: 1}
+        assert st.apply_patch({"overrides_del": [5]})
+        assert not st.overrides
+
+    def test_book_extras_filters_overrides_to_live_ranks(self):
+        t = mk_tuner([0.0])
+        t.state.apply_patch({"overrides_set": {7: 1, 9: 2}})
+        ex = t.book_extras([0, 1])
+        assert ex["ring_overrides"] == {"7": 1}
+        ex = t.book_extras([0])
+        assert "ring_overrides" not in ex
+        assert "tuning" in ex  # the section itself is always present
+
+    def _recv_book(self, sched):
+        from byteps_tpu.comm.transport import recv_message, send_message  # noqa: F401
+
+        a, b = socket.socketpair()
+        try:
+            sched._send_addrbook_to(a, threading.Lock(), "worker", 0, 0)
+            b.settimeout(5)
+            msg = recv_message(b)
+            return json.loads(msg.payload.decode())
+        finally:
+            a.close()
+            b.close()
+
+    def test_autotune_off_book_is_byte_for_byte_legacy(self, monkeypatch):
+        from byteps_tpu.comm.rendezvous import Scheduler
+
+        monkeypatch.delenv("BYTEPS_AUTOTUNE", raising=False)
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        try:
+            assert sched.tuner is None
+            book = self._recv_book(sched)
+            assert set(book.keys()) == {
+                "role", "rank", "num_workers", "num_servers", "servers",
+                "is_recovery", "epoch", "evictions", "worker_ranks",
+                "server_ranks", "map_epoch", "sched_incarnation", "jobs",
+            }
+        finally:
+            sched.stop()
+
+    def test_autotune_on_book_carries_tuning(self, monkeypatch):
+        from byteps_tpu.comm.rendezvous import Scheduler
+
+        monkeypatch.setenv("BYTEPS_AUTOTUNE", "1")
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        try:
+            assert sched.tuner is not None
+            book = self._recv_book(sched)
+            assert book["tuning"] == {"epoch": 0}
+            assert "ring_overrides" not in book  # none live yet
+        finally:
+            sched.stop()
+
+
+class TestOwnershipOverrides:
+    def test_override_wins_over_ring(self):
+        ring = HashRing([0, 1], vnodes=64)
+        key = next(k << 16 for k in range(256) if ring.owner(k << 16) == 0)
+        omap = OwnershipMap([0, 1], epoch=3, overrides={key: 1})
+        assert omap.owner(key) == 1
+        other = next(
+            k << 16 for k in range(256)
+            if ring.owner(k << 16) == 0 and (k << 16) != key
+        )
+        assert omap.owner(other) == 0  # un-overridden keys keep the ring
+
+    def test_override_to_absent_rank_dropped(self):
+        omap = OwnershipMap([0, 1], overrides={5: 7})
+        assert 5 not in omap.overrides
+        assert omap.owner(5) == OwnershipMap([0, 1]).owner(5)
+
+    def test_string_keys_from_json_coerce(self):
+        omap = OwnershipMap([0, 1], overrides={"5": "1"})
+        assert omap.owner(5) == 1
+
+
+class TestQuotaDivision:
+    def _sched_with_fleet(self, monkeypatch, n_servers):
+        from byteps_tpu.comm.rendezvous import Scheduler, _Node
+
+        monkeypatch.delenv("BYTEPS_AUTOTUNE", raising=False)
+        sched = Scheduler(num_workers=1, num_servers=n_servers,
+                          host="127.0.0.1")
+        sched._nodes["worker"].append(_Node(
+            0, "", 0, None, None, "w-uid", job=5, job_priority=2,
+            job_quota_mbps=6.0,
+        ))
+        for r in range(n_servers):
+            sched._nodes["server"].append(
+                _Node(r, "127.0.0.1", 1000 + r, None, None, f"s{r}")
+            )
+        return sched
+
+    def test_quota_divided_across_live_servers(self, monkeypatch):
+        sched = self._sched_with_fleet(monkeypatch, 3)
+        try:
+            jobs = sched._jobs_map_locked()
+            assert jobs["5"]["quota_mbps"] == pytest.approx(2.0)
+            assert jobs["5"]["quota_mbps_total"] == pytest.approx(6.0)
+        finally:
+            sched.stop()
+
+    def test_single_server_keeps_declared_value(self, monkeypatch):
+        sched = self._sched_with_fleet(monkeypatch, 1)
+        try:
+            jobs = sched._jobs_map_locked()
+            assert jobs["5"]["quota_mbps"] == pytest.approx(6.0)
+        finally:
+            sched.stop()
+
+    def test_no_quota_job_map_unchanged(self, monkeypatch):
+        from byteps_tpu.comm.rendezvous import Scheduler, _Node
+
+        sched = Scheduler(num_workers=1, num_servers=2, host="127.0.0.1")
+        sched._nodes["worker"].append(
+            _Node(0, "", 0, None, None, "w-uid")
+        )
+        try:
+            jobs = sched._jobs_map_locked()
+            assert jobs["0"] == {
+                "workers": [0], "priority": 1, "quota_mbps": 0.0,
+            }  # no quota_mbps_total key: legacy shape preserved
+        finally:
+            sched.stop()
+
+
+class TestClientAdoption:
+    def _stub_client(self):
+        from byteps_tpu.comm.ps_client import PSClient
+
+        pc = PSClient.__new__(PSClient)
+        pc._tuning_listeners = []
+        pc.tuning = None
+        pc._tuning_epoch = 0
+        return pc
+
+    def test_monotone_epoch_adoption_and_listeners(self):
+        pc = self._stub_client()
+        seen = []
+        pc.add_tuning_listener(seen.append)
+        pc._adopt_tuning({"tuning": {"epoch": 2, "fusion_threshold": 512}})
+        pc._adopt_tuning({"tuning": {"epoch": 1}})  # stale: ignored
+        assert pc.tuning["epoch"] == 2 and len(seen) == 1
+        pc._adopt_tuning({"tuning": {"epoch": 3}})
+        assert len(seen) == 2 and pc._tuning_epoch == 3
+
+    def test_listener_registration_replays_current(self):
+        pc = self._stub_client()
+        pc._adopt_tuning({"tuning": {"epoch": 1, "codec_off": ["topk"]}})
+        seen = []
+        pc.add_tuning_listener(seen.append)
+        assert seen and seen[0]["codec_off"] == ["topk"]
+
+    def test_books_without_tuning_are_noops(self):
+        pc = self._stub_client()
+        pc._adopt_tuning({})
+        pc._adopt_tuning({"tuning": "garbage"})
+        assert pc.tuning is None
+
+    def test_scheduler_rebirth_resets_tuning_fence(self):
+        # a reborn scheduler's tuner restarts at epoch 0; the monotone
+        # fence must re-arm with the incarnation or every new decision
+        # would be refused while the dead tuner's stayed live
+        pc = self._stub_client()
+        pc.sched_incarnation = 0
+        pc._fence_book({"sched_incarnation": 100})
+        pc._adopt_tuning({"tuning": {"epoch": 10, "codec_off": ["topk"]}})
+        assert pc._tuning_epoch == 10
+        pc._fence_book({"sched_incarnation": 200})  # rebirth
+        pc._adopt_tuning({"tuning": {"epoch": 0}})  # successor's first
+        assert pc.tuning == {"epoch": 0} and pc._tuning_epoch == 0
+
+    def test_tunerless_successor_reverts_to_legacy(self):
+        pc = self._stub_client()
+        seen = []
+        pc.add_tuning_listener(seen.append)
+        pc._adopt_tuning({"tuning": {"epoch": 3, "codec_off": ["topk"]}})
+        assert len(seen) == 1
+        pc._adopt_tuning({"epoch": 9})  # no tuning: tuner gone
+        assert pc.tuning is None
+        assert seen[-1] == {}  # listeners told to revert, exactly once
+        pc._adopt_tuning({"epoch": 10})
+        assert len(seen) == 2  # idempotent per transition
+
+
+class TestEngineAdoption:
+    def _engine(self, **cfg_kw):
+        from byteps_tpu.core.engine import PipelineEngine
+
+        cfg = Config(num_worker=1, **cfg_kw)
+        return PipelineEngine(cfg, object())  # stub client: no listener API
+
+    def test_fusion_threshold_adopts_live(self):
+        eng = self._engine(fusion_threshold=65536)
+        eng._apply_tuning({"epoch": 1, "fusion_threshold": 131072})
+        assert eng.cfg.fusion_threshold == 131072
+
+    def test_fusion_never_turned_on_from_zero(self):
+        eng = self._engine(fusion_threshold=0)
+        eng._apply_tuning({"epoch": 1, "fusion_threshold": 65536})
+        assert eng.cfg.fusion_threshold == 0
+
+    def test_absent_field_restores_launch_value(self):
+        # "no fusion_threshold in the section" means untouched/legacy —
+        # a reborn scheduler's empty tuning state (or a revert) must
+        # land fleet-wide, not freeze the last tuned value
+        eng = self._engine(fusion_threshold=65536)
+        eng._apply_tuning({"epoch": 1, "fusion_threshold": 131072})
+        assert eng.cfg.fusion_threshold == 131072
+        eng._apply_tuning({"epoch": 2})
+        assert eng.cfg.fusion_threshold == 65536
+        eng._apply_tuning({})  # the tuner-gone revert signal
+        assert eng.cfg.fusion_threshold == 65536
+
+    def test_fleet_codec_off_and_rollback_scoped_to_fleet_keys(self):
+        eng = self._engine()
+        eng._codec_names = {1: "topk", 2: "topk", 3: "onebit"}
+        eng._compression_auto_off.add(2)  # local verdict, pre-existing
+        eng._apply_tuning({"epoch": 1, "codec_off": ["topk"]})
+        assert eng._compression_auto_off == {1, 2}
+        assert eng._fleet_codec_off["topk"] == {1}
+        eng._apply_tuning({"epoch": 2, "codec_off": []})  # rollback
+        assert eng._compression_auto_off == {2}  # local verdict survives
+        assert "topk" not in eng._fleet_codec_off
+
+
+class TestServerHotReport:
+    def test_report_armed_by_tuning_book_and_deltas(self):
+        from byteps_tpu.server.server import PSServer
+
+        srv = PSServer(Config(num_worker=1, num_server=1))
+        try:
+            ks = srv._key_state(7 << 16)
+            ks.req_bytes = 1000
+            assert srv._hot_report() is None  # not armed: legacy beat
+            srv._adopt_tuning({"tuning": {"epoch": 0}})
+            # arming re-baselined: pre-arm traffic is not reported
+            rep = srv._hot_report()
+            assert rep["total"] == 0 and rep["owned"] == 0
+            ks.req_bytes += 500
+            rep = srv._hot_report()
+            assert rep["total"] == 500  # deltas, not totals
+            assert rep["keys"] == [[7 << 16, 500]]
+        finally:
+            srv._sock.close()
+
+    def test_tuningless_book_disarms_reports(self):
+        from byteps_tpu.server.server import PSServer
+
+        srv = PSServer(Config(num_worker=1, num_server=1))
+        try:
+            srv._key_state(3).req_bytes = 10
+            srv._adopt_tuning({"tuning": {"epoch": 0}})
+            assert srv._tuning_on
+            # a reborn autotune-off scheduler's book carries no section:
+            # beats must return to the byte-identical legacy wire
+            srv._adopt_tuning({"epoch": 5})
+            assert not srv._tuning_on
+            assert srv._hot_report() is None
+        finally:
+            srv._sock.close()
+
+    def test_enqueue_accounts_bytes(self):
+        from byteps_tpu.comm.transport import Message, Op
+        from byteps_tpu.server.server import PSServer
+
+        srv = PSServer(Config(num_worker=1, num_server=1))
+        try:
+            msg = Message(Op.PUSH, key=3, payload=b"x" * 64, flags=1,
+                          version=1)
+            lock = threading.Lock()
+            a, b = socket.socketpair()
+            try:
+                srv._enqueue(msg, a, lock)
+                assert srv._key_state(3).req_bytes == 64
+            finally:
+                a.close()
+                b.close()
+        finally:
+            srv._sock.close()
+
+
+class TestFlightUpload:
+    def test_recorder_queues_compact_uploads(self, tmp_path, monkeypatch):
+        from byteps_tpu.core.flightrec import FlightRecorder
+
+        monkeypatch.setenv("BYTEPS_FLIGHT_UPLOAD", "1")
+        monkeypatch.setenv("BYTEPS_FLIGHT_DIR", str(tmp_path))
+        rec = FlightRecorder(capacity=8)
+        assert rec.upload
+        path = rec.dump_bundle("slow_step", {"why": "test"},
+                               {"step": 3, "t": 1.0, "trig": []})
+        assert os.path.isdir(path)
+        rec._uploads.append({"rule": "slow_step", "step": 3})  # as _fire does
+        ups = rec.take_uploads()
+        assert ups and not rec.take_uploads()
+        rec.requeue_uploads(ups)
+        assert rec.take_uploads() == ups
+
+    def test_scheduler_stores_uploaded_bundles(self, tmp_path, monkeypatch):
+        from byteps_tpu.comm.rendezvous import Scheduler
+
+        monkeypatch.setenv("BYTEPS_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.delenv("BYTEPS_AUTOTUNE", raising=False)
+        sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+        try:
+            sched._store_uploaded_bundles(
+                ("worker", 0),
+                [{"rule": "slow_step", "step": 9, "evidence": {"x": 1}}],
+            )
+            dirs = list(tmp_path.iterdir())
+            assert len(dirs) == 1 and "worker0" in dirs[0].name
+            with open(dirs[0] / "trigger.json") as f:
+                assert json.load(f)["rule"] == "slow_step"
+            agg = sched.metrics_agg.counters.snapshot()
+            assert agg.get("flight_bundle_rx") == 1
+        finally:
+            sched.stop()
+
+
+def test_tune_rules_complete():
+    """Tier-1 binding: every shipped policy documented + wired, every
+    documented policy shipped (tools/check_tune_rules.py)."""
+    import importlib.util
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tools", "check_tune_rules.py")
+    spec = importlib.util.spec_from_file_location("check_tune_rules", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_tune_rules", mod)
+    spec.loader.exec_module(mod)
+    problems = mod.check(repo)
+    assert problems == [], "\n".join(problems)
+
+
+class TestSchedulerHostedRollback:
+    """The acceptance rollback path on the REAL scheduler-hosted tuner:
+    a deliberately harmful decision (forced) regresses the cluster
+    median step time and is rolled back within the canary window —
+    ``tune_rollback`` lands on the scheduler aggregate."""
+
+    def test_harmful_decision_rolls_back(self, monkeypatch):
+        from byteps_tpu.comm.rendezvous import Scheduler
+
+        monkeypatch.setenv("BYTEPS_AUTOTUNE", "1")
+        monkeypatch.setenv("BYTEPS_AUTOTUNE_CANARY_SWEEPS", "2")
+        monkeypatch.setenv(
+            "BYTEPS_AUTOTUNE_FORCE", "fusion_threshold=1048576"
+        )
+        sched = Scheduler(num_workers=2, num_servers=1, host="127.0.0.1")
+        try:
+            base = {"steps": {"0": 0.1, "1": 0.1}, "fusion": {},
+                    "codec_votes": {}, "num_workers": 2,
+                    "server_ranks": [0]}
+            res = sched.tuner.sweep(dict(base))
+            assert res["actions"] and sched.tuner.state.fusion_threshold
+            slow = {**base, "steps": {"0": 0.9, "1": 0.8}}
+            sched.tuner.sweep(dict(slow))
+            res = sched.tuner.sweep(dict(slow))
+            assert res["rollbacks"], "harmful decision not rolled back"
+            assert sched.tuner.state.fusion_threshold is None
+            labeled = sched.metrics_agg.counters.snapshot_labeled()
+            rb = labeled.get("tune_rollback", {})
+            assert sum(rb.values()) >= 1
+        finally:
+            sched.stop()
+
+
+class TestRebalanceWireE2E:
+    """Acceptance demo (docs/autotune.md): a load-skewed fleet triggers
+    a tuner-initiated hot-key rebalance that migrates ≥1 key through
+    the live migration plane — pulls bitwise through the move,
+    exactly-once sums (replay dedupe intact at the new owner), and NO
+    re-init barrier."""
+
+    def test_skewed_load_rebalances_live(self, monkeypatch):
+        from byteps_tpu.comm.ps_client import PSClient
+        from byteps_tpu.comm.rendezvous import Scheduler
+        from byteps_tpu.server.server import PSServer
+
+        monkeypatch.setenv("BYTEPS_AUTOTUNE", "1")
+        monkeypatch.setenv("BYTEPS_ELASTIC_RESHARD", "1")
+        monkeypatch.setenv("BYTEPS_AUTOTUNE_INTERVAL_S", "0.2")
+        monkeypatch.setenv("BYTEPS_AUTOTUNE_SWEEPS", "2")
+        monkeypatch.setenv("BYTEPS_AUTOTUNE_FACTOR", "1.5")
+        monkeypatch.setenv("BYTEPS_AUTOTUNE_COOLDOWN_S", "60")
+        monkeypatch.setenv("BYTEPS_HEARTBEAT_INTERVAL", "0.1")
+        sched = Scheduler(num_workers=1, num_servers=2, host="127.0.0.1")
+        sched.start()
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+        cfg = Config(num_worker=1, num_server=2, elastic_reshard=True,
+                     heartbeat_interval=0.1, rpc_retries=4,
+                     rpc_deadline_s=2.0, ps_root_port=sched.port)
+        fleet = [
+            PSServer(Config(num_worker=1, num_server=2,
+                            elastic_reshard=True, heartbeat_interval=0.1,
+                            ps_root_port=sched.port))
+            for _ in range(2)
+        ]
+        for s in fleet:
+            threading.Thread(target=s.start, daemon=True).start()
+        pc = PSClient(cfg)
+        before_moved = counters().get("migration_keys_moved")
+        before_dedupe = counters().get("push_dedup")
+        try:
+            pc.connect()
+            assert pc.tuning is not None  # section adopted at connect
+            ring = HashRing([0, 1], vnodes=cfg.ring_vnodes)
+            hot = [k << 16 for k in range(512)
+                   if ring.owner(k << 16) == 0][:5]
+            cold = [k << 16 for k in range(512)
+                    if ring.owner(k << 16) == 1][:1]
+            keys = hot + cold
+            n = 2048
+            for k in keys:
+                pc.init_tensor(k, n, F32)
+            rng = np.random.default_rng(3)
+            grads = {k: rng.standard_normal(n).astype(np.float32)
+                     for k in keys}
+
+            def round_trip(ver):
+                for k in keys:
+                    acked = threading.Event()
+                    pc.push(k, grads[k].tobytes(), F32, ver,
+                            lambda e=acked: e.set())
+                    assert acked.wait(15), f"push {k} v{ver} hung"
+                for k in keys:
+                    got = threading.Event()
+                    box: list = []
+                    pc.pull(k, ver,
+                            lambda p, b=box, e=got: (b.append(p), e.set()))
+                    assert got.wait(15), f"pull {k} v{ver} hung"
+                    np.testing.assert_array_equal(
+                        np.frombuffer(box[0], dtype=np.float32), grads[k]
+                    )
+
+            ver = 0
+            deadline = time.monotonic() + 40
+            moved = False
+            while time.monotonic() < deadline:
+                ver += 1
+                round_trip(ver)  # bitwise EVERY round, incl. mid-move
+                if (sched.tuner.state.overrides
+                        and counters().get("migration_keys_moved")
+                        > before_moved):
+                    moved = True
+                    break
+            assert moved, "tuner-initiated rebalance never fired"
+            # decision + evidence recorded
+            acts = sched.tuner.actions
+            assert acts and acts[0]["rule"] == "hot_key_rebalance"
+            assert acts[0]["evidence"]["hot_rank"] == 0
+            labeled = sched.metrics_agg.counters.snapshot_labeled()
+            assert sum(labeled.get("tune_action", {}).values()) >= 1
+            # pulls stay bitwise after the move settles
+            round_trip(ver + 1)
+            round_trip(ver + 2)
+            # exactly-once through the handoff: replay one already-summed
+            # round at the NEW owner — it must dedupe, not double-sum
+            moved_key = next(iter(sched.tuner.state.overrides))
+            acked = threading.Event()
+            pc.push(moved_key, grads[moved_key].tobytes(), F32, ver + 2,
+                    lambda e=acked: e.set())
+            assert acked.wait(15)
+            got = threading.Event()
+            box: list = []
+            pc.pull(moved_key, ver + 2,
+                    lambda p, b=box, e=got: (b.append(p), e.set()))
+            assert got.wait(15)
+            np.testing.assert_array_equal(
+                np.frombuffer(box[0], dtype=np.float32), grads[moved_key]
+            )
+            assert counters().get("push_dedup") > before_dedupe
+            # NO re-init barrier: the migration continued in place
+            assert pc.server_generation == 0
+            assert pc.map_epoch >= 2
+        finally:
+            pc.close()
+            for s in fleet:
+                s.stop()
+            sched.stop()

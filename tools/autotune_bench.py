@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""autotune_bench — A/B of the adaptive control plane on a skewed load
+(docs/autotune.md "Demo recipe").
+
+The scenario the hot-key rebalance policy exists for: one server is
+slow (here: a chaos-shaped link — every PUSH/PULL frame to its port
+eats a deterministic delay, the in-process stand-in for a sick NIC or
+an overloaded box) AND owns most of the working set.  Phase A trains
+with ``BYTEPS_AUTOTUNE=0``: every round pays the slow server for most
+keys, forever.  Phase B trains with ``BYTEPS_AUTOTUNE=1``: the tuner
+sees the load imbalance in the servers' hot-key reports, moves the hot
+keys to the healthy server through the live migration plane (no
+re-init, pulls bitwise through the move), and the measured window runs
+on the rebalanced placement.
+
+Each phase runs in a fresh subprocess (chaos + autotune knobs are
+process-wide env).  Writes ``AUTOTUNE_BENCH_r01.json``-style output:
+steps/s per phase, the speedup ratio, and the tuner's action log.
+
+Usage:
+    python tools/autotune_bench.py --out AUTOTUNE_BENCH_r01.json
+    python tools/autotune_bench.py --phase on        # (internal) one phase
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+HOT_KEYS = 6        # keys homed on the slow server
+COLD_KEYS = 2       # keys homed on the healthy server
+DIM = 2048          # floats per key
+DELAY_MS = 3        # per-frame chaos delay on the slow server's port
+MEASURE_ROUNDS = 40
+WARMUP_ROUNDS = 15  # phase A warmup; phase B warms until the move lands
+
+
+def run_phase(autotune: bool) -> dict:
+    import numpy as np
+
+    os.environ.update({
+        "BYTEPS_VAN": "chaos:tcp",
+        "BYTEPS_CHAOS_SEED": "11",
+        # armed AFTER the fleet is up (target port unknown until then)
+        "BYTEPS_CHAOS_DROP": "0",
+        "BYTEPS_CHAOS_DELAY": "0",
+        "BYTEPS_ELASTIC_RESHARD": "1",
+        "BYTEPS_HEARTBEAT_INTERVAL": "0.1",
+        "BYTEPS_FLIGHT_STEPS": "0",
+        "BYTEPS_AUTOTUNE": "1" if autotune else "0",
+        "BYTEPS_AUTOTUNE_INTERVAL_S": "0.2",
+        "BYTEPS_AUTOTUNE_SWEEPS": "2",
+        "BYTEPS_AUTOTUNE_FACTOR": "1.5",
+        # one decisive action: a long cooldown keeps the measured window
+        # on a settled placement instead of ping-ponging
+        "BYTEPS_AUTOTUNE_COOLDOWN_S": "120",
+        "BYTEPS_AUTOTUNE_MAX_MOVES": str(HOT_KEYS),
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+    })
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.common.hashing import HashRing
+    from byteps_tpu.common.types import DataType
+    from byteps_tpu.comm.ps_client import PSClient
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.core.telemetry import counters
+    from byteps_tpu.server.server import PSServer
+
+    f32 = int(DataType.FLOAT32)
+    sched = Scheduler(num_workers=1, num_servers=2, host="127.0.0.1")
+    sched.start()
+    os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+    cfg = Config(num_worker=1, num_server=2, elastic_reshard=True,
+                 heartbeat_interval=0.1, rpc_retries=6, rpc_deadline_s=5.0,
+                 ps_root_port=sched.port)
+    fleet = [PSServer(Config(num_worker=1, num_server=2, elastic_reshard=True,
+                             heartbeat_interval=0.1, ps_root_port=sched.port))
+             for _ in range(2)]
+    for s in fleet:
+        threading.Thread(target=s.start, daemon=True).start()
+    # the slow server = rank 0's port, read from the live registration
+    # table (ranks assign as REGISTERs arrive)
+    deadline = time.monotonic() + 10
+    while True:
+        with sched._lock:
+            nodes = list(sched._nodes["server"])
+        if len(nodes) >= 2:
+            break
+        if time.monotonic() > deadline:
+            raise RuntimeError("servers never registered")
+        time.sleep(0.05)
+    victim_port = next(n.port for n in nodes if n.rank == 0)
+    from byteps_tpu.comm.transport import Op
+
+    os.environ["BYTEPS_CHAOS_TARGET_PORT"] = str(victim_port)
+    os.environ["BYTEPS_CHAOS_OPS"] = f"{int(Op.PUSH)},{int(Op.PULL)}"
+    os.environ["BYTEPS_CHAOS_DELAY"] = "1.0"
+    os.environ["BYTEPS_CHAOS_DELAY_MS"] = str(DELAY_MS)
+
+    pc = PSClient(cfg)
+    pc.connect()
+    ring = HashRing([0, 1], vnodes=cfg.ring_vnodes)
+    hot = [k << 16 for k in range(4096) if ring.owner(k << 16) == 0][:HOT_KEYS]
+    cold = [k << 16 for k in range(4096) if ring.owner(k << 16) == 1][:COLD_KEYS]
+    keys = hot + cold
+    assert len(hot) == HOT_KEYS and len(cold) == COLD_KEYS
+    for k in keys:
+        pc.init_tensor(k, DIM, f32)
+    rng = np.random.default_rng(5)
+    grads = {k: rng.standard_normal(DIM).astype(np.float32) for k in keys}
+
+    def round_trip(ver: int) -> None:
+        for k in keys:
+            acked = threading.Event()
+            pc.push(k, grads[k].tobytes(), f32, ver, lambda e=acked: e.set())
+            assert acked.wait(30), f"push {k} hung"
+        for k in keys:
+            got = threading.Event()
+            box: list = []
+            pc.pull(k, ver, lambda p, b=box, e=got: (b.append(p), e.set()))
+            assert got.wait(30), f"pull {k} hung"
+            np.testing.assert_array_equal(
+                np.frombuffer(box[0], np.float32), grads[k]
+            )
+
+    result = {"autotune": autotune}
+    try:
+        ver = 0
+        # warmup: fixed rounds off; with the tuner on, warm until the
+        # rebalance lands (bounded), then settle a couple of rounds
+        if autotune:
+            deadline = time.monotonic() + 45
+            moved = False
+            while time.monotonic() < deadline:
+                ver += 1
+                round_trip(ver)
+                if counters().get("migration_keys_moved") > 0:
+                    moved = True
+                    break
+            result["rebalanced"] = moved
+            for _ in range(3):  # settle: drain chases/parked requests
+                ver += 1
+                round_trip(ver)
+        else:
+            for _ in range(WARMUP_ROUNDS):
+                ver += 1
+                round_trip(ver)
+            result["rebalanced"] = False
+        t0 = time.monotonic()
+        for _ in range(MEASURE_ROUNDS):
+            ver += 1
+            round_trip(ver)
+        dt = time.monotonic() - t0
+        result.update({
+            "rounds": MEASURE_ROUNDS,
+            "seconds": round(dt, 4),
+            "steps_per_s": round(MEASURE_ROUNDS / dt, 3),
+            "migration_keys_moved": counters().get("migration_keys_moved"),
+            "server_generation": pc.server_generation,  # 0 = no re-init
+        })
+        if autotune and sched.tuner is not None:
+            result["tuner_actions"] = [
+                {"rule": a["rule"], "evidence": a.get("evidence")}
+                for a in sched.tuner.actions
+            ]
+            result["overrides"] = {
+                str(k): r for k, r in sched.tuner.state.overrides.items()
+            }
+    finally:
+        pc.close()
+        for s in fleet:
+            s.stop()
+        sched.stop()
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phase", choices=["off", "on"],
+                    help="(internal) run one phase in THIS process")
+    ap.add_argument("--out", default="AUTOTUNE_BENCH_r01.json")
+    args = ap.parse_args(argv)
+    if args.phase:
+        out = run_phase(autotune=args.phase == "on")
+        print("PHASE_RESULT " + json.dumps(out))
+        return 0
+    results = {}
+    for phase in ("off", "on"):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase", phase],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        line = next(
+            (ln for ln in proc.stdout.splitlines()
+             if ln.startswith("PHASE_RESULT ")), None,
+        )
+        if proc.returncode != 0 or line is None:
+            sys.stderr.write(proc.stdout[-4000:] + proc.stderr[-4000:])
+            raise RuntimeError(f"phase {phase} failed")
+        results[phase] = json.loads(line[len("PHASE_RESULT "):])
+    ratio = results["on"]["steps_per_s"] / max(
+        1e-9, results["off"]["steps_per_s"]
+    )
+    doc = {
+        "bench": "autotune skewed-load A/B (hot_key_rebalance)",
+        "schedule": {
+            "hot_keys_on_slow_server": HOT_KEYS,
+            "cold_keys": COLD_KEYS,
+            "dim": DIM,
+            "chaos_delay_ms_per_frame_on_rank0": DELAY_MS,
+            "measure_rounds": MEASURE_ROUNDS,
+        },
+        "off": results["off"],
+        "on": results["on"],
+        "speedup_on_vs_off": round(ratio, 3),
+        "notes": (
+            "same seeded chaos schedule both phases; rank 0 owns "
+            f"{HOT_KEYS}/{HOT_KEYS + COLD_KEYS} keys and every PUSH/PULL "
+            "frame to it is delayed; with BYTEPS_AUTOTUNE=1 the hot-key "
+            "rebalance moves the hot keys to rank 1 through the live "
+            "migration plane (no re-init; bitwise pulls asserted every "
+            "round including through the move)"
+        ),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc, indent=2))
+    if not results["on"].get("rebalanced"):
+        print("WARNING: rebalance never fired in the ON phase",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -640,6 +640,62 @@ def _r_slo_breach(v: View):
     )
 
 
+_TUNER_ANCHOR = slugify("Autotuner: the control loop is acting up")
+
+
+def _r_tuner_flapping(v: View):
+    """The autotuner keeps taking actions its own canary reverts —
+    oscillation: every flip costs a broadcast (and a migration wave for
+    rebalances) without a lasting win."""
+    acts = v.labeled_by("tune_action", "rule")
+    rbs = v.labeled_by("tune_rollback", "rule")
+    total_rb = sum(rbs.values())
+    total_act = max(1.0, sum(acts.values()))
+    if total_rb < 2:
+        return None  # a single rollback is the guardrail WORKING
+    ratio = total_rb / total_act
+    if ratio < 0.4:
+        return None
+    worst = max(rbs, key=rbs.get)
+    return (
+        42 + min(20.0, 30 * ratio),
+        f"the autotuner is flapping: {int(total_rb)} of "
+        f"{int(total_act)} actions rolled back (worst rule: {worst}) — "
+        "the workload is oscillating around a policy band; raise "
+        "BYTEPS_AUTOTUNE_COOLDOWN_S / BYTEPS_AUTOTUNE_SWEEPS, or pin "
+        "the knob and turn the tuner off for it",
+        [f"tune_rollback total = {int(total_rb)} vs tune_action total = "
+         f"{int(total_act)} ({100 * ratio:.0f}%)",
+         f"tune_rollback{{rule={worst}}} = {int(rbs[worst])}"],
+    )
+
+
+def _r_rebalance_storm(v: View):
+    """Hot-key rebalances firing back-to-back: placement is churning —
+    every action is a live migration wave, and keys ping-ponging
+    between servers means the load signal (or the workload) is less
+    stable than the policy assumes."""
+    moves = v.labeled_by("tune_action", "rule").get("hot_key_rebalance", 0)
+    if moves < 3:
+        return None
+    migrated = v.counter("migration_keys_moved")
+    ev = [f"tune_action{{rule=hot_key_rebalance}} = {int(moves)}"]
+    if migrated:
+        ev.append(f"migration_keys_moved_total = {int(migrated)} "
+                  "(each rebalance is a live migration wave)")
+    rb = v.labeled_by("tune_rollback", "rule").get("hot_key_rebalance", 0)
+    if rb:
+        ev.append(f"tune_rollback{{rule=hot_key_rebalance}} = {int(rb)}")
+    return (
+        38 + min(15.0, 3.0 * moves),
+        f"rebalance storm: {int(moves)} hot-key rebalances in this "
+        "window — placement is churning instead of settling; raise "
+        "BYTEPS_AUTOTUNE_FACTOR / BYTEPS_AUTOTUNE_COOLDOWN_S (or check "
+        "whether one tenant's traffic is genuinely bursty)",
+        ev,
+    )
+
+
 RULES: List[Rule] = [
     Rule("straggler_server", _SLOW_ANCHOR,
          "BYTEPS_DEAD_NODE_TIMEOUT_S (evict it) / fix the sick server",
@@ -685,6 +741,12 @@ RULES: List[Rule] = [
          "BYTEPS_JOB_PRIORITY up for the latency job / "
          "BYTEPS_JOB_QUOTA_MBPS down for the bulk neighbor",
          _r_slo_breach),
+    Rule("tuner_flapping", _TUNER_ANCHOR,
+         "BYTEPS_AUTOTUNE_COOLDOWN_S / BYTEPS_AUTOTUNE_SWEEPS up, or pin "
+         "the knob and disable the tuner", _r_tuner_flapping),
+    Rule("rebalance_storm", _TUNER_ANCHOR,
+         "BYTEPS_AUTOTUNE_FACTOR / BYTEPS_AUTOTUNE_COOLDOWN_S up",
+         _r_rebalance_storm),
 ]
 
 

@@ -272,6 +272,41 @@ def render(url: str, cur: Sample, prev: Sample, dt: float,
             f"  ownership map        : {head}"
             + (f" | owned keys {cells}" if cells else "")
         )
+    # adaptive control plane (docs/autotune.md): the tuning epoch the
+    # fleet runs under, per-rule action/rollback totals, and how many
+    # keys the fleet codec consensus turned off on this node.  Only the
+    # scheduler aggregate carries the epoch + tune counters; a node
+    # endpoint may still show its tune_codec_off slice.
+    tune_epoch = None
+    tune_acts: Dict[str, int] = {}
+    tune_rbs: Dict[str, int] = {}
+    codec_off_keys = 0
+    for (name, lbl), v in cur.items():
+        if name == "byteps_cluster_tuning_epoch":
+            tune_epoch = int(v)
+        elif name == "byteps_tune_action_labeled_total":
+            rm = re.search(r'rule="([^"]*)"', lbl)
+            if rm:
+                tune_acts[rm.group(1)] = tune_acts.get(rm.group(1), 0) + int(v)
+        elif name == "byteps_tune_rollback_labeled_total":
+            rm = re.search(r'rule="([^"]*)"', lbl)
+            if rm:
+                tune_rbs[rm.group(1)] = tune_rbs.get(rm.group(1), 0) + int(v)
+        elif name == "byteps_tune_codec_off_total":
+            codec_off_keys += int(v)
+    if tune_epoch is not None or tune_acts or tune_rbs:
+        cells = " ".join(
+            f"{r}={n}" for r, n in sorted(tune_acts.items())
+        ) or "none"
+        rb_total = sum(tune_rbs.values())
+        line = (
+            "  autotune             : "
+            + (f"epoch {tune_epoch}" if tune_epoch is not None else "epoch ?")
+            + f" | actions {cells} | rollbacks {rb_total}"
+        )
+        if codec_off_keys:
+            line += f" | fleet codec-off keys {codec_off_keys}"
+        lines.append(line)
     # compressed wire path (docs/gradient-compression.md): cumulative
     # wire bytes the codecs removed vs shipped, and how many keys the
     # adaptive policy (BYTEPS_COMPRESSION_AUTO) turned OFF because their

@@ -56,6 +56,7 @@ def run_soak(
     one_sided: bool = False,
     reshard: bool = False,
     sched_crash: int = -1,
+    autotune: bool = False,
 ) -> dict:
     """Run the soak in-process; returns a result dict (raises on any
     invariant violation).  Env mutations are process-wide — run via the
@@ -122,6 +123,16 @@ def run_soak(
             # live migration instead of re-init barriers on server-set
             # changes (docs/robustness.md "migration flow")
             "BYTEPS_ELASTIC_RESHARD": "1" if reshard else "0",
+            # adaptive control plane (docs/autotune.md): the soak's
+            # invariants (bitwise pulls, exactly-once sums, no re-init)
+            # must hold WHILE the tuner sweeps and possibly rebalances
+            # under the same seeded faults — fast knobs so sweeps and
+            # any hot-key action land inside the run
+            "BYTEPS_AUTOTUNE": "1" if autotune else "0",
+            "BYTEPS_AUTOTUNE_INTERVAL_S": "0.2",
+            "BYTEPS_AUTOTUNE_SWEEPS": "2",
+            "BYTEPS_AUTOTUNE_FACTOR": "1.5",
+            "BYTEPS_AUTOTUNE_COOLDOWN_S": "2",
             "DMLC_NUM_WORKER": "1",
             "DMLC_NUM_SERVER": str(servers),
             "DMLC_PS_ROOT_URI": "127.0.0.1",
@@ -290,6 +301,12 @@ def run_soak(
         loss1 = float(sum(w @ w for w in ws))
         snap = bps.get_robustness_counters()
         resize_gen = getattr(client, "server_generation", 0) if reshard else 0
+        tuner_sweeps = tuner_actions = tuner_rollbacks = 0
+        if autotune:
+            assert sched.tuner is not None, "BYTEPS_AUTOTUNE did not arm"
+            tuner_sweeps = sched.tuner._sweep_idx
+            tuner_actions = len(sched.tuner.actions)
+            tuner_rollbacks = len(sched.tuner.rollbacks)
     finally:
         bps.shutdown()
         for srv in fleet:
@@ -343,11 +360,21 @@ def run_soak(
             f"(server_generation={resize_gen})"
         )
         assert drained_ok, "drained server never stopped itself"
+    if autotune:
+        # the control loop actually ran while every bitwise/exactly-once
+        # invariant above held; any action it took rode the same
+        # adopt/migrate planes the soak already proves out
+        assert tuner_sweeps > 0, "autotuner never swept"
     return {
         "steps": steps,
         "loss0": loss0,
         "loss1": loss1,
         "counters": snap,
+        "tuner": {
+            "sweeps": tuner_sweeps,
+            "actions": tuner_actions,
+            "rollbacks": tuner_rollbacks,
+        } if autotune else None,
     }
 
 
@@ -501,6 +528,13 @@ def main() -> int:
                          "window with zero spurious evictions, and a "
                          "subsequent --reshard scale-up still work "
                          "against the reborn scheduler")
+    ap.add_argument("--autotune", action="store_true",
+                    help="arm the adaptive control plane (BYTEPS_AUTOTUNE, "
+                         "docs/autotune.md) with fast sweep knobs: the "
+                         "soak's bitwise/exactly-once invariants must hold "
+                         "while the tuner sweeps (and possibly rebalances "
+                         "hot keys) under the same seeded faults — "
+                         "composes with --reshard")
     ap.add_argument("--multi-tenant", action="store_true",
                     help="two concurrent jobs (sync + async, "
                          "job-namespaced keys) through chaos faults on "
@@ -532,7 +566,7 @@ def main() -> int:
                     disconnect=args.disconnect, truncate=args.truncate,
                     corrupt=args.corrupt, crash_at=args.crash_at,
                     one_sided=args.one_sided, reshard=args.reshard,
-                    sched_crash=args.sched_crash,
+                    sched_crash=args.sched_crash, autotune=args.autotune,
                 )
             )
         except BaseException as e:  # noqa: BLE001
@@ -554,6 +588,9 @@ def main() -> int:
             {k: v for k, v in sorted(result["counters"].items())},
         )
     )
+    if result.get("tuner"):
+        print("AUTOTUNE: %(sweeps)d sweeps, %(actions)d action(s), "
+              "%(rollbacks)d rollback(s)" % result["tuner"])
     return 0
 
 

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""CI guard: the autotuner's policy table and docs/autotune.md may never
+drift apart.
+
+The adaptive control plane (byteps_tpu/core/autotune.py) is
+docs/autotune.md made executable — the same binding the doctor
+(tools/check_doctor_rules.py), the metric catalog
+(tools/check_metrics_doc.py), and the env catalog
+(tools/check_env_doc.py) enforce for their surfaces.  Two directions:
+
+1. **policy → doc + wiring**: every rule named in ``TUNE_RULES`` must
+   (a) be cited by a ``<!-- policy: <name> -->`` marker in
+   docs/autotune.md (its row of the policy table), and (b) actually be
+   wired into the sweep — a ``("<name>", self._policy_...)`` entry in
+   ``AutoTuner.sweep`` plus a ``_policy_<name>`` method — so every
+   shipped policy really emits ``tune_action{rule=<name>}`` when it
+   fires (the label value IS the sweep-table name).
+2. **doc → policy**: every ``<!-- policy: … -->`` marker in
+   docs/autotune.md must name a ``TUNE_RULES`` entry — a documented
+   policy that no longer ships is a lie in the operator's handbook.
+
+Wired into tier-1 as ``tests/test_autotune.py::test_tune_rules_complete``.
+
+Usage: ``python tools/check_tune_rules.py [--repo ROOT]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import re
+import sys
+
+_POLICY_MARK_RE = re.compile(r"<!--\s*policy:\s*([a-z0-9_]+)\s*-->")
+
+
+def load_autotune(repo: str):
+    path = os.path.join(repo, "byteps_tpu", "core", "autotune.py")
+    spec = importlib.util.spec_from_file_location("_bps_autotune_guard", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("_bps_autotune_guard", mod)
+    spec.loader.exec_module(mod)
+    return sys.modules["_bps_autotune_guard"]
+
+
+def check(repo: str) -> list:
+    """Returns a list of problem strings (empty = green)."""
+    problems = []
+    src_path = os.path.join(repo, "byteps_tpu", "core", "autotune.py")
+    doc_path = os.path.join(repo, "docs", "autotune.md")
+    if not os.path.exists(doc_path):
+        return [f"{doc_path} missing"]
+    mod = load_autotune(repo)
+    rules = tuple(mod.TUNE_RULES)
+    with open(src_path) as f:
+        src = f.read()
+    with open(doc_path) as f:
+        doc = f.read()
+    cited = set(_POLICY_MARK_RE.findall(doc))
+
+    for name in rules:
+        if name not in cited:
+            problems.append(
+                f"policy {name!r} has no <!-- policy: … --> marker in "
+                "docs/autotune.md — the operator handbook doesn't know "
+                "this policy exists"
+            )
+        # the sweep table entry is what stamps tune_action{rule=<name>}
+        if not re.search(rf'\(\s*"{name}"\s*,\s*self\._policy_', src):
+            problems.append(
+                f"policy {name!r} is in TUNE_RULES but not wired into "
+                "AutoTuner.sweep — it can never emit "
+                f"tune_action{{rule={name}}}"
+            )
+        if not hasattr(mod.AutoTuner, f"_policy_{name}"):
+            problems.append(
+                f"policy {name!r} has no AutoTuner._policy_{name} method"
+            )
+
+    for name in cited:
+        if name not in rules:
+            problems.append(
+                f"docs/autotune.md cites unknown policy {name!r} "
+                "(markers must name a TUNE_RULES entry)"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--repo",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    args = ap.parse_args(argv)
+    problems = check(args.repo)
+    if problems:
+        print("autotune policies and docs/autotune.md have drifted:",
+              file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    mod = load_autotune(args.repo)
+    print(f"tune rules OK: {len(mod.TUNE_RULES)} policy(ies) bound to "
+          "docs/autotune.md")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
