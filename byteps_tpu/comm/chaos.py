@@ -24,9 +24,20 @@ and retries exist for.  Classes:
 - **corrupt**:    the frame's magic byte is flipped before sending — the
                   peer's framing check rejects it and drops the
                   connection.  (This models link corruption that survives
-                  to the app layer as frame desync; silent payload
-                  corruption is a checksum problem the 32-byte header has
-                  no field for, and real DCN links CRC their frames.)
+                  to the app layer as frame desync.)
+- **payload corrupt**: ONE seeded byte past the fixed 32-byte header
+                  gets one bit flipped and the frame ships otherwise
+                  intact — the most common real-DCN silent failure (bad
+                  NIC/DRAM flipping bits that TCP's 16-bit checksum
+                  misses).  Historically this module refused to inject
+                  it because nothing could detect it; with the
+                  end-to-end integrity plane (``BYTEPS_WIRE_CHECKSUM``,
+                  docs/robustness.md "Wire integrity") a receiver
+                  verifies the frame's CRC32C before any sum core or
+                  demux sees it, so payload corruption is now an
+                  injectable, testable fault class.  With checksums OFF
+                  the flip passes silently — exactly the A/B that
+                  proves detection is the checksum's doing, not luck.
 
 Determinism: ``BYTEPS_CHAOS_SEED`` seeds a per-connection
 ``random.Random`` derived from ``(seed, connection_index)``, where the
@@ -34,15 +45,17 @@ index is a process-global counter — with a fixed seed and a fixed
 connect order, the fault schedule replays exactly.
 
 Knobs (probabilities in [0,1], applied per frame in the order drop →
-disconnect → truncate → corrupt; delay is rolled independently):
+disconnect → truncate → corrupt → payload corrupt; delay is rolled
+independently):
 
-    BYTEPS_CHAOS_SEED         int,   default 0
-    BYTEPS_CHAOS_DROP         float, default 0
-    BYTEPS_CHAOS_DISCONNECT   float, default 0
-    BYTEPS_CHAOS_TRUNCATE     float, default 0
-    BYTEPS_CHAOS_CORRUPT      float, default 0
-    BYTEPS_CHAOS_DELAY        float, default 0
-    BYTEPS_CHAOS_DELAY_MS     float, default 20 (max; uniform 0..max)
+    BYTEPS_CHAOS_SEED            int,   default 0
+    BYTEPS_CHAOS_DROP            float, default 0
+    BYTEPS_CHAOS_DISCONNECT      float, default 0
+    BYTEPS_CHAOS_TRUNCATE        float, default 0
+    BYTEPS_CHAOS_CORRUPT         float, default 0
+    BYTEPS_CHAOS_PAYLOAD_CORRUPT float, default 0
+    BYTEPS_CHAOS_DELAY           float, default 0
+    BYTEPS_CHAOS_DELAY_MS        float, default 20 (max; uniform 0..max)
 
 Targeting (one-sided failure rehearsal — docs/robustness.md "healing
 flow"; all three compose):
@@ -194,6 +207,10 @@ class ChaosParams:
     disconnect: float = 0.0
     truncate: float = 0.0
     corrupt: float = 0.0
+    #: seeded single-bit flip past the fixed 32-byte header (frame ships
+    #: otherwise intact) — detectable ONLY by the CHECKSUM_FLAG integrity
+    #: plane (docs/robustness.md "Wire integrity")
+    payload_corrupt: float = 0.0
     delay: float = 0.0
     delay_ms: float = 20.0
     #: fault only frames with these header op codes (empty = all)
@@ -213,6 +230,7 @@ class ChaosParams:
             disconnect=_env_float("BYTEPS_CHAOS_DISCONNECT", 0.0),
             truncate=_env_float("BYTEPS_CHAOS_TRUNCATE", 0.0),
             corrupt=_env_float("BYTEPS_CHAOS_CORRUPT", 0.0),
+            payload_corrupt=_env_float("BYTEPS_CHAOS_PAYLOAD_CORRUPT", 0.0),
             delay=_env_float("BYTEPS_CHAOS_DELAY", 0.0),
             delay_ms=_env_float("BYTEPS_CHAOS_DELAY_MS", 20.0),
             ops=ops,
@@ -362,6 +380,22 @@ class ChaosSocket:
                 mangled = bytearray(data)
                 if mangled:
                     mangled[0] ^= 0xFF  # flip the magic → framing rejects it
+                self._sock.sendall(bytes(mangled))
+                return
+            roll -= p.corrupt
+            if roll < p.payload_corrupt:
+                # single-bit flip past the fixed 32-byte header (trace
+                # block / checksum field / payload — all covered by the
+                # CHECKSUM_FLAG CRC); a header-only frame has nothing to
+                # flip and passes through untouched without spending
+                # budget
+                if len(data) <= 32 or not _budget_allows():
+                    self._sock.sendall(data)
+                    return
+                self._bump("chaos_payload_corrupt", data)
+                mangled = bytearray(data)
+                idx = self._rng.randrange(32, len(mangled))
+                mangled[idx] ^= 1 << self._rng.randrange(8)
                 self._sock.sendall(bytes(mangled))
                 return
             if (p.delay > 0 and self._rng.random() < p.delay

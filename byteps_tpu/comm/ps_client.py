@@ -110,6 +110,19 @@ class _ServerConn:
         # receiver loops still running; the last one to exit runs the
         # mark_dead drain (see lane_exited)
         self._live_lanes = len(self.stripes)
+        #: per-server label value for counter slices (the book index the
+        #: conn was built for; "?" for stubs) — set by the caller
+        self.server_label = "?"
+        #: CRC mismatches across the whole striped connection (the
+        #: BYTEPS_CHECKSUM_CONN_LIMIT escalation tally)
+        self._ck_fails = 0
+
+    def note_checksum_fail(self) -> int:
+        """Account one checksum-rejected reply; returns the connection's
+        running mismatch total (cb_lock-guarded: lanes race)."""
+        with self.cb_lock:
+            self._ck_fails += 1
+            return self._ck_fails
 
     def lane_exited(self) -> bool:
         """Account one receiver loop's exit; True when it was the last.
@@ -214,6 +227,15 @@ class _NativeServerConn:
         self._lock = threading.Lock()
         self._cbs: Dict[int, tuple] = {}  # seq → (cb, sink keep-alive)
         self.dead = False
+        #: per-server label for counter slices (set by the caller)
+        self.server_label = "?"
+        # mirror of the C++ lanes' mismatch tally (fed by op=-3
+        # notifications) so the conn-limit escalation is counted here
+        # too — the lanes themselves read the same env at bpsc_create
+        from byteps_tpu.comm.transport import checksum_conn_limit
+
+        self._ck_fails = 0
+        self._ck_limit = checksum_conn_limit()
         self._on_zero_copy = on_zero_copy
         h = lib.bpsc_create(addr.encode(), port, kind, streams)
         if h < 0:
@@ -339,6 +361,27 @@ class _NativeServerConn:
 
     def _dispatch(self, op, seq, length, zc, off, key, cmd, version,
                   status, flags, arena, direct: Optional[bytes] = None) -> None:
+        if op == -3:
+            # checksum-mismatch notification from the native recv lanes
+            # (docs/robustness.md "Wire integrity"): the corrupt reply
+            # was dropped IN C++ before the demux and the pending entry
+            # stays registered (deadline/retry re-fetches) — this record
+            # only carries the count to the telemetry plane.  The
+            # corrupt frame's op rides in ``cmd``.
+            try:
+                opname = Op(cmd).name if cmd else "?"
+            except ValueError:
+                opname = str(cmd)
+            counters().bump("wire_checksum_fail", labels={
+                "side": "client", "op": opname,
+                "server": self.server_label,
+            })
+            self._ck_fails += 1
+            if self._ck_limit and self._ck_fails == self._ck_limit:
+                # the C++ lane breaks at exactly this count: record the
+                # quarantine once, like the Python recv lanes do
+                counters().bump("wire_checksum_conn_drop")
+            return
         with self._lock:
             if op < 0:  # the connection died with this seq pending
                 self.dead = True
@@ -634,7 +677,9 @@ class PSClient:
         metrics().gauge_set("control_plane_degraded", 0)
         self._server_addrs = [tuple(s) for s in book["servers"]]
         for host, port in self._server_addrs:
-            self._servers.append(self._new_conn(host, port))
+            sc = self._new_conn(host, port)
+            sc.server_label = str(len(self._servers))
+            self._servers.append(sc)
         self._install_routing(
             self._servers, book.get("server_ranks"),
             self._ownership_from_book(book),
@@ -1304,7 +1349,9 @@ class PSClient:
                     return
                 try:
                     for host, port in new_addrs[len(fresh):]:
-                        fresh.append(self._new_conn(host, port))
+                        sc = self._new_conn(host, port)
+                        sc.server_label = str(len(fresh))
+                        fresh.append(sc)
                     break
                 except OSError as e:
                     if attempt == 2:
@@ -2094,29 +2141,57 @@ class PSClient:
             t.start()
 
     def _recv_loop(self, sc: _ServerConn, sock) -> None:
-        from byteps_tpu.comm.transport import recv_header, recv_into
+        from byteps_tpu.comm.transport import (
+            checksum_conn_limit,
+            frame_checksum,
+            recv_header_ex,
+            recv_into,
+        )
 
+        ck_limit = checksum_conn_limit()
         try:
             while not self._stop.is_set():
                 try:
-                    op, status, flags, seq, key, cmd, version, length = (
-                        recv_header(sock)
-                    )
+                    (op, status, flags, seq, key, cmd, version, length,
+                     trace, crc) = recv_header_ex(sock)
                     # the callback is popped only AFTER the payload is
                     # fully received: dying mid-payload must leave it for
                     # mark_dead's cb(None) drain, never lose it
                     sink = sc.peek_sink(seq)
-                    if sink is not None and length == len(sink):
+                    zero_copied = sink is not None and length == len(sink)
+                    if zero_copied:
                         # zero-copy: the aggregated payload lands directly
                         # in the caller's result buffer — no intermediate
                         # bytes object, no frombuffer+slice copy
                         recv_into(sock, sink)
                         payload = _ZERO_COPIED
-                        self.zero_copy_pulls += 1
                     else:
                         payload = (
                             _recv_exact(sock, length) if length else b""
                         )
+                    if crc is not None and frame_checksum(
+                        trace, sink if zero_copied else payload
+                    ) != crc:
+                        # end-to-end wire integrity (docs/robustness.md):
+                        # a corrupted reply is DROPPED before the seq
+                        # demux — the callback stays registered so the
+                        # deadline/retry machinery re-fetches (a zero-
+                        # copy sink holding garbage is harmless: the
+                        # retried response overwrites it before the
+                        # caller ever wakes).  Repeated mismatches
+                        # poison the connection → revival re-dials.
+                        fails = sc.note_checksum_fail()
+                        counters().bump("wire_checksum_fail", labels={
+                            "side": "client",
+                            "op": getattr(op, "name", str(op)),
+                            "server": getattr(sc, "server_label", "?"),
+                        })
+                        if ck_limit and fails >= ck_limit:
+                            counters().bump("wire_checksum_conn_drop")
+                            return
+                        continue
+                    if zero_copied:
+                        self.zero_copy_pulls += 1
                 except (ConnectionError, OSError):
                     return
                 cb = sc.pop_cb(seq)
@@ -2244,6 +2319,7 @@ class PSClient:
             if self.cfg.rpc_deadline_s > 0 else 30.0
         )
         fresh = self._new_conn(host, port, dial_timeout)  # lock NOT held
+        fresh.server_label = str(idx)
         with self._rebuild_lock:
             servers = self._servers
             if (self._stop.is_set() or idx >= len(servers)

@@ -24,11 +24,29 @@ free on the request direction), a 16-byte block ``u64 trace_id + u64
 span_id`` follows the header, BEFORE the payload; ``length`` still
 counts only the payload.  Decoders that don't trace (the native C++
 engine) skip the block — old and new frames interoperate both ways.
+
+Optional end-to-end integrity (docs/robustness.md "Wire integrity"):
+when ``status`` carries ``CHECKSUM_FLAG`` (bit 6), a 4-byte big-endian
+CRC32C follows the header (after the trace block when both are
+present), BEFORE the payload.  The CRC covers EVERYTHING after the
+fixed 32-byte header except itself — the trace block and the whole
+payload (fused member blocks, span trailer, compressed bytes included)
+— so a single flipped payload bit that TCP's 16-bit checksum missed is
+detected at the receiver before the frame reaches any sum core or
+demux.  Stamping is opt-in per process (``BYTEPS_WIRE_CHECKSUM=1``,
+data-plane ops only — control frames stay byte-identical);
+verification is self-describing: any receiver that sees the flag
+checks it.  A mismatch is a DROP (:class:`ChecksumError` after the
+stream is fully consumed — framing survives), healed by the ordinary
+deadline/retry + exactly-once-ledger machinery; repeated mismatches on
+one connection escalate to teardown (``BYTEPS_CHECKSUM_CONN_LIMIT``)
+so connection revival re-dials a possibly-bad path.
 """
 
 from __future__ import annotations
 
 import enum
+import os
 import socket
 import struct
 import threading
@@ -44,6 +62,32 @@ TRACE_FLAG = 0x80
 _TRACE_FMT = "!QQ"
 TRACE_SIZE = struct.calcsize(_TRACE_FMT)
 assert TRACE_SIZE == 16
+
+#: status-byte bit: a 4-byte big-endian CRC32C of (trace block + payload)
+#: follows the header (after the trace block), BEFORE the payload
+CHECKSUM_FLAG = 0x40
+_CHECKSUM_FMT = "!I"
+CHECKSUM_SIZE = struct.calcsize(_CHECKSUM_FMT)
+assert CHECKSUM_SIZE == 4
+
+
+class ChecksumError(ValueError):
+    """A frame's CRC32C did not match its bytes — payload corruption the
+    framing layer cannot see.  Raised AFTER the frame is fully consumed,
+    so the stream stays framed and the caller may keep the connection
+    (drop semantics: discard the frame, let deadlines/retries heal it).
+    A ``ValueError`` subclass so callers that treat malformed bodies as
+    retryable failures (migration shipping, control decode guards)
+    already do the right thing."""
+
+    def __init__(self, op, expected: int, got: int) -> None:
+        super().__init__(
+            f"wire checksum mismatch on {getattr(op, 'name', op)} frame: "
+            f"expected {expected:#010x}, computed {got:#010x}"
+        )
+        self.op = op
+        self.expected = expected
+        self.got = got
 
 
 class Op(enum.IntEnum):
@@ -86,10 +130,120 @@ class Op(enum.IntEnum):
                         # header ``version`` carries the new map epoch
 
 
+# --- end-to-end wire integrity (CHECKSUM_FLAG) ----------------------------
+#
+# CRC32C (Castagnoli, the iSCSI/ext4 polynomial — hardware-accelerated on
+# every server CPU this decade, and the one UCCL-Zip-style lossless wire
+# transforms standardize on) over everything after the fixed header.
+# The Python side prefers the shared C implementation in native/wire.h
+# (``bps_wire_crc32c`` via ctypes — the SAME code the C++ engines stamp
+# and verify with, so the two sides cannot drift) and falls back to a
+# table-driven pure-Python loop when the lib isn't built.
+
+#: ops that carry a checksum when BYTEPS_WIRE_CHECKSUM=1 — the data
+#: plane only; control frames (scheduler link, PING/SHUTDOWN/QUERY)
+#: stay byte-identical so arming the knob never perturbs the control
+#: wire (mirrored by wire.h checksum_op — change both together)
+_CHECKSUM_OPS = frozenset({10, 11, 12, 13, 14, 23, 24, 25, 26})
+
+_TRUTHY_OFF = ("", "0", "false", "no", "off")
+
+
+def wire_checksum_enabled() -> bool:
+    """Stamp outgoing data-plane frames with CRC32C?  Read from
+    ``BYTEPS_WIRE_CHECKSUM`` on every call (a dict lookup — cheap against
+    a frame encode) so tests toggling the env need no cache reset.
+    Verification is NOT gated on this: any received frame carrying
+    ``CHECKSUM_FLAG`` is checked."""
+    return os.environ.get("BYTEPS_WIRE_CHECKSUM", "").lower() not in _TRUTHY_OFF
+
+
+def checksum_conn_limit() -> int:
+    """Mismatches tolerated on one connection before the receiver tears
+    it down (``BYTEPS_CHECKSUM_CONN_LIMIT``, default 8; 0 = never) —
+    the escalation from "one flipped bit, drop and retry" to "this path
+    is corrupting repeatedly, revive the connection"."""
+    v = os.environ.get("BYTEPS_CHECKSUM_CONN_LIMIT", "")
+    try:
+        n = int(v) if v else 8
+    except ValueError:
+        return 8
+    # negatives/garbage = default, matching wire.h checksum_env_conn_limit
+    # (a negative here would mean "drop on the FIRST mismatch" — the
+    # opposite of what -1 conventionally asks for)
+    return n if n >= 0 else 8
+
+
+_CRC32C_POLY = 0x82F63B78
+_crc_table: Optional[list] = None
+#: ctypes fast path through native/wire.h crc32c (None = unresolved,
+#: False = lib unavailable — pure-Python table takes over)
+_crc_native = None
+
+
+def _crc32c_table() -> list:
+    global _crc_table
+    if _crc_table is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ (_CRC32C_POLY if c & 1 else 0)
+            tbl.append(c)
+        _crc_table = tbl
+    return _crc_table
+
+
+def _resolve_crc_native():
+    global _crc_native
+    try:
+        from byteps_tpu.native import get_lib
+
+        lib = get_lib()
+        if lib is not None and hasattr(lib, "bps_wire_crc32c"):
+            _crc_native = lib.bps_wire_crc32c
+        else:
+            _crc_native = False
+    except Exception:  # noqa: BLE001 — any import/build issue → fallback
+        _crc_native = False
+    return _crc_native
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C of ``data`` (bytes / bytearray / memoryview / ndarray),
+    chained: ``crc32c(b, crc32c(a)) == crc32c(a + b)``.  Uses the shared
+    native implementation when the lib is built (the data plane's
+    actual cost), pure Python otherwise."""
+    native = _crc_native if _crc_native is not None else _resolve_crc_native()
+    n = len(data)
+    if not n:
+        return crc
+    if native:
+        import numpy as _np
+
+        a = _np.frombuffer(data, dtype=_np.uint8)  # no-copy view
+        return int(native(a.ctypes.data, n, crc))
+    tbl = _crc32c_table()
+    c = crc ^ 0xFFFFFFFF
+    for b in bytes(data):
+        c = (c >> 8) ^ tbl[(c ^ b) & 0xFF]
+    return c ^ 0xFFFFFFFF
+
+
+def frame_checksum(trace: Optional[Tuple[int, int]], payload) -> int:
+    """The CRC32C a frame's checksum block must carry: everything after
+    the fixed header except the block itself — the 16-byte trace block
+    (when present) chained with the payload bytes."""
+    crc = 0
+    if trace is not None:
+        crc = crc32c(struct.pack(_TRACE_FMT, trace[0], trace[1]))
+    return crc32c(payload, crc)
+
+
 class Message:
     __slots__ = (
         "op", "status", "flags", "seq", "key", "cmd", "version", "payload",
-        "trace",
+        "trace", "checksum",
     )
 
     def __init__(
@@ -103,6 +257,7 @@ class Message:
         status: int = 0,
         flags: int = 0,
         trace: Optional[Tuple[int, int]] = None,
+        checksum: Optional[bool] = None,
     ) -> None:
         self.op = op
         self.status = status
@@ -115,13 +270,26 @@ class Message:
         #: optional (trace_id, span_id) propagated in the trace-context
         #: header field (docs/observability.md); None = untraced frame
         self.trace = trace
+        #: stamp a CHECKSUM_FLAG CRC32C block?  None (default) = follow
+        #: BYTEPS_WIRE_CHECKSUM for data-plane ops; True/False force it
+        #: (golden fixtures / fuzzing)
+        self.checksum = checksum
+
+    def _stamp_checksum(self) -> bool:
+        ck = self.checksum
+        if ck is None:
+            return int(self.op) in _CHECKSUM_OPS and wire_checksum_enabled()
+        return bool(ck)
 
     def encode_header(self) -> bytes:
+        ck = self._stamp_checksum()
         hdr = struct.pack(
             HEADER_FMT,
             MAGIC,
             int(self.op),
-            self.status | (TRACE_FLAG if self.trace is not None else 0),
+            self.status
+            | (TRACE_FLAG if self.trace is not None else 0)
+            | (CHECKSUM_FLAG if ck else 0),
             self.flags,
             self.seq,
             self.key,
@@ -131,6 +299,12 @@ class Message:
         )
         if self.trace is not None:
             hdr += struct.pack(_TRACE_FMT, self.trace[0], self.trace[1])
+        if ck:
+            # computed once per frame per side; the scatter-gather send
+            # below ships [header+trace+crc, payload] unchanged
+            hdr += struct.pack(
+                _CHECKSUM_FMT, frame_checksum(self.trace, self.payload)
+            )
         return hdr
 
     def encode(self) -> bytes:
@@ -157,11 +331,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def recv_header_ex(sock: socket.socket) -> tuple:
-    """Read + parse one header, trace-context aware; returns
-    (op, status, flags, seq, key, cmd, version, length, trace) where
-    ``trace`` is (trace_id, span_id) or None.  The TRACE_FLAG bit is
-    consumed here — ``status`` comes back clean, so frames from tracing
-    and non-tracing peers are indistinguishable downstream."""
+    """Read + parse one header, trace- and checksum-aware; returns
+    (op, status, flags, seq, key, cmd, version, length, trace, crc)
+    where ``trace`` is (trace_id, span_id) or None and ``crc`` is the
+    frame's CHECKSUM_FLAG CRC32C or None.  Both flag bits are consumed
+    here — ``status`` comes back clean, so frames from stamping and
+    non-stamping peers are indistinguishable downstream.  The caller
+    that receives the payload owns verification (:func:`verify_checksum`
+    / :func:`recv_message`)."""
     hdr = _recv_exact(sock, HEADER_SIZE)
     magic, op, status, flags, seq, key, cmd, version, length = struct.unpack(
         HEADER_FMT, hdr
@@ -172,20 +349,44 @@ def recv_header_ex(sock: socket.socket) -> tuple:
     if status & TRACE_FLAG:
         trace = struct.unpack(_TRACE_FMT, _recv_exact(sock, TRACE_SIZE))
         status &= ~TRACE_FLAG
-    return Op(op), status, flags, seq, key, cmd, version, length, trace
+    crc = None
+    if status & CHECKSUM_FLAG:
+        (crc,) = struct.unpack(_CHECKSUM_FMT, _recv_exact(sock, CHECKSUM_SIZE))
+        status &= ~CHECKSUM_FLAG
+    return Op(op), status, flags, seq, key, cmd, version, length, trace, crc
 
 
 def recv_header(sock: socket.socket) -> tuple:
     """Read + parse one header; returns
     (op, status, flags, seq, key, cmd, version, length).  Any trace
-    context on the frame is read off the stream and dropped (the
-    optional-on-decode guarantee: a non-tracing consumer stays framed)."""
+    context or checksum block on the frame is read off the stream and
+    dropped (the optional-on-decode guarantee: a non-verifying consumer
+    stays framed)."""
     return recv_header_ex(sock)[:8]
 
 
+def verify_checksum(crc: Optional[int], trace: Optional[Tuple[int, int]],
+                    payload, op=None) -> None:
+    """Check a received frame's CRC32C against its bytes; no-op for
+    unstamped frames (``crc`` None).  Raises :class:`ChecksumError` on
+    mismatch — the frame is already fully consumed, so the caller may
+    drop it and keep reading the stream."""
+    if crc is None:
+        return
+    got = frame_checksum(trace, payload)
+    if got != crc:
+        raise ChecksumError(op, crc, got)
+
+
 def recv_message(sock: socket.socket) -> Message:
-    op, status, flags, seq, key, cmd, version, length, trace = recv_header_ex(sock)
+    """Receive one frame; verifies the CHECKSUM_FLAG CRC32C when the
+    sender stamped one (raising :class:`ChecksumError` AFTER the frame
+    is consumed — drop semantics, the stream stays framed)."""
+    op, status, flags, seq, key, cmd, version, length, trace, crc = (
+        recv_header_ex(sock)
+    )
     payload = _recv_exact(sock, length) if length else b""
+    verify_checksum(crc, trace, payload, op=op)
     return Message(
         op, key=key, payload=payload, seq=seq, cmd=cmd, version=version,
         status=status, flags=flags, trace=trace,

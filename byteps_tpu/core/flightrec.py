@@ -21,8 +21,11 @@ profiler.  This module closes the loop:
   ``straggler_server`` (one rank's RPC p99 ≫ the median of its peers),
   ``hot_stripe`` (one native reducer's sum time ≫ its siblings, fed
   from ``native_stripe_sum_seconds{stripe}``), ``queue_stall`` (a
-  stage's dwell p99 past ``BYTEPS_FLIGHT_STALL_S``), and
-  ``degraded_flip`` (``control_plane_degraded`` 0→1).  A firing rule
+  stage's dwell p99 past ``BYTEPS_FLIGHT_STALL_S``),
+  ``degraded_flip`` (``control_plane_degraded`` 0→1), and
+  ``corruption_storm`` (a burst of ``wire_checksum_fail`` rejections or
+  a connection dropped over its mismatch limit — docs/robustness.md
+  "Wire integrity").  A firing rule
   bumps ``flight_trigger{rule}`` and dumps a rate-limited **diagnostic
   bundle** directory (``BYTEPS_FLIGHT_DIR``): the full ledger as
   JSONL, a metrics snapshot, config/env state, the trigger evidence,
@@ -62,7 +65,9 @@ EVENT_COUNTERS = (
     "degraded_jobs", "push_dedup", "rpc_deadline_expired", "rpc_retry",
     "rpc_giveup", "conn_revive",
     "chaos_drop", "chaos_delay", "chaos_disconnect", "chaos_truncate",
-    "chaos_corrupt",
+    "chaos_corrupt", "chaos_payload_corrupt",
+    "wire_checksum_fail", "wire_checksum_conn_drop",
+    "native_checksum_fail", "native_checksum_conn_drop",
 )
 
 #: histogram families whose per-label deltas feed the record (and the
@@ -544,6 +549,33 @@ def _rule_slo_breach(rec: "FlightRecorder", r: dict) -> Optional[dict]:
     }
 
 
+#: checksum-mismatch deltas in ONE step/beat record at or above this
+#: fire corruption_storm — a single flipped bit is the retry machinery's
+#: job, a burst means the path itself is bad (NIC/DRAM going)
+_CORRUPT_STORM_MIN = 3
+
+
+def _rule_corruption_storm(rec: "FlightRecorder", r: dict) -> Optional[dict]:
+    """Wire-integrity rejections are BURSTING (docs/robustness.md "Wire
+    integrity"): many CRC32C mismatches landed inside one step/beat
+    window, or a connection blew through its mismatch limit — a bad
+    NIC/link is actively flipping bits, not a one-off cosmic ray."""
+    ev = r.get("events") or {}
+    # both engines: the C++ engine's rejections surface as native_* via
+    # the counter-provider seam, same window, same record
+    fails = (ev.get("wire_checksum_fail", 0)
+             + ev.get("native_checksum_fail", 0))
+    drops = (ev.get("wire_checksum_conn_drop", 0)
+             + ev.get("native_checksum_conn_drop", 0))
+    if fails < _CORRUPT_STORM_MIN and not drops:
+        return None
+    return {
+        "checksum_fails": fails,
+        "conn_drops": drops,
+        "injected": ev.get("chaos_payload_corrupt", 0),
+    }
+
+
 _RULES: Tuple[Tuple[str, Callable], ...] = (
     ("slow_step", _rule_slow_step),
     ("straggler_server", _rule_straggler_server),
@@ -551,6 +583,7 @@ _RULES: Tuple[Tuple[str, Callable], ...] = (
     ("queue_stall", _rule_queue_stall),
     ("degraded_flip", _rule_degraded_flip),
     ("slo_breach", _rule_slo_breach),
+    ("corruption_storm", _rule_corruption_storm),
 )
 
 

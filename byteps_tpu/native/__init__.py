@@ -196,6 +196,21 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     if hasattr(lib, "bps_wire_ring_hash"):
         lib.bps_wire_ring_hash.argtypes = [c.c_uint64]
         lib.bps_wire_ring_hash.restype = c.c_uint64
+    # end-to-end wire integrity (docs/robustness.md "Wire integrity"):
+    # the shared CRC32C (transport.py's ctypes fast path) + the
+    # checksummed golden shims — may be absent in a stale .so; the
+    # pure-Python CRC takes over and the golden lanes skip
+    if hasattr(lib, "bps_wire_crc32c"):
+        lib.bps_wire_crc32c.argtypes = [c.c_void_p, c.c_uint64, c.c_uint32]
+        lib.bps_wire_crc32c.restype = c.c_uint32
+        lib.bps_wire_golden_checksum.argtypes = [c.c_void_p, c.c_uint64]
+        lib.bps_wire_golden_checksum.restype = c.c_int64
+        lib.bps_wire_client_frame_ck.argtypes = [
+            c.c_int32, c.c_uint32, c.c_uint64, c.c_uint32, c.c_uint32,
+            c.c_uint32, c.c_uint64, c.c_uint64, c.c_void_p, c.c_uint64,
+            c.c_void_p, c.c_uint64,
+        ]
+        lib.bps_wire_client_frame_ck.restype = c.c_int64
     # native worker client data plane (ps_client.cc) — may be absent in a
     # stale .so; the pure-Python client covers every van without it
     if hasattr(lib, "bpsc_create"):
@@ -245,10 +260,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None  # corrupt/partial .so → pure-Python fallbacks
-    if not hasattr(lib, "bps_native_server_set_ownership") and autobuild:
+    if not hasattr(lib, "bps_wire_crc32c") and autobuild:
         # stale library from before the newest entry points (currently
-        # the elastic resharding plane: ownership map + WRONG_OWNER
-        # replies): rebuild, then
+        # the end-to-end wire-integrity plane: shared CRC32C + the
+        # checksummed golden shims): rebuild, then
         # load via a temp COPY — dlopen dedups by path/inode, so
         # reloading the original path can hand back the old mapping
         _try_build()
@@ -262,7 +277,7 @@ def _load() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy(_LIB_PATH, tmp.name)
             fresh = ctypes.CDLL(tmp.name)
-            if hasattr(fresh, "bps_native_server_stripe_queue_depths"):
+            if hasattr(fresh, "bps_wire_crc32c"):
                 lib = fresh
         except OSError:
             pass
@@ -294,6 +309,8 @@ NATIVE_COUNTER_NAMES = (
     "native_wrong_owner",
     "native_job_reject",
     "native_async_reject",
+    "native_checksum_fail",
+    "native_checksum_conn_drop",
 )
 
 

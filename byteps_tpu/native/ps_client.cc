@@ -60,6 +60,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <strings.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <sys/un.h>
@@ -91,6 +92,7 @@ uint64_t steady_ns() {
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
+
 
 typedef void (*bpsc_cb_t)(void* ctx, int32_t op, int32_t status,
                           uint32_t flags, uint32_t seq, uint64_t key,
@@ -230,6 +232,14 @@ struct NativeClient {
   bool dead = false;  // set by the LAST lane to exit (after the drain)
   int live_lanes = 0;
 
+  // end-to-end wire integrity (docs/robustness.md "Wire integrity"):
+  // stamp outgoing data-plane frames (BYTEPS_WIRE_CHECKSUM, read at
+  // create) and verify any response carrying kChecksumFlag; mismatches
+  // across the whole striped connection count toward the teardown limit
+  bool checksum_on = false;
+  uint32_t ck_conn_limit = 8;
+  std::atomic<uint32_t> ck_fails{0};
+
   // completion queue (batched delivery; see file header)
   std::mutex cq_mu;
   std::deque<Completion> cq;
@@ -297,10 +307,25 @@ struct NativeClient {
       // sees a clean status — the same optional-on-decode guarantee the
       // Python client's recv_header_ex gives (the native client stamps
       // no spans; ROADMAP keeps that as follow-up).
+      uint8_t trace_ctx[16];
+      bool have_trace = false;
       if (h.status & bps_wire::kTraceFlag) {
-        uint8_t trace_ctx[16];
         if (!cli_recv_exact(lane->fd, trace_ctx, sizeof(trace_ctx))) break;
         h.status &= static_cast<uint8_t>(~bps_wire::kTraceFlag);
+        have_trace = true;
+      }
+      // Optional end-to-end checksum (transport.py CHECKSUM_FLAG):
+      // consume the 4-byte CRC32C and verify once the payload landed —
+      // BEFORE the completion reaches the seq demux.
+      uint32_t want_crc = 0;
+      bool have_ck = false;
+      if (h.status & bps_wire::kChecksumFlag) {
+        uint8_t ckb[4];
+        if (!cli_recv_exact(lane->fd, ckb, sizeof(ckb))) break;
+        std::memcpy(&want_crc, ckb, 4);
+        want_crc = ntohl(want_crc);
+        h.status &= static_cast<uint8_t>(~bps_wire::kChecksumFlag);
+        have_ck = true;
       }
       Completion m{};
       m.op = h.op;
@@ -323,15 +348,19 @@ struct NativeClient {
           t_send_ns = it->second.t_send_ns;
         }
       }
+      const uint8_t* body = nullptr;
       if (m.len) {
         if (sink && sink_len == m.len) {
           // zero-copy: the response lands directly in the caller's
           // registered buffer (ZPull-into-SArray parity); the queued
           // record carries no bytes.  The sink stays valid until the
           // drain delivers this record: Python's keep-alive is dropped
-          // only by the per-record dispatch.
+          // only by the per-record dispatch.  A checksum-rejected frame
+          // may have written garbage into the sink — harmless: the
+          // completion never fires, and the retried response overwrites.
           if (!cli_recv_exact(lane->fd, sink, m.len)) break;
           m.zc = 1;
+          body = sink;
         } else {
           // entry-owned payload: each completion is a fresh vector (the
           // queue outlives this loop iteration), so the old per-lane
@@ -339,6 +368,26 @@ struct NativeClient {
           // gone by construction
           m.payload.resize(m.len);
           if (!cli_recv_exact(lane->fd, m.payload.data(), m.len)) break;
+          body = m.payload.data();
+        }
+      }
+      if (have_ck) {
+        uint32_t crc = have_trace ? bps_wire::crc32c(trace_ctx, 16) : 0;
+        crc = bps_wire::crc32c(body, (size_t)m.len, crc);
+        if (crc != want_crc) {
+          // DROP: the pending entry stays registered (the deadline/
+          // retry machinery owns healing), and Python is told via an
+          // op=-3 notification record (counted, never demuxed — the
+          // corrupt frame's op rides in cmd for the label)
+          uint32_t fails = ck_fails.fetch_add(1, std::memory_order_relaxed) + 1;
+          Completion note{};
+          note.op = -3;
+          note.seq = m.seq;
+          note.cmd = m.op >= 0 ? (uint32_t)m.op : 0;
+          push_completion(std::move(note));
+          if (ck_conn_limit && fails >= ck_conn_limit)
+            break;  // repeated corruption: poison the conn → revival
+          continue;
         }
       }
       // un-register only AFTER the payload is fully received: dying
@@ -371,24 +420,24 @@ std::shared_ptr<NativeClient> cli_for(int64_t id) {
   return it == g_clients.end() ? nullptr : it->second;
 }
 
-// Build the pre-payload part of one outgoing frame into out (32-byte
-// header, plus the 16-byte trace-context block when trace_id != 0 —
-// trace ids are nonzero by construction, tracing.new_trace_id).  The
-// ONE encode path bpsc_send and the golden-fixture shim
-// (bps_wire_client_frame) share, so the live client encoder is what the
-// byte-exact fixtures pin.  Returns the byte count (32 or 48).
-size_t build_frame_head(uint8_t out[48], int32_t op, uint32_t seq,
-                        uint64_t key, uint32_t cmd, uint32_t version,
-                        uint32_t flags, uint64_t len, uint64_t trace_id,
-                        uint64_t span_id) {
-  Header hd;
-  uint8_t status = trace_id ? bps_wire::kTraceFlag : 0;
-  bps_wire::pack_header(&hd, (uint8_t)op, status, (uint8_t)flags, seq, key,
-                        cmd, version, len);
-  std::memcpy(out, &hd, sizeof(hd));
-  if (!trace_id) return sizeof(hd);
-  bps_wire::pack_trace(out + sizeof(hd), trace_id, span_id);
-  return sizeof(hd) + 16;
+// Build the pre-payload part of one outgoing frame into out: 32-byte
+// header, plus the 16-byte trace-context block when trace_id != 0
+// (trace ids are nonzero by construction, tracing.new_trace_id), plus
+// the 4-byte CRC32C block when checksumming (BYTEPS_WIRE_CHECKSUM) —
+// all through the shared wire.h build_head, the SAME encoder the
+// native server's send_msg uses.  The ONE encode path bpsc_send and
+// the golden-fixture shims (bps_wire_client_frame / _ck) share, so the
+// live client encoder is what the byte-exact fixtures pin.  Returns
+// the byte count (32..52).
+size_t build_frame_head(uint8_t out[bps_wire::kMaxHeadLen], int32_t op,
+                        uint32_t seq, uint64_t key, uint32_t cmd,
+                        uint32_t version, uint32_t flags,
+                        const void* payload, uint64_t len, uint64_t trace_id,
+                        uint64_t span_id, bool checksum) {
+  return bps_wire::build_head(out, (uint8_t)op, /*base_status=*/0,
+                              (uint8_t)flags, seq, key, cmd, version, payload,
+                              len, trace_id, span_id,
+                              checksum && bps_wire::checksum_op((uint8_t)op));
 }
 
 }  // namespace
@@ -398,6 +447,10 @@ extern "C" {
 int64_t bpsc_create(const char* host, int32_t port, int32_t kind,
                     int32_t streams) {
   auto c = std::make_shared<NativeClient>();
+  // the shared wire.h parsers (transport.py truthiness), read at
+  // create so tests toggling the env between connections see it
+  c->checksum_on = bps_wire::checksum_env_on();
+  c->ck_conn_limit = bps_wire::checksum_env_conn_limit();
   if (streams < 1) streams = 1;
   if (kind == 1) streams = 1;  // parity with the Python client: stripe tcp only
   for (int i = 0; i < streams; ++i) {
@@ -460,9 +513,10 @@ int32_t bpsc_send2(int64_t h, int32_t op, uint32_t seq, uint64_t key,
   // same path: the native client is payload-agnostic, so the fused
   // pack and recovery-plane routing in comm/ps_client.py work over
   // either client implementation)
-  uint8_t head[48];
+  uint8_t head[bps_wire::kMaxHeadLen];
   size_t head_len = build_frame_head(head, op, seq, key, cmd, version, flags,
-                                     len, trace_id, span_id);
+                                     payload, len, trace_id, span_id,
+                                     c->checksum_on);
   // per-attempt latency starts at the send, transport included —
   // re-stamped on every retry attempt (the Python client's t_sent
   // placement); registered seq only, control sends have no entry
@@ -535,14 +589,43 @@ int64_t bps_wire_client_frame(int32_t op, uint32_t seq, uint64_t key,
                               uint64_t trace_id, uint64_t span_id,
                               const uint8_t* payload, uint64_t len,
                               uint8_t* out, uint64_t cap) {
-  uint8_t head[48];
+  uint8_t head[bps_wire::kMaxHeadLen];
   size_t head_len = build_frame_head(head, op, seq, key, cmd, version, flags,
-                                     len, trace_id, span_id);
+                                     payload, len, trace_id, span_id,
+                                     /*checksum=*/false);
   uint64_t total = head_len + len;
   if (total > cap) return -(int64_t)total;
   std::memcpy(out, head, head_len);
   if (len) std::memcpy(out + head_len, payload, len);
   return (int64_t)total;
+}
+
+// Checksummed twin of bps_wire_client_frame: the same LIVE encode path
+// with BYTEPS_WIRE_CHECKSUM semantics forced on — what the checksummed
+// golden stream (tests/test_wire_golden.py CHECKSUM_GOLDEN_SHA256)
+// pins against transport.py.  A separate symbol so the original shim's
+// bytes (and its callers' bound signature) never change.
+int64_t bps_wire_client_frame_ck(int32_t op, uint32_t seq, uint64_t key,
+                                 uint32_t cmd, uint32_t version,
+                                 uint32_t flags, uint64_t trace_id,
+                                 uint64_t span_id, const uint8_t* payload,
+                                 uint64_t len, uint8_t* out, uint64_t cap) {
+  uint8_t head[bps_wire::kMaxHeadLen];
+  size_t head_len = build_frame_head(head, op, seq, key, cmd, version, flags,
+                                     payload, len, trace_id, span_id,
+                                     /*checksum=*/true);
+  uint64_t total = head_len + len;
+  if (total > cap) return -(int64_t)total;
+  std::memcpy(out, head, head_len);
+  if (len) std::memcpy(out + head_len, payload, len);
+  return (int64_t)total;
+}
+
+// The shared CRC32C through the LIVE wire.h implementation — the ctypes
+// fast path transport.py crc32c() rides, and the parity anchor the
+// integrity tests pin the pure-Python fallback against.
+uint32_t bps_wire_crc32c(const void* data, uint64_t n, uint32_t crc) {
+  return bps_wire::crc32c(data, (size_t)n, crc);
 }
 
 int64_t bpsc_drain(int64_t h, void* recs_out, int64_t max_recs,

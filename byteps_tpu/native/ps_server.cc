@@ -27,6 +27,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <strings.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -125,6 +126,10 @@ enum NativeCounter {
   kCtrJobReject,      // job-namespaced frames refused (multi-tenant is
                       // Python-engine-only; docs/async.md)
   kCtrAsyncReject,    // async-profile INITs refused (no async plane)
+  kCtrChecksumFail,   // frames dropped on a CRC32C mismatch (end-to-end
+                      // wire integrity; docs/robustness.md)
+  kCtrChecksumConnDrop,  // connections dropped after
+                         // BYTEPS_CHECKSUM_CONN_LIMIT mismatches
   kCtrCount,
 };
 
@@ -138,6 +143,7 @@ const char* const kCounterNames[kCtrCount] = {
     "native_push_dedup",      "native_init_replay_ack",
     "native_resync_query",    "native_zombie_reject", "native_span_drop",
     "native_wrong_owner",     "native_job_reject",    "native_async_reject",
+    "native_checksum_fail",   "native_checksum_conn_drop",
 };
 
 // ---------------------------------------------------------------------------
@@ -1448,6 +1454,12 @@ class NativeServer {
     async_ = enable_async;
     const char* sch = getenv("BYTEPS_SERVER_ENABLE_SCHEDULE");
     schedule_ = sch && atoi(sch) != 0;
+    // end-to-end wire integrity (docs/robustness.md "Wire integrity"):
+    // stamp replies + tolerate BYTEPS_CHECKSUM_CONN_LIMIT mismatches
+    // per connection before dropping it (shared wire.h parsers —
+    // transport.py truthiness)
+    checksum_on_ = bps_wire::checksum_env_on();
+    ck_conn_limit_ = bps_wire::checksum_env_conn_limit();
     // BYTEPS_SERVER_STRIPES: reducer-thread count the key space shards
     // across.  Default min(4, cores): below 4 cores more stripes only
     // buy context switching; above, 4 reducers already saturate the
@@ -1532,12 +1544,18 @@ class NativeServer {
   void send_msg(const ConnPtr& conn, uint8_t op, uint32_t seq, uint64_t key,
                 uint32_t version, const uint8_t* payload, uint64_t len,
                 uint8_t status = 0) {
-    Header h;
-    pack_header(&h, op, status, /*flags=*/0, seq, key, /*cmd=*/0, version, len);
+    // shared wire.h head builder: header + (with BYTEPS_WIRE_CHECKSUM)
+    // the 4-byte CRC32C over the payload — the SAME encode path the
+    // native client and the golden shims use, computed once per frame
+    uint8_t head[bps_wire::kMaxHeadLen];
+    size_t head_len = bps_wire::build_head(
+        head, op, status, /*flags=*/0, seq, key, /*cmd=*/0, version, payload,
+        len, /*trace_id=*/0, /*span_id=*/0,
+        checksum_on_ && bps_wire::checksum_op(op));
     // per-connection write mutex lives IN the Conn, so concurrent engine
     // threads serialize against each other for exactly this stream
     std::lock_guard<std::mutex> g(conn->write_mu);
-    if (!conn->send_all(&h, sizeof(h))) return;
+    if (!conn->send_all(head, head_len)) return;
     if (len) conn->send_all(payload, len);
   }
 
@@ -1665,6 +1683,7 @@ class NativeServer {
 
   void serve_inner(const ConnPtr& conn) {
     std::vector<uint8_t> payload;
+    uint32_t ck_fails = 0;  // per-connection mismatch tally (escalation)
     while (!stop_.load()) {
       Header h;
       if (!conn->recv_exact(&h, sizeof(h))) { NDBG("serve: header recv failed"); break; }
@@ -1675,16 +1694,36 @@ class NativeServer {
       // after the header.  The block is always consumed (the stream
       // must stay framed), but decoded into span context only when the
       // span plane is on — with BYTEPS_TRACE_SPANS=0 this is one
-      // relaxed atomic load and no ring ever sees a write.
+      // relaxed atomic load and no ring ever sees a write.  The raw
+      // bytes are kept: the frame checksum covers them.
       uint64_t trace_id = 0, span_id = 0;
+      uint8_t trace_ctx[16];
+      bool have_trace = false;
       if (h.status & kTraceFlag) {
-        uint8_t trace_ctx[16];
         if (!conn->recv_exact(trace_ctx, sizeof(trace_ctx))) {
           NDBG("serve: trace-context recv failed");
           break;
         }
+        have_trace = true;
         if (tracing()) bps_wire::unpack_trace(trace_ctx, &trace_id, &span_id);
         h.status &= static_cast<uint8_t>(~kTraceFlag);
+      }
+      // Optional end-to-end checksum (transport.py CHECKSUM_FLAG):
+      // consume the 4-byte CRC32C block; verified below once the
+      // payload landed — BEFORE anything reaches a stripe ring or sum
+      // core (docs/robustness.md "Wire integrity").
+      uint32_t want_crc = 0;
+      bool have_ck = false;
+      if (h.status & bps_wire::kChecksumFlag) {
+        uint8_t ckb[4];
+        if (!conn->recv_exact(ckb, sizeof(ckb))) {
+          NDBG("serve: checksum recv failed");
+          break;
+        }
+        std::memcpy(&want_crc, ckb, 4);
+        want_crc = ntohl(want_crc);
+        h.status &= static_cast<uint8_t>(~bps_wire::kChecksumFlag);
+        have_ck = true;
       }
 
       uint32_t seq = ntohl(h.seq);
@@ -1694,6 +1733,24 @@ class NativeServer {
       uint64_t len = be64toh(h.length);
       payload.resize(len);
       if (len && !conn->recv_exact(payload.data(), len)) break;
+      if (have_ck) {
+        uint32_t crc = have_trace ? bps_wire::crc32c(trace_ctx, 16) : 0;
+        crc = bps_wire::crc32c(payload.data(), payload.size(), crc);
+        if (crc != want_crc) {
+          // DROP: no reply, no state touched — the sender's deadline/
+          // retry + the exactly-once ledger heal it bitwise.  Repeated
+          // mismatches mean the path itself is bad: close the conn so
+          // the client's revival re-dials.
+          ctr_[kCtrChecksumFail].fetch_add(1, std::memory_order_relaxed);
+          if (ck_conn_limit_ && ++ck_fails >= ck_conn_limit_) {
+            NDBG("serve: %u checksum mismatches — dropping conn", ck_fails);
+            ctr_[kCtrChecksumConnDrop].fetch_add(1,
+                                                 std::memory_order_relaxed);
+            break;
+          }
+          continue;
+        }
+      }
       // Multi-tenant fence (docs/async.md): keys carry their job id in
       // the top 16 bits, and this engine has no per-job round sizing,
       // QoS weighting, or admission metering — summing an unknown
@@ -2584,6 +2641,11 @@ class NativeServer {
   // stripes=1 fast path: handlers run inline on the serve threads (no
   // reducer threads, no ring hop) — set once in start_engine
   bool inline_exec_ = false;
+  // end-to-end wire integrity (docs/robustness.md "Wire integrity"):
+  // BYTEPS_WIRE_CHECKSUM / BYTEPS_CHECKSUM_CONN_LIMIT, read once in
+  // start_engine
+  bool checksum_on_ = false;
+  uint32_t ck_conn_limit_ = 8;
   std::vector<std::unique_ptr<Stripe>> stripes_;
   // EF residual lr (workers broadcast optimizer lr; default 1.0)
   std::atomic<float> ef_lr_{1.0f};
@@ -2935,6 +2997,83 @@ int64_t bps_wire_golden_compressed(uint8_t* out, uint64_t cap) {
       "byteps_compressor_type=onebit\nbyteps_ef_type=vanilla";
   put_header(kRegisterCompressor, 0, 0, 32, 301, 0, 0, sizeof(reg) - 1);
   put_bytes(reg, sizeof(reg) - 1);
+  if (buf.size() > cap) return -(int64_t)buf.size();
+  std::memcpy(out, buf.data(), buf.size());
+  return (int64_t)buf.size();
+}
+
+// Checksummed-frame fixture stream (docs/robustness.md "Wire
+// integrity"): the SAME wire shapes as the plain golden streams —
+// PUSH ± trace block, PULL, a FUSED push with a compressed member +
+// span trailer + trace context, the codec-compressed fused REPLY —
+// but with CHECKSUM_FLAG stamped through the LIVE shared encoder
+// (wire.h build_head, the one path send_msg and bpsc_send2 ride).
+// Pinned against transport.py and a frozen CHECKSUM_GOLDEN_SHA256 in
+// tests/test_wire_golden.py; a SEPARATE stream, so every pre-checksum
+// digest stays byte-identical.  Returns bytes written, or -(needed)
+// when cap is too small.
+int64_t bps_wire_golden_checksum(uint8_t* out, uint64_t cap) {
+  std::vector<uint8_t> buf;
+  auto put_frame = [&](uint8_t op, uint8_t flags, uint32_t seq, uint64_t key,
+                       uint32_t cmd, uint32_t version, const uint8_t* payload,
+                       uint64_t len, uint64_t trace_id, uint64_t span_id) {
+    uint8_t head[bps_wire::kMaxHeadLen];
+    size_t head_len =
+        bps_wire::build_head(head, op, /*base_status=*/0, flags, seq, key,
+                             cmd, version, payload, len, trace_id, span_id,
+                             /*checksum=*/true);
+    buf.insert(buf.end(), head, head + head_len);
+    if (len) buf.insert(buf.end(), payload, payload + len);
+  };
+  // J: checksummed plain PUSH (payload bytes 0..7)
+  uint8_t payload_a[8] = {0, 1, 2, 3, 4, 5, 6, 7};
+  put_frame(kPush, 1, 7, 42, 6, 3, payload_a, sizeof(payload_a), 0, 0);
+  // K: the same PUSH with trace context — CRC covers trace block + payload
+  put_frame(kPush, 1, 7, 42, 6, 3, payload_a, sizeof(payload_a),
+            0x1122334455667788ull, 0x99AABBCCDDEEFF00ull);
+  // L: checksummed PULL (empty payload: CRC of the empty tail)
+  put_frame(kPull, 0, 8, 42, 6, 3, nullptr, 0, 0, 0);
+  // M: checksummed FUSED push — compressed member beside a raw one,
+  // member-span trailer, outer trace context (the compressed-wire
+  // fixture body, now integrity-stamped end to end)
+  const uint32_t kCmdCompressedF32 = 3, kCmdDefaultF32 = 0;
+  const uint8_t onebit_payload[12] = {0x00, 0x00, 0x00, 0x3F,
+                                      0xEF, 0xBE, 0xAD, 0xDE,
+                                      0x67, 0x45, 0x23, 0x01};
+  const uint8_t raw_payload[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+  std::vector<uint8_t> body;
+  auto put_member = [&](uint64_t mkey, uint32_t mcmd, uint32_t ver,
+                        const uint8_t* p, uint64_t n) {
+    uint64_t key_be = htobe64(mkey), len_be = htobe64(n);
+    uint32_t cmd_be = htonl(mcmd), ver_be = htonl(ver);
+    uint8_t m[24];
+    std::memcpy(m, &key_be, 8);
+    std::memcpy(m + 8, &cmd_be, 4);
+    std::memcpy(m + 12, &ver_be, 4);
+    std::memcpy(m + 16, &len_be, 8);
+    body.insert(body.end(), m, m + 24);
+    body.insert(body.end(), p, p + n);
+  };
+  uint32_t count_be = htonl(2);
+  body.insert(body.end(), (uint8_t*)&count_be, (uint8_t*)&count_be + 4);
+  put_member(301, kCmdCompressedF32, 5, onebit_payload,
+             sizeof(onebit_payload));
+  put_member(302, kCmdDefaultF32, 5, raw_payload, sizeof(raw_payload));
+  for (uint64_t sid : {0xC0FFEE0000000001ull, 0xC0FFEE0000000002ull}) {
+    uint64_t be = htobe64(sid);
+    body.insert(body.end(), (uint8_t*)&be, (uint8_t*)&be + 8);
+  }
+  put_frame(kFused, 1, 31, 301, 2, 0, body.data(), body.size(),
+            0x5555555555555555ull, 0x6666666666666666ull);
+  // N: the checksummed fused REPLY through the LIVE reply encoder
+  std::vector<uint64_t> keys = {301, 302};
+  std::vector<uint32_t> versions = {5, 5};
+  std::vector<std::vector<uint8_t>> slots = {
+      std::vector<uint8_t>(onebit_payload,
+                           onebit_payload + sizeof(onebit_payload)),
+      std::vector<uint8_t>(raw_payload, raw_payload + sizeof(raw_payload))};
+  std::vector<uint8_t> reply = encode_fused_reply_bytes(keys, versions, slots);
+  put_frame(kFused, 0, 31, 301, 0, 0, reply.data(), reply.size(), 0, 0);
   if (buf.size() > cap) return -(int64_t)buf.size();
   std::memcpy(out, buf.data(), buf.size());
   return (int64_t)buf.size();

@@ -9,9 +9,11 @@
 
 #include <arpa/inet.h>
 #include <endian.h>
+#include <strings.h>
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 namespace bps_wire {
@@ -21,6 +23,11 @@ constexpr uint8_t kMagic = 0xB5;
 //: status-byte bit: a 16-byte (u64 trace_id + u64 span_id) block follows
 //: the header, BEFORE the payload (transport.py TRACE_FLAG)
 constexpr uint8_t kTraceFlag = 0x80;
+
+//: status-byte bit: a 4-byte big-endian CRC32C of (trace block + payload)
+//: follows the header (after the trace block), BEFORE the payload
+//: (transport.py CHECKSUM_FLAG; docs/robustness.md "Wire integrity")
+constexpr uint8_t kChecksumFlag = 0x40;
 
 // transport.py Op enum (data-plane subset the native code speaks)
 enum Opcode : uint8_t {
@@ -91,6 +98,135 @@ inline void unpack_trace(const uint8_t in[16], uint64_t* trace_id,
   std::memcpy(&s, in + 8, 8);
   *trace_id = be64toh(t);
   *span_id = be64toh(s);
+}
+
+// --- end-to-end wire integrity (kChecksumFlag) -----------------------------
+//
+// CRC32C (Castagnoli 0x1EDC6F41, reflected 0x82F63B78) over everything
+// after the fixed 32-byte header except the checksum block itself: the
+// optional trace block chained with the whole payload.  Slice-by-8
+// software implementation (~GB/s — the checksum must stay in the noise
+// of a fused sum) shared by BOTH native halves and, via the
+// bps_wire_crc32c ctypes shim, by transport.py — one implementation,
+// no drift.  Semantics match the Python fallback exactly:
+// crc32c(B, crc32c(A)) == crc32c(A||B), crc32c("123456789") = 0xE3069283.
+
+inline const uint32_t (*crc32c_tables())[256] {
+  static uint32_t tbl[8][256];
+  static const bool init = [] {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c >> 1) ^ ((c & 1) ? 0x82F63B78u : 0);
+      tbl[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int t = 1; t < 8; ++t)
+        tbl[t][i] = (tbl[t - 1][i] >> 8) ^ tbl[0][tbl[t - 1][i] & 0xFF];
+    return true;
+  }();
+  (void)init;
+  return tbl;
+}
+
+inline uint32_t crc32c(const void* data, size_t n, uint32_t crc = 0) {
+  const uint32_t (*tbl)[256] = crc32c_tables();
+  const uint8_t* p = (const uint8_t*)data;
+  uint32_t c = crc ^ 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+#if __BYTE_ORDER == __BIG_ENDIAN
+    lo = __builtin_bswap32(lo);
+    hi = __builtin_bswap32(hi);
+#endif
+    lo ^= c;
+    c = tbl[7][lo & 0xFF] ^ tbl[6][(lo >> 8) & 0xFF] ^
+        tbl[5][(lo >> 16) & 0xFF] ^ tbl[4][lo >> 24] ^
+        tbl[3][hi & 0xFF] ^ tbl[2][(hi >> 8) & 0xFF] ^
+        tbl[1][(hi >> 16) & 0xFF] ^ tbl[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) c = (c >> 8) ^ tbl[0][(c ^ *p++) & 0xFF];
+  return c ^ 0xFFFFFFFFu;
+}
+
+// Which ops carry a checksum when stamping is on — the data plane only,
+// mirroring transport.py _CHECKSUM_OPS (change both together): control
+// frames stay byte-identical so arming the knob never perturbs them.
+inline bool checksum_op(uint8_t op) {
+  switch (op) {
+    case kInit:
+    case kPush:
+    case kPull:
+    case kRegisterCompressor:
+    case kFused:
+    case kResyncQuery:
+    case kResyncState:
+    case kMigrateState:
+    case kWrongOwner:
+      return true;
+    default:
+      return false;
+  }
+}
+
+// The ONE place the integrity knobs are parsed on the C++ side (both
+// engines call these at start/create) — truthiness mirrors transport.py
+// wire_checksum_enabled()/checksum_conn_limit() exactly (change all
+// together): ""/0/false/no/off = off; conn limit default 8, 0 = never
+// escalate, negatives/garbage = default.
+inline bool checksum_env_on() {
+  const char* v = getenv("BYTEPS_WIRE_CHECKSUM");
+  if (!v || !*v) return false;
+  return !(strcmp(v, "0") == 0 || strcasecmp(v, "false") == 0 ||
+           strcasecmp(v, "no") == 0 || strcasecmp(v, "off") == 0);
+}
+
+inline uint32_t checksum_env_conn_limit() {
+  const char* v = getenv("BYTEPS_CHECKSUM_CONN_LIMIT");
+  if (!v || !*v) return 8;
+  char* end = nullptr;
+  long n = strtol(v, &end, 10);
+  if (end == v || n < 0) return 8;
+  return (uint32_t)n;
+}
+
+//: largest pre-payload prefix: header (32) + trace (16) + crc (4)
+constexpr size_t kMaxHeadLen = 52;
+
+// Build the complete pre-payload prefix of one frame — header, optional
+// trace block (trace_id != 0), optional CRC32C block — the ONE encode
+// path the native server's send_msg, the native client's bpsc_send2,
+// and the golden-fixture shims all go through.  The CRC covers the
+// trace block chained with the payload (everything after the fixed
+// header except itself — transport.py frame_checksum parity).  Returns
+// the prefix length.
+inline size_t build_head(uint8_t out[kMaxHeadLen], uint8_t op,
+                         uint8_t base_status, uint8_t flags, uint32_t seq,
+                         uint64_t key, uint32_t cmd, uint32_t version,
+                         const void* payload, uint64_t len, uint64_t trace_id,
+                         uint64_t span_id, bool checksum) {
+  Header hd;
+  uint8_t status = base_status;
+  if (trace_id) status |= kTraceFlag;
+  if (checksum) status |= kChecksumFlag;
+  pack_header(&hd, op, status, flags, seq, key, cmd, version, len);
+  std::memcpy(out, &hd, sizeof(hd));
+  size_t off = sizeof(hd);
+  if (trace_id) {
+    pack_trace(out + off, trace_id, span_id);
+    off += 16;
+  }
+  if (checksum) {
+    uint32_t crc = trace_id ? crc32c(out + sizeof(hd), 16) : 0;
+    crc = crc32c(payload, (size_t)len, crc);
+    uint32_t be = htonl(crc);
+    std::memcpy(out + off, &be, 4);
+    off += 4;
+  }
+  return off;
 }
 
 // key → reducer stripe (ps_server.cc key-striped engine plane).  Tensor

@@ -1677,9 +1677,33 @@ class PSServer:
                 pass
 
     def _serve_conn_loop(self, conn: socket.socket, send_lock) -> None:
+        from byteps_tpu.comm.transport import ChecksumError, checksum_conn_limit
+
+        ck_limit = checksum_conn_limit()
+        ck_fails = 0
         try:
             while not self._stop.is_set():
-                msg = recv_message(conn)
+                try:
+                    msg = recv_message(conn)
+                except ChecksumError as e:
+                    # end-to-end wire integrity (docs/robustness.md "Wire
+                    # integrity"): a flipped payload bit that survived
+                    # TCP's checksum.  The frame is fully consumed, so
+                    # DROP it without a reply — the worker's deadline/
+                    # retry + the exactly-once ledger heal it bitwise —
+                    # and escalate repeated mismatches to a connection
+                    # drop so the client revives (possibly bad NIC/path).
+                    from byteps_tpu.core.telemetry import counters
+
+                    ck_fails += 1
+                    counters().bump("wire_checksum_fail", labels={
+                        "side": "server",
+                        "op": getattr(e.op, "name", str(e.op)),
+                    })
+                    if ck_limit and ck_fails >= ck_limit:
+                        counters().bump("wire_checksum_conn_drop")
+                        return
+                    continue
                 if msg.op in (Op.PUSH, Op.PULL, Op.INIT, Op.FUSED):
                     self._enqueue(msg, conn, send_lock)
                 elif msg.op == Op.RESYNC_QUERY:

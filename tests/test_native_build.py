@@ -58,6 +58,12 @@ _REQUIRED_SYMBOLS = (
     "bps_native_server_set_ownership",
     # compressed wire path (ISSUE 11): compressed-fused golden fixtures
     "bps_wire_golden_compressed",
+    # end-to-end wire integrity (ISSUE 15): the shared CRC32C shim
+    # (transport.py's fast path), the checksummed golden stream, and
+    # the checksummed client-encoder twin
+    "bps_wire_crc32c",
+    "bps_wire_golden_checksum",
+    "bps_wire_client_frame_ck",
 )
 
 

@@ -193,6 +193,130 @@ class TestCompressedGoldenFrames:
         assert _fused_echo(body) == encode_fused_push(members)
 
 
+#: sha256 of the CHECKSUMMED fixture stream (CHECKSUM_FLAG + 4-byte
+#: CRC32C after the header/trace block; docs/robustness.md "Wire
+#: integrity") as frozen at the wire-integrity port — a SEPARATE stream,
+#: so every pre-checksum digest above stays byte-identical (default-off
+#: compat: flag off ⇒ the existing GOLDEN streams are unchanged)
+CHECKSUM_GOLDEN_SHA256 = (
+    "bd1891fb581e892c85501f5a201c1d808b647cd98465fc8d3df0c40f9846089f"
+)
+
+
+def python_checksum_golden_frames() -> bytes:
+    """The checksummed fixture stream via transport.py: the SAME wire
+    shapes as the plain/compressed streams — PUSH ± trace, PULL, the
+    compressed fused PUSH with trailer + trace, the codec-compressed
+    fused REPLY — with ``checksum=True`` forcing the CHECKSUM_FLAG
+    stamp.  Mirrors ps_server.cc bps_wire_golden_checksum — change both
+    together."""
+    from byteps_tpu.common.types import DataType, RequestType, get_command_type
+
+    cmd_comp = get_command_type(RequestType.COMPRESSED_PUSH_PULL,
+                                int(DataType.FLOAT32))
+    cmd_raw = get_command_type(RequestType.DEFAULT_PUSH_PULL,
+                               int(DataType.FLOAT32))
+    onebit = (struct.pack("<f", 0.5)
+              + struct.pack("<II", 0xDEADBEEF, 0x01234567))
+    raw = bytes(range(1, 9))
+    out = b""
+    # J: checksummed plain PUSH
+    out += Message(Op.PUSH, key=42, payload=bytes(range(8)), seq=7, cmd=6,
+                   version=3, flags=1, checksum=True).encode()
+    # K: the same PUSH with trace context — CRC covers trace + payload
+    out += Message(Op.PUSH, key=42, payload=bytes(range(8)), seq=7, cmd=6,
+                   version=3, flags=1,
+                   trace=(0x1122334455667788, 0x99AABBCCDDEEFF00),
+                   checksum=True).encode()
+    # L: checksummed PULL (empty payload)
+    out += Message(Op.PULL, key=42, seq=8, cmd=6, version=3,
+                   checksum=True).encode()
+    # M: checksummed compressed fused PUSH (trailer + trace context)
+    body = encode_fused_push(
+        [(301, cmd_comp, 5, onebit), (302, cmd_raw, 5, raw)],
+        span_ids=[0xC0FFEE0000000001, 0xC0FFEE0000000002],
+    )
+    out += Message(Op.FUSED, key=301, payload=body, seq=31, cmd=2, flags=1,
+                   trace=(0x5555555555555555, 0x6666666666666666),
+                   checksum=True).encode()
+    # N: the checksummed codec-compressed fused REPLY
+    reply = encode_fused_reply([(301, 5, onebit), (302, 5, raw)])
+    out += Message(Op.FUSED, key=301, payload=reply, seq=31,
+                   checksum=True).encode()
+    return out
+
+
+class TestChecksumGoldenFrames:
+    def test_native_codec_matches_python(self):
+        lib = _lib()
+        if not hasattr(lib, "bps_wire_golden_checksum"):
+            pytest.skip("lib predates the wire-integrity shim")
+        buf = (ctypes.c_uint8 * 8192)()
+        n = lib.bps_wire_golden_checksum(buf, len(buf))
+        assert n > 0, f"bps_wire_golden_checksum failed: {n}"
+        assert bytes(buf[:n]) == python_checksum_golden_frames()
+
+    def test_frames_match_frozen_digest(self):
+        digest = hashlib.sha256(python_checksum_golden_frames()).hexdigest()
+        assert digest == CHECKSUM_GOLDEN_SHA256, (
+            "the checksummed wire format changed — a PROTOCOL revision: "
+            "update CHECKSUM_GOLDEN_SHA256 and audit every decoder "
+            "(Python AND C++) for compatibility"
+        )
+
+    def test_client_encoder_checksummed_frames_match(self):
+        """The native CLIENT's checksummed encode path
+        (bps_wire_client_frame_ck — the bytes bpsc_send2 writes under
+        BYTEPS_WIRE_CHECKSUM=1) against transport.py, frame by frame."""
+        lib = _lib()
+        if not hasattr(lib, "bps_wire_client_frame_ck"):
+            pytest.skip("lib predates the wire-integrity shim")
+        cases = [
+            (Op.PUSH, 21, 42, 6, 3, 1, None, bytes(range(8))),
+            (Op.PUSH, 21, 42, 6, 3, 1,
+             (0x0123456789ABCDEF, 0x0FEDCBA987654321), bytes(range(8))),
+            (Op.PULL, 22, 42, 6, 3, 0, None, b""),
+            (Op.FUSED, 24, 101, 2, 0, 1,
+             (0x3333333333333333, 0x4444444444444444),
+             encode_fused_push([(101, 6, 1, b"abcd")], span_ids=[0xA1])),
+        ]
+        for op, seq, key, cmd, ver, flags, trace, payload in cases:
+            out = (ctypes.c_uint8 * (len(payload) + 64))()
+            t, s = trace if trace else (0, 0)
+            n = lib.bps_wire_client_frame_ck(
+                int(op), seq, key, cmd, ver, flags, t, s, bytes(payload),
+                len(payload), out, len(out),
+            )
+            assert n > 0
+            py = Message(op, key=key, payload=payload, seq=seq, cmd=cmd,
+                         version=ver, flags=flags, trace=trace,
+                         checksum=True).encode()
+            assert bytes(out[:n]) == py
+
+    def test_checksum_off_keeps_existing_streams_byte_identical(self):
+        """Old-decoder compat: with the flag off, every pre-checksum
+        fixture stream is untouched (their frozen digests above are the
+        stronger pin; this asserts the checksum attribute's default
+        never leaks into unstamped encodes even under the env knob)."""
+        import os
+
+        assert "BYTEPS_WIRE_CHECKSUM" not in os.environ or \
+            os.environ["BYTEPS_WIRE_CHECKSUM"] in ("", "0")
+        assert hashlib.sha256(
+            python_golden_frames()
+        ).hexdigest() == GOLDEN_SHA256
+        # an explicit checksum=False wins over the env knob
+        os.environ["BYTEPS_WIRE_CHECKSUM"] = "1"
+        try:
+            framed = Message(Op.PUSH, key=1, payload=b"xy", seq=1,
+                             checksum=False).encode()
+        finally:
+            os.environ.pop("BYTEPS_WIRE_CHECKSUM")
+        assert framed == Message(Op.PUSH, key=1, payload=b"xy", seq=1,
+                                 checksum=False).encode()
+        assert len(framed) == 32 + 2  # no checksum block
+
+
 #: sha256 of the CLIENT-encoder fixture stream (trace-flagged frames
 #: through bps_wire_client_frame, the live bpsc_send2 path) as frozen at
 #: the native-observability port

@@ -556,7 +556,8 @@ def _r_compression_loss(v: View):
 
 def _r_chaos_active(v: View):
     total = v.counter("chaos_drop", "chaos_delay", "chaos_disconnect",
-                      "chaos_truncate", "chaos_corrupt")
+                      "chaos_truncate", "chaos_corrupt",
+                      "chaos_payload_corrupt")
     if total <= 0:
         return None
     return (
@@ -637,6 +638,60 @@ def _r_slo_breach(v: View):
         "whether a bulk neighbor saturates the shared fleet (give the "
         "latency job a higher BYTEPS_JOB_PRIORITY / quota the bulk job)",
         evidence,
+    )
+
+
+_CORRUPT_ANCHOR = slugify("Wire corruption: checksums are rejecting frames")
+
+
+def _r_wire_corruption(v: View):
+    """The end-to-end integrity plane (BYTEPS_WIRE_CHECKSUM) is
+    rejecting frames: payload bits are flipping between the sender's
+    CRC32C stamp and the receiver's verify — bad NIC/DRAM/link below
+    TCP's 16-bit checksum.  Correctness is safe (rejected frames are
+    dropped and retried through the exactly-once ledger); the evidence
+    names where, and whether the faults are injected rehearsals."""
+    fails = v.counter("wire_checksum_fail", "native_checksum_fail")
+    if fails <= 0:
+        return None
+    ev = [f"wire_checksum_fail(+native) total = {int(fails)}"]
+    per_srv = v.labeled_by("wire_checksum_fail", "server")
+    if per_srv:
+        worst = max(per_srv, key=per_srv.get)
+        ev.append(
+            f"worst path: server {worst} "
+            f"({int(per_srv[worst])} rejected replies client-side)"
+        )
+    per_side = v.labeled_by("wire_checksum_fail", "side")
+    if per_side:
+        ev.append("by side: " + ", ".join(
+            f"{s}={int(n)}" for s, n in sorted(per_side.items())
+        ))
+    drops = v.counter("wire_checksum_conn_drop", "native_checksum_conn_drop")
+    if drops:
+        ev.append(
+            f"wire_checksum_conn_drop(+native) total = {int(drops)} — "
+            "connections blew BYTEPS_CHECKSUM_CONN_LIMIT and were revived"
+        )
+    storms = v.ledger_triggers().get("corruption_storm", 0)
+    if storms:
+        ev.append(f"corruption_storm trigger fired {storms}x on-node")
+    injected = v.counter("chaos_payload_corrupt")
+    if injected:
+        ev.append(
+            f"chaos_payload_corrupt = {int(injected)} — (some of) these "
+            "flips are injected rehearsals, not hardware"
+        )
+    score = 28 + min(30.0, math.log10(max(fails, 1.0)) * 10)
+    if drops or storms:
+        score += 15
+    return (
+        score,
+        "payload bits are flipping on the wire and the checksum plane is "
+        "catching them — sums stay bitwise-correct (drop + retry + "
+        "exactly-once ledger) but every rejection costs a deadline; find "
+        "the bad NIC/link before it gets worse",
+        ev,
     )
 
 
@@ -734,6 +789,9 @@ RULES: List[Rule] = [
     Rule("chaos_active", _SLOW_ANCHOR,
          "unset BYTEPS_CHAOS_* if this is not a rehearsal",
          _r_chaos_active),
+    Rule("wire_corruption", _CORRUPT_ANCHOR,
+         "replace the corrupting NIC/link; BYTEPS_CHECKSUM_CONN_LIMIT "
+         "tunes the revival threshold", _r_wire_corruption),
     Rule("quota_starved", _TENANT_ANCHOR,
          "BYTEPS_JOB_QUOTA_MBPS up (or shed the job's offered load)",
          _r_quota_starved),

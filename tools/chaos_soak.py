@@ -57,6 +57,9 @@ def run_soak(
     reshard: bool = False,
     sched_crash: int = -1,
     autotune: bool = False,
+    payload_corrupt: bool = False,
+    checksums: bool = True,
+    engine: str = "python",
 ) -> dict:
     """Run the soak in-process; returns a result dict (raises on any
     invariant violation).  Env mutations are process-wide — run via the
@@ -80,7 +83,26 @@ def run_soak(
     re-registered with the reborn incarnation within the rejoin window
     with ZERO spurious evictions, the new incarnation's map epoch fences
     above the old one, and — composed with ``reshard`` — a subsequent
-    live scale-up still works against the reborn scheduler."""
+    live scale-up still works against the reborn scheduler.
+
+    ``payload_corrupt`` (the ``--corrupt`` mode; docs/robustness.md
+    "Wire integrity"): seeded single-bit payload flips at p≈0.05 on
+    PUSH/PULL/FUSED frames (plus MIGRATE_STATE when composed with
+    ``reshard``, so a corrupted authoritative-ledger shipment is
+    exercised too), with ``BYTEPS_WIRE_CHECKSUM=1`` and fusion armed so
+    fused frames actually flow.  Asserts bitwise pulls every step,
+    ``wire_checksum_fail`` > 0 (the schedule really flipped bits and
+    every flip was caught), and ``rpc_giveup`` == 0 (drops healed inside
+    the retry budget).  ``checksums=False`` runs the SAME seeded flip
+    schedule with the integrity plane off — the run is then EXPECTED to
+    fail its bitwise assert (silent corruption); the ``--ab`` CLI flag
+    automates that two-leg proof in subprocesses.
+
+    ``engine``: ``python`` (default) or ``native`` — the C++ engine
+    verifies ahead of its stripe rings and stamps replies through the
+    same shared wire.h CRC32C (native servers preclude ``reshard``/
+    ``one_sided``/``autotune`` composition, which need Python-engine
+    state export)."""
     if one_sided and servers < 2:
         raise ValueError("--one-sided needs --servers >= 2 (one victim, "
                          "one healthy control)")
@@ -91,6 +113,23 @@ def run_soak(
         raise ValueError("--sched-crash must land before the --reshard "
                          "scale-up step (steps//3) so the resize runs "
                          "against the REBORN scheduler")
+    if engine == "native" and (reshard or one_sided or autotune):
+        raise ValueError("--engine native cannot compose with --reshard/"
+                         "--one-sided/--autotune (Python-engine-only "
+                         "state export; docs/robustness.md parity matrix)")
+    corrupt_ops = ""
+    if payload_corrupt:
+        from byteps_tpu.comm.transport import Op as _Op
+
+        ops = [int(_Op.PUSH), int(_Op.PULL), int(_Op.FUSED)]
+        if reshard:
+            ops.append(int(_Op.MIGRATE_STATE))
+        corrupt_ops = ",".join(str(o) for o in ops)
+        # a flips-only schedule: the mode asserts rpc_giveup == 0, which
+        # only the integrity plane's drop-and-retry can guarantee — a
+        # stray disconnect/truncate landing inside a retry burst could
+        # exhaust a budget for reasons unrelated to corruption
+        drop = delay = disconnect = truncate = corrupt = 0.0
     os.environ.update(
         {
             "BYTEPS_VAN": "chaos:tcp",
@@ -104,6 +143,17 @@ def run_soak(
             "BYTEPS_CHAOS_DISCONNECT": "0" if one_sided else str(disconnect),
             "BYTEPS_CHAOS_TRUNCATE": "0" if one_sided else str(truncate),
             "BYTEPS_CHAOS_CORRUPT": "0" if one_sided else str(corrupt),
+            # --corrupt mode (docs/robustness.md "Wire integrity"):
+            # seeded payload bit-flips on the data-plane ops, checksums
+            # on (unless the A/B control leg turned them off), fusion
+            # armed so FUSED frames are in the blast radius
+            "BYTEPS_CHAOS_PAYLOAD_CORRUPT":
+                "0.05" if payload_corrupt else "0",
+            "BYTEPS_CHAOS_OPS": corrupt_ops,
+            "BYTEPS_WIRE_CHECKSUM":
+                "1" if (payload_corrupt and checksums) else "0",
+            "BYTEPS_FUSION_THRESHOLD": "65536" if payload_corrupt else "0",
+            "BYTEPS_SERVER_NATIVE": "1" if engine == "native" else "0",
             "BYTEPS_RPC_DEADLINE_S": "0.3",
             "BYTEPS_INIT_DEADLINE_S": "0.5",
             # a small budget in one-sided mode so give-ups (and thus the
@@ -142,13 +192,14 @@ def run_soak(
     from byteps_tpu.common.config import Config
     from byteps_tpu.comm.rendezvous import Scheduler
     from byteps_tpu.core.telemetry import counters
-    from byteps_tpu.server.server import PSServer
+    from byteps_tpu.server.server import NativePSServer, PSServer
 
+    server_cls = NativePSServer if engine == "native" else PSServer
     counters().reset()
     sched = Scheduler(num_workers=1, num_servers=servers, host="127.0.0.1")
     sched.start()
     os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
-    fleet = [PSServer(Config.from_env()) for _ in range(servers)]
+    fleet = [server_cls(Config.from_env()) for _ in range(servers)]
     for srv in fleet:
         threading.Thread(target=srv.start, daemon=True).start()
 
@@ -193,11 +244,16 @@ def run_soak(
     rng = np.random.default_rng(seed)
     # --reshard trains several NAMED shards so the consistent-hash ring
     # re-homes a real subset of keys on every server-set change (one
-    # tensor = one key could land on an unmoved ring segment)
-    n_shards = 8 if reshard else 1
+    # tensor = one key could land on an unmoved ring segment).
+    # --corrupt (without reshard) trains one small tensor (rides the
+    # fuser) and one above-threshold tensor (plain PUSH/PULL), so the
+    # flip schedule hits all three targeted frame shapes.
+    n_shards = 8 if reshard else (2 if payload_corrupt else 1)
     sdim = max(4, dim // n_shards)
-    ws = [rng.standard_normal(sdim).astype(np.float32)
-          for _ in range(n_shards)]
+    sizes = [sdim] * n_shards
+    if payload_corrupt and not reshard:
+        sizes = [sdim, 24576]  # 96 KB > the 64 KB fusion threshold
+    ws = [rng.standard_normal(s).astype(np.float32) for s in sizes]
     loss0 = float(sum(w @ w for w in ws))
     lr = 0.05
     up_at, down_at = max(1, steps // 3), max(2, (2 * steps) // 3)
@@ -316,10 +372,29 @@ def run_soak(
         sched.stop()
 
     assert loss1 < loss0, f"loss did not decrease: {loss0} -> {loss1}"
-    chaos_on = one_sided or any((drop, delay, disconnect, truncate, corrupt))
+    chaos_on = one_sided or payload_corrupt or any(
+        (drop, delay, disconnect, truncate, corrupt)
+    )
     injected = sum(v for k, v in snap.items() if k.startswith("chaos_"))
     if chaos_on:
         assert injected > 0, f"no faults injected: {snap}"
+    if payload_corrupt and checksums:
+        # the wire-integrity contract (docs/robustness.md "Wire
+        # integrity"): every injected flip was caught somewhere — the
+        # Python side's labeled counter or the native engine's — and
+        # every drop healed inside the retry budget (no give-ups, no
+        # silent corruption: the per-step bitwise assert above already
+        # proved the sums).
+        flips = snap.get("chaos_payload_corrupt", 0)
+        assert flips > 0, f"--corrupt schedule injected nothing: {snap}"
+        caught = (snap.get("wire_checksum_fail", 0)
+                  + snap.get("native_checksum_fail", 0))
+        assert caught > 0, (
+            f"payload flips were injected but no receiver caught them: {snap}"
+        )
+        assert snap.get("rpc_giveup", 0) == 0, (
+            f"corruption drops exhausted a retry budget: {snap}"
+        )
     if one_sided:
         # the targeted drops must have exhausted at least one retry
         # budget and routed through the in-place heal (no re-init)
@@ -499,6 +574,49 @@ def run_multi_tenant_soak(
     }
 
 
+def run_corrupt_ab(args) -> int:
+    """The two-leg corruption proof (docs/robustness.md "Wire
+    integrity"), each leg a fresh subprocess (the soak mutates
+    process-wide env): the SAME seeded payload-flip schedule must
+    survive bitwise with checksums on, and demonstrably corrupt with
+    checksums off — detection, not luck."""
+    import subprocess
+
+    base = [
+        sys.executable, os.path.abspath(__file__),
+        "--steps", str(args.steps), "--seed", str(args.seed),
+        "--servers", str(args.servers),
+        "--drop", "0", "--delay", "0", "--disconnect", "0",
+        "--truncate", "0", "--corrupt-frame", "0",
+        "--corrupt", "--engine", args.engine,
+        "--timeout", str(args.timeout),
+    ]
+    if args.reshard:
+        base.append("--reshard")
+    print(f"[A/B] leg A: checksums ON (seed={args.seed}, "
+          f"engine={args.engine}) ...")
+    a = subprocess.run(base, capture_output=True, text=True,
+                       timeout=args.timeout + 120)
+    print(a.stdout.strip())
+    if a.returncode != 0:
+        print(a.stderr.strip())
+        print("[A/B] FAILED: the checksums-ON leg did not survive")
+        return 1
+    print(f"[A/B] leg B: SAME schedule, checksums OFF ...")
+    b = subprocess.run(base + ["--no-checksum"], capture_output=True,
+                       text=True, timeout=args.timeout + 120)
+    if b.returncode == 0:
+        print(b.stdout.strip())
+        print("[A/B] FAILED: the checksums-OFF leg survived bitwise — "
+              "the injected flips were inert, so leg A proves nothing")
+        return 1
+    tail = (b.stdout.strip().splitlines() or ["<no output>"])[-1]
+    print(f"[A/B] leg B corrupted as expected: {tail}")
+    print("[A/B] OK: checksums-on survives bitwise, checksums-off "
+          "corrupts — detection is the checksum's doing, not luck")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=60)
@@ -508,7 +626,32 @@ def main() -> int:
     ap.add_argument("--delay", type=float, default=0.05)
     ap.add_argument("--disconnect", type=float, default=0.005)
     ap.add_argument("--truncate", type=float, default=0.005)
-    ap.add_argument("--corrupt", type=float, default=0.005)
+    ap.add_argument("--corrupt-frame", type=float, default=0.005,
+                    help="probability of the magic-byte flip (header "
+                         "corruption — always detected by framing)")
+    ap.add_argument("--corrupt", action="store_true",
+                    help="payload-corruption mode (docs/robustness.md "
+                         "'Wire integrity'): seeded single-bit flips past "
+                         "the header at p=0.05 on PUSH/PULL/FUSED (plus "
+                         "MIGRATE_STATE with --reshard) with "
+                         "BYTEPS_WIRE_CHECKSUM=1 and fusion armed; asserts "
+                         "bitwise pulls every step, wire_checksum_fail>0, "
+                         "rpc_giveup==0")
+    ap.add_argument("--no-checksum", action="store_true",
+                    help="with --corrupt: run the SAME seeded flip schedule "
+                         "with the integrity plane OFF — the run is "
+                         "expected to FAIL (silent corruption); used by "
+                         "--ab's control leg")
+    ap.add_argument("--engine", choices=("python", "native"),
+                    default="python",
+                    help="server engine for the fleet (native verifies "
+                         "ahead of its stripe rings via the same shared "
+                         "wire.h CRC32C)")
+    ap.add_argument("--ab", action="store_true",
+                    help="with --corrupt: run BOTH legs in subprocesses — "
+                         "checksums on must survive bitwise, the same "
+                         "schedule with checksums off must corrupt (the "
+                         "A/B that proves detection, not luck)")
     ap.add_argument("--crash-at", type=int, default=-1,
                     help="step at which to hard-kill the last server")
     ap.add_argument("--one-sided", action="store_true",
@@ -545,6 +688,11 @@ def main() -> int:
                     help="watchdog: the soak must finish within this")
     args = ap.parse_args()
 
+    if args.ab:
+        if not args.corrupt:
+            ap.error("--ab needs --corrupt")
+        return run_corrupt_ab(args)
+
     result: dict = {}
     err: list = []
 
@@ -564,9 +712,11 @@ def main() -> int:
                     steps=args.steps, seed=args.seed, servers=args.servers,
                     drop=args.drop, delay=args.delay,
                     disconnect=args.disconnect, truncate=args.truncate,
-                    corrupt=args.corrupt, crash_at=args.crash_at,
+                    corrupt=args.corrupt_frame, crash_at=args.crash_at,
                     one_sided=args.one_sided, reshard=args.reshard,
                     sched_crash=args.sched_crash, autotune=args.autotune,
+                    payload_corrupt=args.corrupt,
+                    checksums=not args.no_checksum, engine=args.engine,
                 )
             )
         except BaseException as e:  # noqa: BLE001
