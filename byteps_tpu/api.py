@@ -17,6 +17,7 @@ Semantics on TPU (single-controller JAX):
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 from typing import Any, Optional
@@ -91,8 +92,33 @@ def local_size() -> int:
 def declare_tensor(name: str, **kwargs: str) -> int:
     """Declare a named tensor ahead of communication, optionally carrying
     compression kwargs (byteps_declare_tensor, mxnet/ops.py:82-120);
-    returns the stable declared key."""
-    ctx = get_registry().declare(name, **{k: str(v) for k, v in kwargs.items()})
+    returns the stable declared key.
+
+    Server-side optimizer (docs/architecture.md "Server-side
+    optimizer"): ``byteps_server_opt="sgd"|"momentum"|"adam"`` declares
+    the tensor's keys with a server-side update rule (workers push
+    gradients, pull updated parameters), overriding the process-wide
+    ``BYTEPS_SERVER_OPT``; ``byteps_server_opt_hp`` carries its
+    hyperparams as a JSON string or a dict (dicts are canonicalized to
+    JSON here — registry kwargs are strings on the wire)."""
+    raw = kwargs.get("byteps_server_opt")
+    if raw is not None:
+        rule = str(raw).strip().lower()
+        if rule and rule not in ("0", "false", "no", "off"):
+            # fail at DECLARE, not at the first push's INIT: the rule
+            # registry is local, so a typo'd name should not travel to
+            # the server before erroring
+            from byteps_tpu.server.update_rules import RULE_NAMES
+
+            if rule not in RULE_NAMES:
+                raise ValueError(
+                    f"unknown server update rule {rule!r} "
+                    f"(have {RULE_NAMES})"
+                )
+    ctx = get_registry().declare(name, **{
+        k: (json.dumps(v, sort_keys=True) if isinstance(v, dict) else str(v))
+        for k, v in kwargs.items()
+    })
     return ctx.declared_key
 
 

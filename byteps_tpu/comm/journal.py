@@ -26,6 +26,15 @@ corrupt sums, so the journal must never outlive the numbering.
 The payload is copied on record (the engine hands zero-copy views whose
 buffers die with the task); that copy is the whole cost of the feature
 on the hot path.
+
+Server-side optimizer keys (docs/architecture.md "Server-side
+optimizer") change nothing here: the journal records gradient pushes
+exactly as for SUM keys, and replay safety is the server's exactly-once
+ledger — a replayed push dedupes BEFORE it can count toward a round
+barrier, so the server's update rule fires exactly once per completed
+round no matter how many journaled retransmits land.  The seed round's
+parameter push is journaled like any other; replaying it is harmless
+for the same reason (the ledger already marks it summed).
 """
 
 from __future__ import annotations

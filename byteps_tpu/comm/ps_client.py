@@ -490,6 +490,7 @@ class PSClient:
     _sched_reconnecting = False
     _sched_terminal = False
     _seen_map_epoch = 0
+    _seen_ring_overrides: dict = {}
     _reconnect_token = 0
     #: adaptive control plane (docs/autotune.md): the newest adopted
     #: ``tuning`` section + its epoch; class-level defaults keep
@@ -531,6 +532,7 @@ class PSClient:
         #: holder of the current token may clear flags or latch terminal
         self._reconnect_token = 0
         self._seen_map_epoch = 0
+        self._seen_ring_overrides = {}
         self._servers: List[_ServerConn] = []
         self._server_addrs: List[tuple] = []
         #: bumped whenever the server list is rebuilt (elastic server
@@ -751,15 +753,18 @@ class PSClient:
             return False
         if inc > self.sched_incarnation:
             if self.sched_incarnation:
-                # scheduler REBIRTH: its tuner restarts at tuning epoch
-                # 0, so the monotone adoption fence must re-arm or every
-                # new decision (epochs 1..N-1) would be refused while
-                # the dead incarnation's tuning stayed live forever.
-                # -1 (not 0) so even the successor's initial epoch-0
-                # section adopts — its empty state reverts fleet
-                # decisions (engine restores launch values on absent
-                # fields; overridden keys migrate home via the fenced
-                # map epoch).
+                # scheduler REBIRTH: the successor's tuner numbering
+                # restarts, so the monotone adoption fence must re-arm
+                # or its decisions would be refused while the dead
+                # incarnation's tuning stayed live forever.  -1 (not 0)
+                # so even an epoch-0 initial section adopts.  The
+                # successor normally RE-ADOPTS the fleet's live state
+                # from the survivors' rejoin reports (_tuning_report →
+                # AutoTuner.adopt_rejoin_report), so its first book
+                # confirms the running decisions; only a tunerless
+                # successor (BYTEPS_AUTOTUNE off) ships an empty
+                # section, deliberately reverting the fleet to launch
+                # values.
                 self._tuning_epoch = -1
             self.sched_incarnation = inc
         return True
@@ -775,8 +780,15 @@ class PSClient:
         # rejoin re-REGISTER always reports what this node observed and
         # a reborn scheduler fences above it
         me = book.get("map_epoch")
-        if me is not None and int(me) > self._seen_map_epoch:
+        if me is not None and int(me) >= self._seen_map_epoch:
             self._seen_map_epoch = int(me)
+            # newest placement overrides seen in any book: they ride the
+            # rejoin report (_tuning_report) so a reborn scheduler can
+            # re-adopt placement instead of migrating every overridden
+            # key home on its first book
+            self._seen_ring_overrides = dict(
+                book.get("ring_overrides") or {}
+            )
         ev = book.get("evictions") or {}
         for role, name in (("worker", "worker_evicted"),
                            ("server", "server_evicted")):
@@ -824,6 +836,20 @@ class PSClient:
                 from byteps_tpu.common import logging as bpslog
 
                 bpslog.warning("tuning listener failed: %r", e)
+
+    def _tuning_report(self) -> Optional[dict]:
+        """The fleet-tuning state this node last adopted — the rejoin
+        REGISTER carries it so a RESTARTED scheduler's tuner re-adopts
+        the live decisions (docs/autotune.md "Rollback flow") instead
+        of reverting them with its empty epoch-0 state.  None when no
+        tuner ever armed (the report field stays absent and the legacy
+        wire is byte-identical)."""
+        if self.tuning is None:
+            return None
+        rep = dict(self.tuning)
+        if self._seen_ring_overrides:
+            rep["ring_overrides"] = dict(self._seen_ring_overrides)
+        return rep
 
     def add_tuning_listener(self, cb) -> None:
         """Register a fleet-tuning consumer; replays the current
@@ -1240,6 +1266,11 @@ class PSClient:
                 "job": self.cfg.job_id,
                 "job_priority": self.cfg.job_priority,
                 "job_quota_mbps": self.cfg.job_quota_mbps,
+                # last-adopted fleet tuning + placement overrides: a
+                # reborn scheduler re-adopts these before its first
+                # books (AutoTuner.adopt_rejoin_report) so live
+                # decisions survive the restart
+                "tuning": self._tuning_report(),
             }).encode()
             send_message(sock, Message(Op.REGISTER, payload=payload))
             resp = recv_message(sock)
@@ -2340,7 +2371,9 @@ class PSClient:
     def init_tensor(self, key: int, num_elements: int, dtype_id: int,
                     trace: Optional[tuple] = None,
                     async_profile: bool = False,
-                    staleness: int = -1) -> None:
+                    staleness: int = -1,
+                    server_opt: Optional[str] = None,
+                    server_opt_hp: Optional[dict] = None) -> None:
         """Blocking init-push; doubles as the cross-worker barrier for this
         key (InitTensor blocking ZPush, operations.cc:283-414).
 
@@ -2366,13 +2399,28 @@ class PSClient:
         keep seeing the exact 12-byte INIT they always parsed — and the
         native C++ engine, which has no async plane, rejects the
         extended form with a clean ``status=1`` echo (the Python-engine
-        fallback rule, docs/async.md)."""
+        fallback rule, docs/async.md).
+
+        ``server_opt`` (docs/architecture.md "Server-side optimizer"):
+        the key declares a server-side update rule — bit 1 of the same
+        profile byte, followed by the rule block (name + canonical-JSON
+        ``server_opt_hp``), so the server runs the optimizer and this
+        worker pulls updated parameters.  Engines without the update
+        plane reject with the same clean status echo."""
         import struct
 
         token = self._init_token(key)
         payload = struct.pack("!QI", num_elements, dtype_id)
-        if async_profile:
-            payload += struct.pack("!Bi", 1, int(staleness))
+        profile = (1 if async_profile else 0) | (2 if server_opt else 0)
+        if profile:
+            payload += struct.pack("!Bi", profile, int(staleness))
+        if server_opt:
+            from byteps_tpu.comm.transport import encode_server_opt_block
+            from byteps_tpu.server.update_rules import canonical_hp
+
+            payload += encode_server_opt_block(
+                server_opt, canonical_hp(server_opt_hp or {})
+            )
         resp = self._blocking_request_retrying(
             key,
             lambda seq: Message(
@@ -2399,7 +2447,11 @@ class PSClient:
             # would silently run on uninitialized state.
             from byteps_tpu.common.tenancy import job_of_key
 
-            if async_profile:
+            if server_opt:
+                why = (f"the server-side optimizer plane (rule "
+                       f"{server_opt!r}) needs Python-engine servers — "
+                       "see docs/architecture.md")
+            elif async_profile:
                 why = ("async push_pull needs Python-engine servers "
                        "— see docs/async.md")
             elif job_of_key(key):

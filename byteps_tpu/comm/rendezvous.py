@@ -325,6 +325,11 @@ class Scheduler:
         telemetry plane already maintains."""
         loads, hot_keys, owned = self.tuner.drain_hot()
         steps: Dict[int, float] = {}
+        # per-stage dwell totals across the worker rows of the flight
+        # matrix (each record's ``st`` is already a per-step delta) —
+        # the fusion-threshold walk deltas these against its previous
+        # sweep, so the walk sees where step time went, not just counts
+        dwell: Dict[str, float] = {}
         for who, recs in self.flight.matrix().items():
             if not who.startswith("worker"):
                 continue
@@ -332,6 +337,12 @@ class Scheduler:
                 if r.get("k") == "step" and r.get("dur"):
                     steps[who] = float(r["dur"])
                     break
+            for r in recs:
+                for stage, nv in (r.get("st") or {}).items():
+                    try:
+                        dwell[stage] = dwell.get(stage, 0.0) + float(nv[1])
+                    except (TypeError, ValueError, IndexError):
+                        continue
         flat = self.metrics_agg.counters.snapshot()
         labeled = self.metrics_agg.counters.snapshot_labeled()
         votes: Dict[str, set] = {}
@@ -366,6 +377,7 @@ class Scheduler:
                 "wire_rpc": flat.get("wire_rpc", 0),
                 "fused_frames": flat.get("fused_frames", 0),
                 "fused_keys": flat.get("fused_keys", 0),
+                "dwell": dwell,
             },
             "codec_votes": {c: len(rs) for c, rs in votes.items()},
         }
@@ -750,6 +762,18 @@ class Scheduler:
                 # is adopted (no-op on a live scheduler — the book is
                 # already out)
                 self._arm_rejoin_grace_locked()
+                # tuner-state reconstruction (docs/autotune.md): before
+                # this successor emits its first books, re-adopt the
+                # fleet's live tuning (fusion threshold, codec_off,
+                # ring overrides) from the survivors' reports — the
+                # first book then CONFIRMS the decisions the fleet
+                # already runs instead of reverting them and migrating
+                # every overridden key home mid-training.  Only during
+                # bring-up: a live scheduler's own tuner state is
+                # authoritative over any (necessarily stale) report.
+                if (self.tuner is not None and not self._addrbook_sent
+                        and info.get("tuning")):
+                    self.tuner.adopt_rejoin_report(info["tuning"])
                 if not self._addrbook_sent and role == "worker" and not job:
                     # the cluster may have been resized since this
                     # scheduler's env was written; the survivors know

@@ -660,6 +660,58 @@ def decode_migrate_state(payload: bytes) -> Tuple[dict, bytes, bytes]:
     return meta, payload[off : off + sn], payload[off + sn : off + sn + an]
 
 
+def decode_migrate_extra(payload: bytes, meta: dict) -> bytes:
+    """The raw tail *behind* store+accum in a MIGRATE_STATE body —
+    optimizer slot bytes (``meta["opt_slot_nbytes"]`` names the split).
+    Kept out of :func:`decode_migrate_state`'s pinned 3-tuple so the
+    PR 8 codec round-trip tests stay byte-for-byte valid; that decoder
+    already tolerates trailing bytes, this one returns them."""
+    (hlen,) = struct.unpack_from("!I", payload, 0)
+    off = (
+        4 + hlen
+        + int(meta.get("store_nbytes", 0))
+        + int(meta.get("accum_nbytes", 0))
+    )
+    return payload[off:]
+
+
+# --- server-opt INIT profile block (bit 1 of the profile byte) ------------
+#
+# The PR 12 async profile appends ``!Bi`` (profile byte + staleness) to
+# the 12-byte INIT body; sync keys stay byte-identical.  The server-side
+# optimizer plane turns that byte into a bitmask (bit 0 = async, bit 1 =
+# server-opt) and, when bit 1 is set, appends a rule block at offset 17:
+# ``!H`` rule-name length + name bytes + ``!I`` hyperparam-JSON length +
+# canonical JSON.  Engines that predate the bit reject the whole INIT
+# with status=1 (the native engine counts ``native_server_opt_reject``),
+# exactly like the async precedent — never a silent downgrade to SUM.
+
+
+def encode_server_opt_block(rule: str, hp_json: str) -> bytes:
+    """The rule block appended after the ``!Bi`` profile extension."""
+    nb = str(rule).encode("utf-8")
+    hb = hp_json.encode("utf-8")
+    return struct.pack("!H", len(nb)) + nb + struct.pack("!I", len(hb)) + hb
+
+
+def decode_server_opt_block(payload: bytes, off: int) -> Tuple[str, bytes]:
+    """Inverse of :func:`encode_server_opt_block` → (rule name, raw
+    hyperparam JSON bytes); raises ValueError when truncated."""
+    if off + 2 > len(payload):
+        raise ValueError("server-opt block truncated (name length)")
+    (nlen,) = struct.unpack_from("!H", payload, off)
+    off += 2
+    if off + nlen + 4 > len(payload):
+        raise ValueError("server-opt block truncated (name)")
+    name = payload[off : off + nlen].decode("utf-8")
+    off += nlen
+    (hlen,) = struct.unpack_from("!I", payload, off)
+    off += 4
+    if off + hlen > len(payload):
+        raise ValueError("server-opt block truncated (hyperparams)")
+    return name, payload[off : off + hlen]
+
+
 def encode_wrong_owner(epoch: int, owner: int) -> bytes:
     """Body of an Op.WRONG_OWNER reply."""
     import json

@@ -157,6 +157,18 @@ class Config:
     # -1 = unbounded (pure async); 0 degenerates to sequential
     # consistency (every pull waits for all of its round's pushes).
     staleness_bound: int = -1  # BYTEPS_STALENESS_BOUND
+    # server-side optimizer plane (docs/architecture.md "Server-side
+    # optimizer"): "" = off (servers SUM, workers own the optimizer);
+    # a rule name ("sgd" / "momentum" / "adam") declares every float
+    # tensor's INIT with the server-opt profile — workers push
+    # gradients and pull UPDATED PARAMETERS.  Per-tensor overridable
+    # via declare kwargs (byteps_server_opt="adam",
+    # byteps_server_opt_hp={"lr": 0.001}).  Python-engine servers
+    # only; the native engine rejects the profile cleanly.
+    server_opt: str = ""  # BYTEPS_SERVER_OPT
+    # JSON hyperparams for the fleet-wide BYTEPS_SERVER_OPT rule, e.g.
+    # '{"lr": 0.01, "momentum": 0.9}' — per-tensor kwargs win.
+    server_opt_hp: str = ""  # BYTEPS_SERVER_OPT_HP
     # per-job step-time SLO in seconds (0 = off): a completed step
     # slower than this fires the flight recorder's slo_breach trigger
     # (rate-limited bundle, flight_trigger{rule="slo_breach"}).
@@ -363,6 +375,8 @@ class Config:
             job_credit_bytes=max(0, _env_int("BYTEPS_JOB_CREDIT_BYTES", 0)),
             async_mode=_env_bool("BYTEPS_ASYNC"),
             staleness_bound=max(-1, _env_int("BYTEPS_STALENESS_BOUND", -1)),
+            server_opt=_env_str("BYTEPS_SERVER_OPT", "").strip().lower(),
+            server_opt_hp=_env_str("BYTEPS_SERVER_OPT_HP", ""),
             job_slo_s=max(0.0, float(
                 os.environ.get("BYTEPS_JOB_SLO_S", "0") or "0"
             )),

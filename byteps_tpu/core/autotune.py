@@ -112,6 +112,12 @@ class TunerConfig:
     pack_lo: float = 1.5        # avg fused pack ≤ this → fusion is overhead
     pack_hi: float = 6.0        # avg fused pack ≥ this → packs saturate
     rpc_hi: int = 64            # per-sweep wire RPCs that count as pressure
+    # dwell evidence bands (flight-matrix per-stage deltas): when the
+    # matrix carries stage dwell, a walk step must also be justified in
+    # TIME — counts alone can't tell a wire-bound fleet from one whose
+    # steps live in COPYD2H/COMPRESS
+    dwell_fuse_frac: float = 0.05  # FUSE ≥ this share of wire dwell → fusion costs real time
+    dwell_wire_frac: float = 0.2   # wire stages ≥ this share of all dwell → wire-bound
 
     @classmethod
     def from_env(cls) -> "TunerConfig":
@@ -295,6 +301,48 @@ class AutoTuner:
     def tuning_dict(self) -> dict:
         with self._lock:
             return self.state.tuning_dict()
+
+    def adopt_rejoin_report(self, report: dict) -> bool:
+        """Re-adopt a rejoiner's last-applied fleet tuning
+        (docs/autotune.md "Rollback flow").  A REBORN scheduler's tuner
+        starts empty at epoch 0; without this its first books would
+        revert every live decision — workers restore launch fusion
+        thresholds and every overridden key migrates home mid-training.
+        The survivors carry the state: each rejoin REGISTER reports the
+        tuning section (plus the ring overrides) the node last adopted,
+        and the successor re-adopts the NEWEST report before emitting
+        its first books.  Monotone by tuning epoch, so a live
+        scheduler — whose own state is at or above anything the fleet
+        ever saw — ignores every report, and racing rejoiners converge
+        on the newest.  Returns True when state moved."""
+        if not isinstance(report, dict):
+            return False
+        try:
+            epoch = int(report.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            return False
+        with self._lock:
+            if epoch <= self.state.epoch:
+                return False
+            self.state.epoch = epoch
+            ft = report.get("fusion_threshold")
+            try:
+                self.state.fusion_threshold = (
+                    None if ft is None else int(ft)
+                )
+            except (TypeError, ValueError):
+                self.state.fusion_threshold = None
+            self.state.codec_off = [
+                str(c) for c in (report.get("codec_off") or ())
+            ]
+            overrides: Dict[int, int] = {}
+            for k, r in (report.get("ring_overrides") or {}).items():
+                try:
+                    overrides[int(k)] = int(r)
+                except (TypeError, ValueError):
+                    continue
+            self.state.overrides = overrides
+            return True
 
     # --- the sweep -------------------------------------------------------
 
@@ -501,11 +549,15 @@ class AutoTuner:
     def _policy_fusion_threshold(self, view: dict) -> Optional[dict]:
         """Walk the fleet fusion threshold by the observed step mix.
         Inputs are cumulative totals from the aggregate (``wire_rpc``,
-        ``fused_frames``, ``fused_keys``); this policy deltas them
-        against the previous sweep.  Shrink when fusion is pure
-        overhead (packs barely coalesce), grow when wire-RPC pressure
-        stays high while packs saturate (or nothing fuses at all); the
-        band between is the hysteresis dead zone."""
+        ``fused_frames``, ``fused_keys``) plus the flight matrix's
+        per-stage dwell totals; this policy deltas both against the
+        previous sweep.  Shrink when fusion is pure overhead (packs
+        barely coalesce AND the FUSE stage dwells a real share of wire
+        time), grow when wire-RPC pressure stays high while packs
+        saturate (or nothing fuses at all) AND the wire stages dominate
+        the pipeline's dwell; the band between is the hysteresis dead
+        zone.  Fleets whose heartbeats carry no dwell (older workers)
+        degrade to the count-only walk."""
         f = view.get("fusion") or {}
         cur = self.state.fusion_threshold
         if cur is None:
@@ -523,18 +575,53 @@ class AutoTuner:
         rpc, fused, keys = (
             deltas["wire_rpc"], deltas["fused_frames"], deltas["fused_keys"]
         )
+        # per-stage dwell deltas (the flight-matrix evidence): where
+        # the workers' step time actually WENT since the last sweep
+        dw: Dict[str, float] = {}
+        for stage, total in (f.get("dwell") or {}).items():
+            name = "dwell." + str(stage)
+            try:
+                tot = float(total)
+            except (TypeError, ValueError):
+                continue
+            dw[str(stage)] = max(0.0, tot - self._fusion_base.get(name, 0.0))
+            self._fusion_base[name] = tot
+        wire_d = dw.get("PUSH", 0.0) + dw.get("FUSE", 0.0)
+        total_d = sum(dw.values())
+        have_dwell = total_d > 0.0
         if rpc <= 0 and fused <= 0:
             return None  # idle sweep: no evidence either way
         avg_pack = keys / fused if fused else 0.0
         new = cur
         if fused and avg_pack <= self.cfg.pack_lo and rpc >= 1:
-            new = max(self.cfg.fusion_min, cur // 2)
+            # dwell veto: degenerate packs only justify a shrink when
+            # the FUSE stage actually dwells a real share of wire time —
+            # a fuser nobody waits on isn't worth a fleet-wide walk step
+            if not have_dwell or wire_d <= 0.0 or (
+                dw.get("FUSE", 0.0) >= self.cfg.dwell_fuse_frac * wire_d
+            ):
+                new = max(self.cfg.fusion_min, cur // 2)
         elif rpc >= self.cfg.rpc_hi and (
             fused == 0 or avg_pack >= self.cfg.pack_hi
         ):
-            new = min(self.cfg.fusion_max, cur * 2)
+            # dwell veto: RPC pressure only justifies a grow when the
+            # wire stages dominate the pipeline — growing the pack size
+            # of a COPYD2H/COMPRESS-bound fleet just adds latency
+            if not have_dwell or (
+                wire_d >= self.cfg.dwell_wire_frac * total_d
+            ):
+                new = min(self.cfg.fusion_max, cur * 2)
         if new == cur:
             return None
+        evidence = {
+            "from": cur, "to": new,
+            "wire_rpc": int(rpc), "fused_frames": int(fused),
+            "avg_pack": round(avg_pack, 2),
+            "band": [self.cfg.pack_lo, self.cfg.pack_hi],
+        }
+        if have_dwell:
+            evidence["dwell_wire_s"] = round(wire_d, 6)
+            evidence["dwell_total_s"] = round(total_d, 6)
         return {
             "rule": "fusion_threshold",
             "set": {"fusion_threshold": new},
@@ -543,12 +630,7 @@ class AutoTuner:
             # workers read as "untouched" — the regressed threshold
             # would survive its own rollback
             "undo": {"fusion_threshold": cur},
-            "evidence": {
-                "from": cur, "to": new,
-                "wire_rpc": int(rpc), "fused_frames": int(fused),
-                "avg_pack": round(avg_pack, 2),
-                "band": [self.cfg.pack_lo, self.cfg.pack_hi],
-            },
+            "evidence": evidence,
         }
 
     def _policy_codec_consensus(self, view: dict) -> Optional[dict]:

@@ -540,6 +540,11 @@ class PipelineEngine:
             self._maybe_setup_compression(ctx, np_dtype, flat.size * np_dtype.itemsize)
 
         self._prepare_round(ctx, dtype_id, flat.size, build_partitions, on_first_init)
+        # server-opt tensors pull UPDATED PARAMETERS, not gradient sums:
+        # the worker-side divide must not fire (the declared rule folds
+        # averaging server-side, same float op order)
+        if self._server_opt_profile(ctx)[0]:
+            average = False
         # jax input + bare codec chain ⇒ the device path: compress before
         # D2H, decode after H2D, assemble the result on device — no host
         # result buffer is ever written, so don't allocate one (the whole
@@ -676,6 +681,16 @@ class PipelineEngine:
                     {"async_profile": True, "staleness": staleness}
                     if is_async else {}
                 )
+                opt_name, opt_hp = self._server_opt_profile(ctx)
+                if opt_name:
+                    # server-opt profile rides the same INIT extension
+                    # (profile-byte bit 1 + rule block); "average" ships
+                    # as a hyperparam because the divide now happens
+                    # server-side, inside the rule
+                    hp = dict(opt_hp)
+                    hp.setdefault("average", True)
+                    akw["server_opt"] = opt_name
+                    akw["server_opt_hp"] = hp
                 for part in ctx.partitions:
                     if self._traced():
                         from byteps_tpu.core.tracing import (
@@ -750,6 +765,14 @@ class PipelineEngine:
 
         registry = get_registry()
         ctx = registry.declare(name)
+        if self._server_opt_profile(ctx)[0]:
+            # the row-sparse wire path scatter-sums rows into the dense
+            # store; a server-side rule would update against a partial
+            # accumulator — refuse instead of training wrong
+            raise ValueError(
+                f"tensor {name!r}: the server-side optimizer profile "
+                "does not support row-sparse push_pull (dense only)"
+            )
 
         def build_partitions(c):
             from byteps_tpu.common.types import Partition
@@ -913,6 +936,34 @@ class PipelineEngine:
             else self.cfg.staleness_bound
         )
         return True, max(-1, bound)
+
+    def _server_opt_profile(self, ctx) -> tuple:
+        """(rule name or None, hyperparam dict) for a tensor's keys
+        (docs/architecture.md "Server-side optimizer"): the declare-time
+        ``byteps_server_opt`` / ``byteps_server_opt_hp`` kwargs override
+        the process-wide ``BYTEPS_SERVER_OPT`` / ``BYTEPS_SERVER_OPT_HP``
+        — per-tensor rules on one worker.  ``byteps_server_opt`` accepts
+        a rule name, or a falsy string to force a tensor back to plain
+        SUM under a fleet-wide rule."""
+        raw = ctx.kwargs.get("byteps_server_opt")
+        if raw is None or raw == "":
+            name = self.cfg.server_opt
+        elif str(raw).lower() in ("0", "false", "no", "off"):
+            name = ""
+        else:
+            name = str(raw).strip().lower()
+        if not name:
+            return None, {}
+        hp_raw = ctx.kwargs.get("byteps_server_opt_hp")
+        if hp_raw in (None, ""):
+            hp_raw = self.cfg.server_opt_hp
+        if isinstance(hp_raw, dict):
+            hp = dict(hp_raw)
+        else:
+            from byteps_tpu.server.update_rules import parse_hp
+
+            hp = parse_hp(hp_raw)
+        return name, hp
 
     @staticmethod
     def _job_labels(job: int):

@@ -336,6 +336,16 @@ class FlightRecorder:
             c["rpc"] = {
                 rank: v.get("p99", 0.0) for rank, v in (r.get("rpc") or {}).items()
             }
+            # per-stage dwell delta, compacted to {stage: [n, seconds]}:
+            # the scheduler's fusion-threshold walk reads WHERE each
+            # step's time went (docs/autotune.md "Fusion-threshold
+            # walk"), not just how many packs crossed the wire
+            st = {
+                name: [v.get("n", 0), v.get("s", 0.0)]
+                for name, v in (r.get("stages") or {}).items()
+            }
+            if st:
+                c["st"] = st
             out.append(c)
         return out
 

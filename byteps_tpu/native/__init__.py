@@ -311,6 +311,7 @@ NATIVE_COUNTER_NAMES = (
     "native_async_reject",
     "native_checksum_fail",
     "native_checksum_conn_drop",
+    "native_server_opt_reject",
 )
 
 

@@ -130,6 +130,9 @@ enum NativeCounter {
                       // wire integrity; docs/robustness.md)
   kCtrChecksumConnDrop,  // connections dropped after
                          // BYTEPS_CHECKSUM_CONN_LIMIT mismatches
+  kCtrServerOptReject,   // server-opt-profile INITs refused (the update
+                         // plane is Python-engine-only; appended LAST so
+                         // an older .so keeps its index mapping)
   kCtrCount,
 };
 
@@ -144,6 +147,7 @@ const char* const kCounterNames[kCtrCount] = {
     "native_resync_query",    "native_zombie_reject", "native_span_drop",
     "native_wrong_owner",     "native_job_reject",    "native_async_reject",
     "native_checksum_fail",   "native_checksum_conn_drop",
+    "native_server_opt_reject",
 };
 
 // ---------------------------------------------------------------------------
@@ -1920,6 +1924,24 @@ class NativeServer {
                 (unsigned long long)key);
       }
       ctr_[kCtrAsyncReject].fetch_add(1, std::memory_order_relaxed);
+      send_msg(conn, kInit, seq, key, 0, nullptr, 0, /*status=*/1);
+      return true;
+    }
+    // Server-opt profile (bit 1, docs/architecture.md "Server-side
+    // optimizer"): the worker asked this engine to RUN the update rule
+    // and serve parameters.  This engine only SUMs — accepting would
+    // silently hand the worker raw gradient sums where it expects
+    // parameters, so reject cleanly like the async precedent.
+    if (payload.size() >= 13 && (payload[12] & 2)) {
+      static std::atomic<bool> warned_opt{false};
+      if (!warned_opt.exchange(true)) {
+        fprintf(stderr,
+                "byteps-native: rejecting server-opt-profile init "
+                "(key %llx) — the server-side optimizer plane is "
+                "Python-engine-only (docs/architecture.md)\n",
+                (unsigned long long)key);
+      }
+      ctr_[kCtrServerOptReject].fetch_add(1, std::memory_order_relaxed);
       send_msg(conn, kInit, seq, key, 0, nullptr, 0, /*status=*/1);
       return true;
     }

@@ -86,12 +86,15 @@ class DistributedOptimizer:
 
     def __init__(
         self,
-        optimizer: optax.GradientTransformation,
+        optimizer: Optional[optax.GradientTransformation] = None,
         named_parameters: Optional[Sequence[str]] = None,
         compression: Any = None,
         backward_passes_per_step: int = 1,
         axis_names: Sequence[str] = (DP_AXIS,),
         average: bool = True,
+        server_side: bool = False,
+        server_rule: str = "sgd",
+        server_hp: Optional[dict] = None,
     ) -> None:
         self.inner = optimizer
         self.axis_names = tuple(axis_names)
@@ -101,18 +104,101 @@ class DistributedOptimizer:
         self.priorities = {
             name: -i for i, name in enumerate(named_parameters or [])
         }
-        self._tx = distributed_optimizer(optimizer, axis_names, average)
-        if backward_passes_per_step > 1:
-            self._tx = optax.MultiSteps(self._tx, backward_passes_per_step)
+        # server-side optimizer mode (docs/architecture.md "Server-side
+        # optimizer"): the PS fleet RUNS the update rule — this wrapper
+        # holds ZERO local optimizer state (no optax slots), pushes
+        # gradients and assigns the pulled, already-updated parameters.
+        # ``server_rule``/``server_hp`` name the server's rule; the
+        # user's optax ``optimizer`` is ignored in this mode (the rule
+        # is the optimizer).
+        self.server_side = bool(server_side)
+        self.server_rule = str(server_rule)
+        self.server_hp = dict(server_hp or {})
+        self._server_seeded = False
+        if self.server_side:
+            self._tx = None
+        elif optimizer is None:
+            raise TypeError(
+                "DistributedOptimizer needs an optax optimizer unless "
+                "server_side=True (the PS fleet runs the rule then)"
+            )
+        else:
+            self._tx = distributed_optimizer(optimizer, axis_names, average)
+            if backward_passes_per_step > 1:
+                self._tx = optax.MultiSteps(self._tx, backward_passes_per_step)
 
     def init(self, params):
+        if self.server_side:
+            # the whole point: worker optimizer-state bytes -> 0
+            return optax.EmptyState()
         return self._tx.init(params)
 
     def update(self, grads, state, params=None):
+        if self.server_side:
+            raise RuntimeError(
+                "DistributedOptimizer(server_side=True) has no local "
+                "update — call server_step(params, grads) and assign "
+                "the returned parameters"
+            )
         return self._tx.update(grads, state, params)
+
+    # --- server-side mode ------------------------------------------------
+
+    def _server_names(self, tree) -> list:
+        import jax as _jax
+
+        leaves_with_path = _jax.tree_util.tree_flatten_with_path(tree)[0]
+        return [
+            ("param" + _jax.tree_util.keystr(path), leaf)
+            for path, leaf in leaves_with_path
+        ]
+
+    def server_step(self, params, grads):
+        """One server-updated step: push this worker's gradients, pull
+        the parameters the owning servers computed, return them as the
+        new parameter tree (same structure as ``params``).
+
+        The FIRST call seeds the fleet: every worker pushes its
+        (identical) initial parameters, which the servers adopt
+        verbatim before any rule fires — so call it with the same
+        initial params on every worker.  No optax state exists on this
+        worker in this mode; the rule's slots live with each key's
+        owning server and migrate with it on reshard."""
+        if not self.server_side:
+            raise RuntimeError("server_step requires server_side=True")
+        from byteps_tpu import api as _api
+
+        def _round(tree):
+            named = self._server_names(tree)
+            handles = []
+            for name, leaf in named:
+                _api.declare_tensor(
+                    name,
+                    byteps_server_opt=self.server_rule,
+                    byteps_server_opt_hp=self.server_hp,
+                )
+                handles.append(_api.push_pull_async(
+                    leaf, name=name,
+                    priority=self.priorities.get(name, 0),
+                ))
+            outs = [_api.synchronize(h) for h in handles]
+            import jax as _jax
+
+            treedef = _jax.tree_util.tree_structure(tree)
+            return _jax.tree_util.tree_unflatten(treedef, outs)
+
+        if not self._server_seeded:
+            self._server_seeded = True
+            _round(params)  # seed round: servers adopt initial params
+        return _round(grads)
 
     @property
     def gradient_transformation(self) -> optax.GradientTransformation:
+        if self.server_side:
+            raise RuntimeError(
+                "server_side=True carries no local gradient "
+                "transformation — the update runs on the PS fleet"
+            )
         return self._tx
 
 

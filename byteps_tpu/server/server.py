@@ -88,6 +88,11 @@ class _KeyState:
         "job",
         "async_mode",
         "staleness",
+        "opt_rule",
+        "opt_rule_name",
+        "opt_hp",
+        "opt_step",
+        "opt_seeded",
         "req_bytes",
         "lock",
     )
@@ -152,6 +157,21 @@ class _KeyState:
         self.job = 0
         self.async_mode = False
         self.staleness = -1
+        # server-side optimizer plane (docs/architecture.md "Server-side
+        # optimizer"): the INIT profile's bit 1 declares an update rule
+        # (server/update_rules.py) for this key — workers push gradients
+        # and pull UPDATED PARAMETERS.  opt_step counts completed rounds
+        # (0 = the parameter seed round hasn't published yet); opt_seeded
+        # is the async-mode per-worker seed ledger (each worker's first
+        # push carries its initial params, adopted once, never summed).
+        # All of it lives behind ks.lock like the rest of the round
+        # state, ships in MIGRATE_STATE, and survives the re-init
+        # barrier (store contents do too).
+        self.opt_rule = None  # update_rules.UpdateRule instance
+        self.opt_rule_name: Optional[str] = None
+        self.opt_hp: Dict[str, Any] = {}
+        self.opt_step = 0
+        self.opt_seeded: set = set()
         # cumulative data-plane request bytes (docs/autotune.md): fed by
         # _enqueue on the serve threads, read per heartbeat by the
         # hot-key report.  Bare += across threads may lose an increment
@@ -665,6 +685,16 @@ class PSServer:
                     # recovered-conn barrier bypass may be armed
                     "reconnect": True,
                 })
+                # last-observed fleet tuning + placement overrides: a
+                # reborn scheduler's tuner re-adopts these before its
+                # first books (AutoTuner.adopt_rejoin_report), so the
+                # overridden keys this server holds stay put
+                rep = dict(getattr(self, "_seen_tuning", None) or {})
+                ov = getattr(self, "_seen_ring_overrides", None)
+                if ov:
+                    rep["ring_overrides"] = dict(ov)
+                if rep:
+                    payload["tuning"] = rep
             send_message(
                 conn, Message(Op.REGISTER, payload=json.dumps(payload).encode())
             )
@@ -734,8 +764,24 @@ class PSServer:
         if epoch is not None and int(epoch) > getattr(self, "membership_epoch", 0):
             self.membership_epoch = int(epoch)
         me = book.get("map_epoch")
-        if me is not None and int(me) > getattr(self, "_map_epoch", 0):
+        if me is not None and int(me) >= getattr(self, "_map_epoch", 0):
             self._map_epoch = int(me)
+            # newest placement overrides observed: reported back on a
+            # rejoin re-REGISTER (with the tuning section below) so a
+            # reborn scheduler re-adopts placement instead of migrating
+            # every overridden key home on its first book
+            self._seen_ring_overrides = dict(
+                book.get("ring_overrides") or {}
+            )
+        t = book.get("tuning")
+        if isinstance(t, dict):
+            try:
+                te = int(t.get("epoch", 0) or 0)
+            except (TypeError, ValueError):
+                te = 0
+            if te >= int(getattr(self, "_seen_tuning_epoch", 0) or 0):
+                self._seen_tuning_epoch = te
+                self._seen_tuning = dict(t)
         self._adopt_tuning(book)
 
     def _adopt_tuning(self, book: dict) -> None:
@@ -1304,6 +1350,20 @@ class PSServer:
             accum_b = ks.accum.tobytes() if ks.recv_count else b""
             meta["store_nbytes"] = len(store_b)
             meta["accum_nbytes"] = len(accum_b)
+            # server-side optimizer state moves WITH the store
+            # (docs/architecture.md): slot arrays ride as raw tails
+            # behind the accumulator (decode_migrate_extra) so the
+            # trajectory continues bitwise at the new owner; the codec's
+            # pinned (meta, store, accum) 3-tuple is untouched.
+            extra_b = b""
+            if ks.opt_rule is not None:
+                slot_blobs = ks.opt_rule.slot_bytes()
+                meta["opt_rule"] = str(ks.opt_rule_name)
+                meta["opt_hp"] = dict(ks.opt_hp)
+                meta["opt_step"] = int(ks.opt_step)
+                meta["opt_seeded"] = sorted(int(w) for w in ks.opt_seeded)
+                meta["opt_slot_nbytes"] = [len(b) for b in slot_blobs]
+                extra_b = b"".join(slot_blobs)
             # tombstone BEFORE the wire hop: requests from here on get
             # WRONG_OWNER, so no push can mutate state already serialized
             ks.migrated_to = owner
@@ -1321,7 +1381,8 @@ class PSServer:
                 conns[owner] = sock
             send_message(sock, Message(
                 Op.MIGRATE_STATE, key=key, version=epoch,
-                payload=encode_migrate_state(meta, store_b, accum_b),
+                payload=encode_migrate_state(meta, store_b, accum_b)
+                + extra_b,
             ))
             resp = recv_message(sock)
             # status 3 = "already authoritative at destination" (an
@@ -1356,6 +1417,11 @@ class PSServer:
             ks.raw_payload = None
             ks.raw_version = -1
             ks.compressor = None
+            ks.opt_rule = None
+            ks.opt_rule_name = None
+            ks.opt_hp = {}
+            ks.opt_step = 0
+            ks.opt_seeded = set()
         counters().bump("migration_keys_moved")
         metrics().observe("migration_key_seconds", time.time() - t0)
         return True
@@ -1516,6 +1582,11 @@ class PSServer:
             epoch = int(meta.get("epoch", msg.version))
             dtype = np.dtype(str(meta["dtype"]))
             store_version = int(meta.get("store_version", 0))
+            extra_b = b""
+            if meta.get("opt_rule"):
+                from byteps_tpu.comm.transport import decode_migrate_extra
+
+                extra_b = decode_migrate_extra(msg.payload, meta)
         except (KeyError, ValueError, TypeError, UnicodeDecodeError,
                 _struct.error):
             close_socket(conn)  # malformed control frame: drop, like resync
@@ -1550,7 +1621,8 @@ class PSServer:
                 already_home = True
             else:
                 self._install_migrated_locked(
-                    ks, epoch, dtype, store_version, meta, store_b, accum_b
+                    ks, epoch, dtype, store_version, meta, store_b, accum_b,
+                    extra_b,
                 )
         if already_home:
             send_message(conn, Message(
@@ -1572,7 +1644,8 @@ class PSServer:
 
     def _install_migrated_locked(self, ks: _KeyState, epoch: int, dtype,
                                  store_version: int, meta: dict,
-                                 store_b: bytes, accum_b: bytes) -> None:
+                                 store_b: bytes, accum_b: bytes,
+                                 extra_b: bytes = b"") -> None:
         """Install one migrated key state under ``ks.lock`` (split out of
         :meth:`_handle_migrate` so the reply never rides inside the key
         lock).  Ordering rules in the caller's docstring."""
@@ -1611,6 +1684,34 @@ class PSServer:
             if meta.get("async_mode"):
                 ks.async_mode = True
                 ks.staleness = max(-1, int(meta.get("staleness", -1)))
+            # server-side optimizer state: rebuild the rule and reload
+            # its slots from the raw tail so the trajectory continues
+            # bitwise at this owner (tests/test_reshard.py pins it)
+            ks.opt_rule = None
+            ks.opt_rule_name = None
+            ks.opt_hp = {}
+            ks.opt_step = 0
+            ks.opt_seeded = set()
+            if meta.get("opt_rule"):
+                from byteps_tpu.server import update_rules
+
+                hp = meta.get("opt_hp") or {}
+                rule = update_rules.make_rule(
+                    meta["opt_rule"], hp, store.size, dtype
+                )
+                blobs: List[bytes] = []
+                off = 0
+                for nb in meta.get("opt_slot_nbytes") or ():
+                    blobs.append(extra_b[off : off + int(nb)])
+                    off += int(nb)
+                rule.load_slot_bytes(blobs)
+                ks.opt_rule = rule
+                ks.opt_rule_name = str(meta["opt_rule"])
+                ks.opt_hp = dict(hp)
+                ks.opt_step = int(meta.get("opt_step", 0))
+                ks.opt_seeded = {
+                    int(w) for w in (meta.get("opt_seeded") or ())
+                }
             ks.compressor = None
             if ks.compressor_kwargs:
                 from byteps_tpu.compression.registry import create_compressor
@@ -1883,9 +1984,29 @@ class PSServer:
         n, dtype_id = struct.unpack_from("!QI", msg.payload, 0)
         async_profile = False
         staleness = -1
+        opt_declared = False
+        opt_name: Optional[str] = None
+        opt_hp: Dict[str, Any] = {}
         if len(msg.payload) >= 17:
             profile, staleness = struct.unpack_from("!Bi", msg.payload, 12)
             async_profile = bool(profile & 1)
+            # bit 1: the server-side optimizer profile — rule name +
+            # canonical-JSON hyperparams follow at offset 17
+            # (transport.decode_server_opt_block).  A malformed block is
+            # a status=1 rejection, never a silent downgrade to SUM.
+            if profile & 2:
+                from byteps_tpu.comm.transport import decode_server_opt_block
+                from byteps_tpu.server import update_rules
+
+                try:
+                    opt_name, hp_raw = decode_server_opt_block(
+                        msg.payload, 17
+                    )
+                    opt_hp = update_rules.parse_hp(hp_raw)
+                    opt_declared = True
+                except ValueError as exc:
+                    self._reject_server_opt(msg, conn, send_lock, exc)
+                    return
         ks = self._key_state(msg.key)
         wid = msg.flags
         token = msg.version
@@ -1908,6 +2029,43 @@ class PSServer:
                 ks.dtype = dtype
                 ks.store = np.zeros(n, dtype=dtype)
                 ks.accum = np.zeros(n, dtype=dtype)
+            # server-opt profile, adopted from EVERY init like async_mode
+            # above: a re-init without the extension returns the key to
+            # plain SUM semantics.  Same (rule, hp) keeps the live slots
+            # and step count across re-init barriers (elastic resizes
+            # re-declare every key); a changed config rebuilds from
+            # zero-state — documented in docs/architecture.md.
+            if redirect is None:
+                if opt_declared:
+                    from byteps_tpu.server import update_rules
+
+                    if not update_rules.same_config(
+                        ks.opt_rule, opt_name, opt_hp
+                    ):
+                        try:
+                            ks.opt_rule = update_rules.make_rule(
+                                opt_name, opt_hp, len(ks.store), ks.dtype
+                            )
+                        except ValueError as exc:
+                            ks.opt_rule = None
+                            ks.opt_rule_name = None
+                            ks.opt_hp = {}
+                            ks.opt_step = 0
+                            ks.opt_seeded = set()
+                            self._reject_server_opt(
+                                msg, conn, send_lock, exc
+                            )
+                            return
+                        ks.opt_rule_name = opt_name
+                        ks.opt_hp = dict(opt_hp)
+                        ks.opt_step = 0
+                        ks.opt_seeded = set()
+                elif ks.opt_rule is not None:
+                    ks.opt_rule = None
+                    ks.opt_rule_name = None
+                    ks.opt_hp = {}
+                    ks.opt_step = 0
+                    ks.opt_seeded = set()
             # init-idempotency (docs/robustness.md): a replayed INIT whose
             # barrier already COMPLETED — the retry of a dropped ack after
             # the barrier released — is acked from the completed-barrier
@@ -1955,6 +2113,26 @@ class PSServer:
         if waiters is None:
             return
         self._release_init_waiters(msg.key, waiters)
+
+    def _reject_server_opt(self, msg: Message, conn, send_lock, exc) -> None:
+        """status=1 INIT rejection for a server-opt profile this engine
+        cannot honor (unknown rule, non-floating store, torn block) —
+        the client raises with the why; never a silent SUM downgrade."""
+        from byteps_tpu.common import logging as bpslog
+        from byteps_tpu.core.telemetry import counters
+
+        counters().bump("server_opt_reject")
+        bpslog.warning(
+            "rejecting server-opt INIT for key %d: %s", msg.key, exc
+        )
+        try:
+            send_message(
+                conn,
+                Message(Op.INIT, key=msg.key, seq=msg.seq, status=1),
+                send_lock,
+            )
+        except (ConnectionError, OSError):
+            pass
 
     def _complete_init_barrier_locked(self, ks: "_KeyState"):
         """If the key's init barrier is full, consume it and reset the
@@ -2141,13 +2319,53 @@ class PSServer:
         only AFTER the summation succeeded (a sum that raises must leave
         the retry eligible)."""
         if self._async_ks(ks):
-            # async mode: parameter store, sum deltas in place
-            # (server.cc:315-319)
-            if compressed:
+            if ks.opt_rule is not None:
+                # async server-opt: the rule fires per push (no round
+                # barrier to average at); the SSP gate then bounds the
+                # PARAMETER version a pull may observe.  Each worker's
+                # FIRST push carries its initial params (the
+                # DistributedOptimizer seed contract) — the first copy
+                # is adopted verbatim, later seeds are identical and
+                # dropped, and a rejoiner (already in the ledger) goes
+                # straight back to gradient pushes.
+                grad = (
+                    ks.compressor.decompress(msg.payload, ks.store.size)
+                    if compressed else arr
+                )
+                wid = msg.flags
+                if wid not in ks.opt_seeded:
+                    if not ks.opt_seeded:
+                        ks.store[:] = grad
+                    ks.opt_seeded.add(wid)
+                else:
+                    ks.opt_step += 1
+                    ks.opt_rule.apply(ks.store, grad, 1, ks.opt_step)
+                    from byteps_tpu.core.telemetry import counters
+
+                    counters().bump("server_opt_updates")
+                ks.store_version += 1
+            elif compressed:
+                # async mode: parameter store, sum deltas in place
+                # (server.cc:315-319)
                 ks.compressor.sum_into(msg.payload, ks.store)
+                ks.store_version += 1
             else:
                 self._reducer(ks.store, arr)
-            ks.store_version += 1
+                ks.store_version += 1
+        elif ks.opt_rule is not None and ks.opt_step == 0:
+            # sync server-opt seed round: every worker pushes the SAME
+            # initial params; adopt the first copy VERBATIM — an
+            # average of N identical float32 copies is not bitwise the
+            # original ((N*x)/N rounds), and the seed must be bitwise
+            # the worker's initial state for trajectory parity.
+            if ks.recv_count == 0:
+                if compressed:
+                    ks.accum[:] = ks.compressor.decompress(
+                        msg.payload, ks.accum.size
+                    )
+                else:
+                    ks.accum[: len(arr)] = arr
+            ks.recv_count += 1
         elif compressed:
             # decompress-then-sum (server.cc:92-118)
             if ks.recv_count == 0:
@@ -2472,8 +2690,33 @@ class PSServer:
 
     def _publish_round_locked(self, ks: "_KeyState", compressed: bool) -> List:
         """ALL_RECV: publish the round, flush buffered pulls
-        (server.cc:348-375).  Caller holds ks.lock; returns the flush list."""
-        ks.store, ks.accum = ks.accum, ks.store
+        (server.cc:348-375).  Caller holds ks.lock; returns the flush list.
+
+        Server-opt keys publish PARAMETERS, not sums: the rule fires
+        here, exactly once per completed round — replayed pushes were
+        deduped before they could re-count toward the barrier
+        (_is_replayed_push_locked), so a retry storm can never fire the
+        rule twice for one round.  The fused path funnels into this
+        same hook, so fusion composes for free."""
+        if ks.opt_rule is not None and not self._async_ks(ks):
+            if ks.opt_step == 0:
+                # seed round: accum holds the workers' (identical)
+                # initial params verbatim — adopt them as the store
+                ks.store, ks.accum = ks.accum, ks.store
+            else:
+                # accum = raw gradient sum; averaging happens inside
+                # the rule (same float op order as the worker engine's
+                # _finalize divide — the low bits are the contract)
+                ks.opt_rule.apply(
+                    ks.store, ks.accum, self._workers_for_ks(ks),
+                    ks.opt_step,
+                )
+                from byteps_tpu.core.telemetry import counters
+
+                counters().bump("server_opt_updates")
+            ks.opt_step += 1
+        else:
+            ks.store, ks.accum = ks.accum, ks.store
         ks.store_version += 1
         ks.recv_count = 0
         if compressed:

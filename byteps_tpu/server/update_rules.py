@@ -1,0 +1,218 @@
+"""Pluggable per-key server-side update rules (the ZeRO-for-PS plane).
+
+Today's servers only SUM: every worker holds the full optimizer state
+and applies the same dense update N times.  This module moves the
+update to the key's owning server — workers push **gradients** and
+pull **updated parameters** — so worker-side optimizer state drops to
+zero bytes and the ownership ring shards the update exactly like the
+cross-replica weight-update-sharding setup (arXiv:2004.13336).
+
+Rules are pure numpy and deterministic: every arithmetic op runs in
+the store dtype (hyperparameters are cast to it at construction), so a
+server-side trajectory is **bitwise-identical** to a worker applying
+the same rule to the same pulled gradient sum.  That property is the
+acceptance contract (``tests/test_server_opt.py``) and the reason the
+worker reference in tests instantiates these very classes locally.
+
+Lifecycle (server side, ``docs/architecture.md`` "Server-side
+optimizer"):
+
+- declared at INIT via the profile extension (bit 1 of the PR 12
+  profile byte) with the rule name + JSON hyperparams;
+- round 1 is the **seed round**: every worker pushes its (identical)
+  initial parameters; the server adopts the first copy verbatim —
+  never an average, so the seed is bitwise the worker's initial state;
+- every later completed round calls :meth:`UpdateRule.apply` exactly
+  once with the raw gradient **sum** (averaging happens inside the
+  rule, with the same float op order as the worker engine's
+  ``_finalize`` divide, because where the divide happens is visible in
+  the low bits);
+- slots ride ``MIGRATE_STATE`` as raw tails behind the accumulator
+  (:meth:`UpdateRule.slot_bytes` / :meth:`UpdateRule.load_slot_bytes`)
+  so a reshard moves the optimizer state with the store.
+
+Only floating stores can carry a rule — integer gradients have no
+meaningful lr — and the native engine rejects the profile outright
+(``native_server_opt_reject``), mirroring the async-profile precedent.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+#: every shipped rule, the order docs/robustness.md lists them in
+RULE_NAMES = ("sgd", "momentum", "adam")
+
+
+class UpdateRule:
+    """Base class: one instance per server-opt key, living in
+    ``_KeyState`` behind the key's shard/stripe lock (no locking in
+    here).  ``apply`` mutates ``params`` in place; ``t`` is the
+    1-based completed-gradient-round count (Adam bias correction)."""
+
+    name = "?"
+
+    def __init__(self, n: int, dtype: np.dtype, hp: Dict) -> None:
+        if not np.issubdtype(dtype, np.floating):
+            raise ValueError(
+                f"server-side optimizer needs a floating store, got {dtype}"
+            )
+        self.n = int(n)
+        self.dtype = np.dtype(dtype)
+        self.hp = dict(hp)
+        #: divide the pushed sum by num_workers before the update —
+        #: mirrors the engine-side ``job.average`` flag, which the
+        #: worker hands off to the server for server-opt keys
+        self.average = bool(hp.get("average", True))
+        self._lr = self.dtype.type(hp.get("lr", self.default_lr()))
+
+    @staticmethod
+    def default_lr() -> float:
+        return 0.01
+
+    # -- the update -------------------------------------------------------
+
+    def apply(
+        self, params: np.ndarray, grad_sum: np.ndarray,
+        num_workers: int, t: int,
+    ) -> None:
+        grad = grad_sum / num_workers if self.average else grad_sum
+        self._update(params, grad, t)
+
+    def _update(self, params: np.ndarray, grad: np.ndarray, t: int) -> None:
+        raise NotImplementedError
+
+    # -- migration surface ------------------------------------------------
+
+    def slots(self) -> List[np.ndarray]:
+        """Optimizer state arrays, fixed order, store dtype — what
+        rides MIGRATE_STATE behind the accumulator."""
+        return []
+
+    def slot_bytes(self) -> List[bytes]:
+        return [s.tobytes() for s in self.slots()]
+
+    def load_slot_bytes(self, blobs: List[bytes]) -> None:
+        slots = self.slots()
+        if len(blobs) != len(slots):
+            raise ValueError(
+                f"rule {self.name}: expected {len(slots)} slot blobs, "
+                f"got {len(blobs)}"
+            )
+        for slot, blob in zip(slots, blobs):
+            arr = np.frombuffer(blob, dtype=self.dtype)
+            if arr.size != slot.size:
+                raise ValueError(
+                    f"rule {self.name}: slot size mismatch "
+                    f"({arr.size} != {slot.size})"
+                )
+            slot[:] = arr
+
+    def state_nbytes(self) -> int:
+        return sum(s.nbytes for s in self.slots())
+
+
+class SGD(UpdateRule):
+    """``params -= lr * grad`` — stateless, zero slots."""
+
+    name = "sgd"
+
+    def _update(self, params: np.ndarray, grad: np.ndarray, t: int) -> None:
+        params -= self._lr * grad
+
+
+class Momentum(UpdateRule):
+    """Classic (heavy-ball) momentum: ``m = mu*m + grad``,
+    ``params -= lr * m``.  One slot."""
+
+    name = "momentum"
+
+    def __init__(self, n: int, dtype: np.dtype, hp: Dict) -> None:
+        super().__init__(n, dtype, hp)
+        self._mu = self.dtype.type(hp.get("momentum", 0.9))
+        self.m = np.zeros(self.n, dtype=self.dtype)
+
+    def _update(self, params: np.ndarray, grad: np.ndarray, t: int) -> None:
+        np.multiply(self.m, self._mu, out=self.m)
+        self.m += grad
+        params -= self._lr * self.m
+
+    def slots(self) -> List[np.ndarray]:
+        return [self.m]
+
+
+class Adam(UpdateRule):
+    """Adam (Kingma & Ba): first/second moments + bias correction by
+    the completed-round count ``t``.  Two slots."""
+
+    name = "adam"
+
+    @staticmethod
+    def default_lr() -> float:
+        return 0.001
+
+    def __init__(self, n: int, dtype: np.dtype, hp: Dict) -> None:
+        super().__init__(n, dtype, hp)
+        self._b1 = self.dtype.type(hp.get("b1", 0.9))
+        self._b2 = self.dtype.type(hp.get("b2", 0.999))
+        self._eps = self.dtype.type(hp.get("eps", 1e-8))
+        self.m = np.zeros(self.n, dtype=self.dtype)
+        self.v = np.zeros(self.n, dtype=self.dtype)
+
+    def _update(self, params: np.ndarray, grad: np.ndarray, t: int) -> None:
+        one = self.dtype.type(1)
+        np.multiply(self.m, self._b1, out=self.m)
+        self.m += (one - self._b1) * grad
+        np.multiply(self.v, self._b2, out=self.v)
+        self.v += (one - self._b2) * (grad * grad)
+        m_hat = self.m / (one - self._b1 ** t)
+        v_hat = self.v / (one - self._b2 ** t)
+        params -= self._lr * (m_hat / (np.sqrt(v_hat) + self._eps))
+
+    def slots(self) -> List[np.ndarray]:
+        return [self.m, self.v]
+
+
+_RULES = {"sgd": SGD, "momentum": Momentum, "adam": Adam}
+assert tuple(sorted(_RULES)) == tuple(sorted(RULE_NAMES))
+
+
+def make_rule(name: str, hp: Dict, n: int, dtype) -> UpdateRule:
+    """Factory — raises ``ValueError`` for unknown rules or
+    non-floating stores, which the server turns into an INIT
+    ``status=1`` rejection (the client explains it)."""
+    cls = _RULES.get(str(name))
+    if cls is None:
+        raise ValueError(
+            f"unknown server update rule {name!r} (have {RULE_NAMES})"
+        )
+    return cls(n, np.dtype(dtype), dict(hp or {}))
+
+
+def canonical_hp(hp: Dict) -> str:
+    """Deterministic JSON for the INIT wire block and migration meta —
+    sorted keys, no whitespace, so equal configs are equal bytes."""
+    return json.dumps(dict(hp or {}), sort_keys=True, separators=(",", ":"))
+
+
+def parse_hp(blob) -> Dict:
+    if not blob:
+        return {}
+    obj = json.loads(blob if isinstance(blob, str) else blob.decode("utf-8"))
+    if not isinstance(obj, dict):
+        raise ValueError("server-opt hyperparams must be a JSON object")
+    return obj
+
+
+def same_config(rule: UpdateRule, name: str, hp: Dict) -> bool:
+    """True when an existing rule instance already matches a freshly
+    declared (name, hp) — a re-INIT with the same config keeps the
+    slots and step count; a different config rebuilds from zero."""
+    return (
+        rule is not None
+        and rule.name == str(name)
+        and canonical_hp(rule.hp) == canonical_hp(hp)
+    )

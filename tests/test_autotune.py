@@ -129,11 +129,14 @@ class TestHotKeyRebalance:
 
 
 class TestFusionWalk:
-    def fusion_view(self, thr, rpc, fused, keys):
+    def fusion_view(self, thr, rpc, fused, keys, dwell=None):
+        f = {"threshold": thr, "wire_rpc": rpc,
+             "fused_frames": fused, "fused_keys": keys}
+        if dwell is not None:
+            f["dwell"] = dwell
         return {
             "steps": {}, "num_workers": 2, "codec_votes": {},
-            "fusion": {"threshold": thr, "wire_rpc": rpc,
-                       "fused_frames": fused, "fused_keys": keys},
+            "fusion": f,
         }
 
     def test_raise_on_pressure_with_saturated_packs(self):
@@ -147,6 +150,55 @@ class TestFusionWalk:
         t.sweep(self.fusion_view(65536, 0, 0, 0))
         res = t.sweep(self.fusion_view(65536, 100, 100, 110))  # avg 1.1
         assert res["actions"][0]["set"]["fusion_threshold"] == 32768
+
+    def test_dwell_vetoes_grow_when_fleet_is_not_wire_bound(self):
+        # counts scream pressure, but the flight matrix says the steps
+        # live in COPYD2H — doubling the pack size can't help, so the
+        # dwell evidence vetoes the walk step
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.fusion_view(65536, 0, 0, 0,
+                                 dwell={"PUSH": 0.0, "COPYD2H": 0.0}))
+        res = t.sweep(self.fusion_view(
+            65536, 500, 10, 100,
+            dwell={"PUSH": 0.01, "COPYD2H": 10.0}))
+        assert not res["actions"]
+
+    def test_dwell_confirms_grow_when_wire_dominates(self):
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.fusion_view(65536, 0, 0, 0,
+                                 dwell={"PUSH": 0.0, "COPYD2H": 0.0}))
+        res = t.sweep(self.fusion_view(
+            65536, 500, 10, 100,
+            dwell={"PUSH": 8.0, "COPYD2H": 2.0}))
+        act = res["actions"][0]
+        assert act["set"]["fusion_threshold"] == 131072
+        assert act["evidence"]["dwell_wire_s"] > 0
+
+    def test_dwell_vetoes_shrink_when_fuse_stage_is_free(self):
+        # degenerate packs, but nobody actually dwells in FUSE — the
+        # fuser costs no time, so halving the threshold is pure churn
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.fusion_view(65536, 0, 0, 0,
+                                 dwell={"PUSH": 0.0, "FUSE": 0.0}))
+        res = t.sweep(self.fusion_view(
+            65536, 100, 100, 110,
+            dwell={"PUSH": 10.0, "FUSE": 0.001}))
+        assert not res["actions"]
+
+    def test_dwell_deltas_not_totals_drive_the_walk(self):
+        # the view ships WINDOWED TOTALS; the policy must delta them —
+        # a second sweep with the same totals is a zero-dwell sweep and
+        # the count veto applies (wire share of 0 total → count-only
+        # fallback must NOT kick in: have_dwell goes False, walk runs)
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.fusion_view(65536, 0, 0, 0,
+                                 dwell={"PUSH": 8.0, "COPYD2H": 2.0}))
+        # same dwell totals → delta 0 → no dwell evidence this sweep;
+        # the count-only walk still grows on pressure
+        res = t.sweep(self.fusion_view(
+            65536, 500, 10, 100,
+            dwell={"PUSH": 8.0, "COPYD2H": 2.0}))
+        assert res["actions"][0]["set"]["fusion_threshold"] == 131072
 
     def test_rollback_restores_concrete_previous_value(self):
         # the undo must carry the OBSERVED pre-action threshold, never
@@ -418,6 +470,39 @@ class TestClientAdoption:
         pc._adopt_tuning({})
         pc._adopt_tuning({"tuning": "garbage"})
         assert pc.tuning is None
+
+    def test_tuning_report_carries_state_and_overrides(self):
+        # the rejoin REGISTER's state-reconstruction report: last
+        # adopted tuning section + newest ring overrides seen
+        pc = self._stub_client()
+        pc._seen_ring_overrides = {}
+        assert pc._tuning_report() is None  # no tuner ever armed
+        pc._adopt_tuning({"tuning": {"epoch": 4, "fusion_threshold": 8192}})
+        pc._seen_ring_overrides = {"65536": 1}
+        rep = pc._tuning_report()
+        assert rep["epoch"] == 4 and rep["fusion_threshold"] == 8192
+        assert rep["ring_overrides"] == {"65536": 1}
+
+    def test_adopt_rejoin_report_monotone(self):
+        t = mk_tuner([0.0])
+        assert t.adopt_rejoin_report({
+            "epoch": 7, "fusion_threshold": 131072,
+            "codec_off": ["topk"], "ring_overrides": {"65536": 1},
+        })
+        assert t.state.epoch == 7
+        assert t.state.fusion_threshold == 131072
+        assert t.state.codec_off == ["topk"]
+        assert t.state.overrides == {65536: 1}
+        # stale / garbage reports are refused, state untouched
+        assert not t.adopt_rejoin_report({"epoch": 6,
+                                          "fusion_threshold": 1})
+        assert not t.adopt_rejoin_report("garbage")
+        assert not t.adopt_rejoin_report({"epoch": "x"})
+        assert t.state.fusion_threshold == 131072
+        # the re-adopted override rides the next book like any decision
+        extras = t.book_extras([1])
+        assert extras["ring_overrides"] == {"65536": 1}
+        assert extras["tuning"]["epoch"] == 7
 
     def test_scheduler_rebirth_resets_tuning_fence(self):
         # a reborn scheduler's tuner restarts at epoch 0; the monotone

@@ -1314,6 +1314,80 @@ class TestTraceMergeAttribution:
         assert red["0"]["share_of_sum"] == pytest.approx(0.75)
         assert red["1"]["share_of_sum"] == pytest.approx(0.25)
 
+    def test_stripe_identity_derived_from_tid_occupancy(self, tmp_path):
+        """Reducer-lane spans whose args lack a ``stripe`` field still
+        land in the per-stripe occupancy: identity falls back to the
+        ``stripe<N>`` track (tid) the drain files every lane span under,
+        and occupancy counts EVERY stage on the lane, not just sum."""
+        tm = self._merge_tool()
+        T = 0xD1
+        self._write(tmp_path, "0", [
+            self._span("worker0", "k", "PUSH", 0, 2000, trace=T, span=0x8),
+        ])
+        self._write(tmp_path, "server0", [
+            # no stripe arg anywhere — tid carries the lane identity
+            self._span("server0", "stripe0", "sum", 100, 300, trace=T,
+                       span=0x60, parent=0x8, engine="native"),
+            self._span("server0", "stripe0", "publish", 400, 100, trace=T,
+                       span=0x61, parent=0x8, engine="native"),
+            self._span("server0", "stripe1", "sum", 100, 100, trace=T,
+                       span=0x62, parent=0x8, engine="native"),
+            # control-thread span on a key track: never a lane
+            self._span("server0", "key3", "reply", 600, 50, trace=T,
+                       span=0x63, parent=0x8, engine="native"),
+        ])
+        attrib = tm.critical_path(
+            tm.merge(tm.find_trace_files([str(tmp_path)])))
+        red = attrib["engines"]["native"]["reducers"]
+        assert set(red) == {"0", "1"}
+        # sum split still only counts sum stages
+        assert red["0"]["sum_total_s"] == pytest.approx(300e-6)
+        # occupancy counts sum + publish on the lane
+        assert red["0"]["busy_total_s"] == pytest.approx(400e-6)
+        assert red["0"]["occupancy"] == pytest.approx(0.8)
+        assert red["1"]["occupancy"] == pytest.approx(0.2)
+
+    def test_skewed_occupancy_feeds_hot_stripe_trigger(self, tmp_path):
+        """The attribution pass runs the flight recorder's OWN
+        hot_stripe rule on the per-lane occupancy: a skewed key hash
+        found offline and one caught live are judged identically."""
+        tm = self._merge_tool()
+        T = 0xD2
+        self._write(tmp_path, "0", [
+            self._span("worker0", "k", "PUSH", 0, 20000, trace=T, span=0x9),
+        ])
+        # stripe0 is hot: 10 ms busy vs 2 ms siblings (past the
+        # rule's 3× median bar and its 1 ms absolute floor)
+        self._write(tmp_path, "server0", [
+            self._span("server0", "stripe0", "sum", 0, 10000, trace=T,
+                       span=0x70, parent=0x9, engine="native"),
+            self._span("server0", "stripe1", "sum", 0, 2000, trace=T,
+                       span=0x71, parent=0x9, engine="native"),
+            self._span("server0", "stripe2", "sum", 0, 2000, trace=T,
+                       span=0x72, parent=0x9, engine="native"),
+        ])
+        attrib = tm.critical_path(
+            tm.merge(tm.find_trace_files([str(tmp_path)])))
+        hot = attrib["engines"]["native"]["hot_stripe"]
+        assert hot["stripe"] == "0"
+        assert hot["sum_seconds"] == pytest.approx(0.01)
+        assert hot["sibling_median"] == pytest.approx(0.002)
+        assert hot["share"] == pytest.approx(10.0 / 14.0, rel=1e-3)
+        # balanced lanes: same pipeline, no verdict
+        bal = tmp_path / "balanced"
+        self._write(bal, "0", [
+            self._span("worker0", "k", "PUSH", 0, 20000, trace=T, span=0xA),
+        ])
+        self._write(bal, "server0", [
+            self._span("server0", "stripe0", "sum", 0, 2000, trace=T,
+                       span=0x80, parent=0xA, engine="native"),
+            self._span("server0", "stripe1", "sum", 0, 2100, trace=T,
+                       span=0x81, parent=0xA, engine="native"),
+        ])
+        attrib = tm.critical_path(
+            tm.merge(tm.find_trace_files([str(bal)])))
+        assert "hot_stripe" not in attrib["engines"]["native"]
+
     def test_cli_writes_attribution_artifact(self, tmp_path):
         tm = self._merge_tool()
         T = 0xBB

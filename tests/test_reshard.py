@@ -420,6 +420,115 @@ class TestMigrationWire:
             srv.stop()
 
 
+class TestOptimizerStateMigration:
+    """Server-side optimizer keys (docs/architecture.md "Server-side
+    optimizer") migrate their rule WITH the store: slot tensors ride the
+    MIGRATE_STATE frame as a raw tail after the accum blob, the step
+    count and per-worker seed ledger ride the meta, and the trajectory
+    at the new owner continues BITWISE — a reshard mid-run is invisible
+    to the update math."""
+
+    def test_reshard_moves_adam_slots_and_trajectory_stays_bitwise(self):
+        from byteps_tpu.comm.transport import encode_server_opt_block
+        from byteps_tpu.server.update_rules import canonical_hp, make_rule
+
+        a = _wire_server()
+        b = _wire_server()
+        a.rank, b.rank = 0, 1
+        key = _key_owned_by(1, [0, 1])  # re-homes to b under epoch 2
+        n = 32
+        hp = {"lr": 0.002}
+        rng = np.random.default_rng(21)
+        x0 = rng.standard_normal(n).astype(np.float32)
+        # local reference: same rule class, same op order, 1 worker
+        ref = make_rule("adam", hp, n, np.dtype(np.float32))
+        ref_params = x0.copy()
+        ref_t = 0
+
+        def _ref_step(g):
+            nonlocal ref_t
+            ref_t += 1
+            ref.apply(ref_params, g, 1, ref_t)
+            return ref_params
+
+        payload = (struct.pack("!QI", n, F32)
+                   + struct.pack("!Bi", 2, -1)
+                   + encode_server_opt_block("adam", canonical_hp(hp)))
+        w = connect(a.host, a.port)
+        w.settimeout(15)
+        try:
+            send_message(w, Message(Op.INIT, key=key, seq=1, flags=1,
+                                    version=77, payload=payload))
+            r = recv_message(w)
+            assert r.op == Op.INIT and r.status == 0
+            # seed round, then two Adam rounds at the OLD owner
+            grads = {}
+            for ver in (1, 2, 3):
+                g = x0 if ver == 1 else rng.standard_normal(n).astype(
+                    np.float32)
+                grads[ver] = g
+                send_message(w, Message(Op.PUSH, key=key, seq=ver + 1,
+                                        flags=1, cmd=CMD_F32, version=ver,
+                                        payload=g.tobytes()))
+                assert recv_message(w).op == Op.PUSH
+                if ver > 1:
+                    _ref_step(g)
+            send_message(w, Message(Op.PULL, key=key, seq=9, cmd=CMD_F32,
+                                    version=3))
+            np.testing.assert_array_equal(
+                np.frombuffer(recv_message(w).payload, dtype=np.float32),
+                ref_params)
+            # the reshard: b adopts the key, a ships store + slots
+            servers = [(a.host, a.port), (b.host, b.port)]
+            book = _book(2, [0, 1], servers)
+            b._adopt_book(dict(book, rank=1))
+            a._adopt_book(dict(book, rank=0))
+            _wait(lambda: key in b._keys
+                  and b._keys[key].store is not None,
+                  msg="migration never landed on the new owner")
+            st = b._keys[key]
+            assert st.opt_rule is not None
+            assert st.opt_rule_name == "adam"
+            assert st.opt_step == 3  # seed + 2 grad rounds published
+            # slot tensors traveled BITWISE (m and v, in slot order)
+            np.testing.assert_array_equal(st.opt_rule.m, ref.m)
+            np.testing.assert_array_equal(st.opt_rule.v, ref.v)
+            np.testing.assert_array_equal(st.store, ref_params)
+            # the old owner tombstoned AND dropped its rule state
+            assert a._keys[key].migrated_to == 1
+            assert a._keys[key].opt_rule is None
+            # the trajectory CONTINUES bitwise at the new owner —
+            # including the bias-correction schedule (t keeps counting)
+            wb = connect(b.host, b.port)
+            wb.settimeout(15)
+            for ver in (4, 5):
+                g = rng.standard_normal(n).astype(np.float32)
+                send_message(wb, Message(Op.PUSH, key=key, seq=ver + 10,
+                                         flags=1, cmd=CMD_F32, version=ver,
+                                         payload=g.tobytes()))
+                assert recv_message(wb).op == Op.PUSH
+                send_message(wb, Message(Op.PULL, key=key, seq=ver + 20,
+                                         cmd=CMD_F32, version=ver))
+                np.testing.assert_array_equal(
+                    np.frombuffer(recv_message(wb).payload,
+                                  dtype=np.float32),
+                    _ref_step(g))
+            # exactly-once across the handoff: replaying round 3 (summed
+            # at the OLD owner, ledger traveled) cannot re-fire the rule
+            step_before = b._keys[key].opt_step
+            send_message(wb, Message(Op.PUSH, key=key, seq=99, flags=1,
+                                     cmd=CMD_F32, version=3,
+                                     payload=grads[3].tobytes()))
+            assert recv_message(wb).op == Op.PUSH
+            assert b._keys[key].opt_step == step_before
+            np.testing.assert_array_equal(b._keys[key].store, ref_params)
+            close_socket(wb)
+        finally:
+            close_socket(w)
+            a.stop()
+            b.stop()
+
+
 class TestStaleMapChase:
     """Map-epoch skew: the worker-side WRONG_OWNER chase re-routes the
     RPC once the redirect's book lands (async push AND blocking init)."""

@@ -179,6 +179,83 @@ class TestRestartedSchedulerFencesEpochs:
             sched.stop()
 
 
+class TestRebornTunerReadoption:
+    def test_first_book_confirms_survivor_tuning(self, monkeypatch):
+        """A reborn scheduler's tuner re-adopts the fleet's live tuning
+        (fusion threshold + ring overrides) from the survivors' rejoin
+        reports BEFORE emitting its first books — the book confirms the
+        running decisions instead of reverting them and migrating every
+        overridden key home (docs/autotune.md)."""
+        monkeypatch.setenv("BYTEPS_AUTOTUNE", "1")
+        sched = Scheduler(num_workers=2, num_servers=0, host="127.0.0.1",
+                          rejoin_window=30.0)
+        sched.start()
+        try:
+            report = {
+                "epoch": 5, "fusion_threshold": 131072,
+                "codec_off": ["topk"],
+                "ring_overrides": {"65536": 1},
+            }
+            s1 = socket.create_connection(("127.0.0.1", sched.port),
+                                          timeout=5)
+            s1.settimeout(10)
+            send_message(s1, Message(Op.REGISTER, payload=json.dumps({
+                "role": "worker", "host": "", "port": 0, "uid": "tun-w1",
+                "num_workers": 2, "num_servers": 0,
+                "last_rank": 1, "epoch": 3, "map_epoch": 7,
+                "tuning": report,
+            }).encode()))
+            s0, resp0 = _register_raw(sched.port, {
+                "role": "worker", "host": "", "port": 0, "uid": "tun-w0",
+                "num_workers": 2, "num_servers": 0,
+                "last_rank": 0, "epoch": 3, "map_epoch": 7,
+                # stale report from a slower adopter: monotone by
+                # tuning epoch, the newest report wins
+                "tuning": {"epoch": 2, "fusion_threshold": 4096},
+            }, timeout=10)
+            book0 = json.loads(resp0.payload.decode())
+            recv_message(s1)  # drain w1's book
+            assert book0["tuning"]["epoch"] == 5
+            assert book0["tuning"]["fusion_threshold"] == 131072
+            assert book0["tuning"]["codec_off"] == ["topk"]
+            # state carries the override (the BOOK filters it to live
+            # server ranks — none registered here)
+            assert sched.tuner.state.overrides == {65536: 1}
+            s0.close()
+            s1.close()
+        finally:
+            sched.stop()
+
+    def test_live_scheduler_ignores_rejoin_tuning(self, monkeypatch):
+        """Once books are out, the scheduler's own tuner state is
+        authoritative — a late rejoiner's report (necessarily from an
+        older incarnation or a stale window) must not perturb it."""
+        monkeypatch.setenv("BYTEPS_AUTOTUNE", "1")
+        sched = Scheduler(num_workers=1, num_servers=0, host="127.0.0.1",
+                          rejoin_window=30.0)
+        sched.start()
+        try:
+            s0, _ = _register_raw(sched.port, {
+                "role": "worker", "host": "", "port": 0, "uid": "live-w0",
+                "num_workers": 1, "num_servers": 0,
+            }, timeout=10)
+            epoch0 = sched.tuner.state.epoch
+            s0.close()
+            time.sleep(0.1)
+            s1, resp = _register_raw(sched.port, {
+                "role": "worker", "host": "", "port": 0, "uid": "live-w0",
+                "num_workers": 1, "num_servers": 0,
+                "last_rank": 0, "epoch": 1, "map_epoch": 1,
+                "tuning": {"epoch": 50, "fusion_threshold": 4096},
+            }, timeout=10)
+            assert resp.status == 0
+            assert sched.tuner.state.epoch == epoch0
+            assert sched.tuner.state.fusion_threshold is None
+            s1.close()
+        finally:
+            sched.stop()
+
+
 class TestSchedulerRestartRejoin:
     def test_crash_restart_full_rejoin_traffic_bitwise(self):
         """The acceptance e2e: SIGKILL-equivalent scheduler crash +

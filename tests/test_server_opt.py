@@ -1,0 +1,590 @@
+"""Server-side optimizer plane (docs/architecture.md "Server-side
+optimizer"): workers push gradients, the server runs the update rule,
+workers pull UPDATED PARAMETERS — worker-side optimizer state drops to
+zero bytes.
+
+Layers under test:
+
+- wire level: the INIT profile extension (bit 1 + rule block) declares a
+  per-key update rule; the seed round adopts the workers' initial params
+  VERBATIM (bitwise — never an average of identical copies); gradient
+  rounds fire the rule exactly once per completed round
+- the acceptance pin: worker-side vs server-side SGD / momentum
+  trajectories are BITWISE identical across {unfused, fused} on the
+  python engine — the worker-side reference here is an INDEPENDENT numpy
+  implementation mirroring the engine's _finalize op order (divide, then
+  the optimizer), not a re-import of the server's rule classes
+- Adam: a fixed-seed trajectory pins to a frozen digest — any change to
+  the update math, the bias-correction schedule, or the seed semantics
+  breaks the literal
+- exactly-once: a REPLAYED gradient push (journal retransmit, retry
+  storm) dedupes before it can re-count toward the round barrier, so the
+  rule never fires twice for one round and params do not move
+- async profile (bit 0 | bit 1): the rule fires per push under the SSP
+  gate; each worker's first push is its parameter seed (the per-worker
+  seed ledger survives re-init barriers, so a rejoiner's pushes go
+  straight back to gradient semantics)
+- malformed / unsupported declarations: unknown rule names and the
+  native C++ engine both answer a clean status=1 INIT echo (the
+  Python-engine fallback rule) — never a silent downgrade to SUM
+- engine level: a full cluster with ``byteps_server_opt`` declare
+  kwargs pulls parameters (no worker-side divide), bitwise against the
+  same independent reference; DistributedOptimizer(server_side=True)
+  drives the same plane through optim.server_step
+"""
+
+import hashlib
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.types import DataType, RequestType, get_command_type
+from byteps_tpu.comm.rendezvous import Scheduler
+from byteps_tpu.comm.transport import (
+    Message,
+    Op,
+    close_socket,
+    connect,
+    decode_fused_reply,
+    encode_fused_push,
+    encode_server_opt_block,
+    recv_message,
+    send_message,
+)
+from byteps_tpu.core.telemetry import counters
+from byteps_tpu.server.server import PSServer
+from byteps_tpu.server.update_rules import canonical_hp, make_rule
+
+CMD_F32 = get_command_type(RequestType.DEFAULT_PUSH_PULL, int(DataType.FLOAT32))
+F32 = int(DataType.FLOAT32)
+
+KEY_A = 7 << 16
+KEY_B = 9 << 16
+N = 64
+
+
+# --- wire helpers ----------------------------------------------------------
+
+
+def _opt_init_payload(n, rule, hp=None, async_profile=False, staleness=-1):
+    profile = (1 if async_profile else 0) | 2
+    payload = struct.pack("!QI", n, F32)
+    payload += struct.pack("!Bi", profile, int(staleness))
+    payload += encode_server_opt_block(rule, canonical_hp(hp or {}))
+    return payload
+
+
+def _init_opt_key(socks_flags, key, n, rule, hp=None,
+                  async_profile=False, staleness=-1, token=77):
+    payload = _opt_init_payload(n, rule, hp, async_profile, staleness)
+    for i, (sock, flag) in enumerate(socks_flags):
+        send_message(sock, Message(Op.INIT, key=key, seq=100 + i, flags=flag,
+                                   version=token, payload=payload))
+    for sock, _ in socks_flags:
+        r = recv_message(sock)
+        assert r.op == Op.INIT and r.status == 0
+
+
+def _push(sock, key, flag, version, arr, seq):
+    send_message(sock, Message(Op.PUSH, key=key, seq=seq, flags=flag,
+                               cmd=CMD_F32, version=version,
+                               payload=arr.tobytes()))
+
+
+def _pull(sock, key, version, seq):
+    send_message(sock, Message(Op.PULL, key=key, seq=seq, cmd=CMD_F32,
+                               version=version))
+    r = recv_message(sock)
+    assert r.op == Op.PULL
+    return np.frombuffer(r.payload, dtype=np.float32)
+
+
+def _wire_server(num_workers=2):
+    srv = PSServer(Config(num_worker=num_workers, num_server=1))
+    srv.start(register=False)
+    return srv
+
+
+# --- the independent worker-side reference ---------------------------------
+# Mirrors the WORKER-side op order exactly: the engine's _finalize divides
+# the pulled sum (float32 array / python int), then the optimizer applies
+# its in-place float32 update.  Deliberately NOT built on
+# server.update_rules — this is the other half of the parity claim.
+
+
+class _WorkerSideRef:
+    def __init__(self, rule, hp, x0):
+        self.rule = rule
+        self.lr = np.float32(hp.get("lr", 0.001 if rule == "adam" else 0.01))
+        self.params = x0.copy()
+        if rule == "momentum":
+            self.mu = np.float32(hp.get("momentum", 0.9))
+            self.m = np.zeros_like(x0)
+        if rule == "adam":
+            self.b1 = np.float32(hp.get("b1", 0.9))
+            self.b2 = np.float32(hp.get("b2", 0.999))
+            self.eps = np.float32(hp.get("eps", 1e-8))
+            self.m = np.zeros_like(x0)
+            self.v = np.zeros_like(x0)
+        self.t = 0
+
+    def step(self, grad_sum, num_workers):
+        grad = grad_sum / num_workers  # the engine _finalize divide
+        self.t += 1
+        if self.rule == "sgd":
+            self.params -= self.lr * grad
+        elif self.rule == "momentum":
+            np.multiply(self.m, self.mu, out=self.m)
+            self.m += grad
+            self.params -= self.lr * self.m
+        else:  # adam
+            one = np.float32(1)
+            np.multiply(self.m, self.b1, out=self.m)
+            self.m += (one - self.b1) * grad
+            np.multiply(self.v, self.b2, out=self.v)
+            self.v += (one - self.b2) * (grad * grad)
+            m_hat = self.m / (one - self.b1 ** np.float32(self.t))
+            v_hat = self.v / (one - self.b2 ** np.float32(self.t))
+            self.params -= self.lr * (m_hat / (np.sqrt(v_hat) + self.eps))
+        return self.params
+
+
+# --- wire-level bitwise trajectories ---------------------------------------
+
+
+class TestWireBitwiseTrajectory:
+    """Worker-side vs server-side trajectories, two real workers on raw
+    sockets.  Two workers keep float addition commutative (a+b == b+a
+    bitwise), so arrival order cannot smear the parity claim."""
+
+    def _run_lane(self, rule, hp, fused, rounds=5, seed=42):
+        srv = _wire_server(num_workers=2)
+        rng = np.random.default_rng(seed)
+        x0 = {k: rng.standard_normal(N).astype(np.float32)
+              for k in (KEY_A, KEY_B)}
+        refs = {k: _WorkerSideRef(rule, hp, x0[k]) for k in (KEY_A, KEY_B)}
+        digest = hashlib.sha256()
+        w1 = connect(srv.host, srv.port)
+        w2 = connect(srv.host, srv.port)
+        w1.settimeout(15)
+        w2.settimeout(15)
+        try:
+            for k in (KEY_A, KEY_B):
+                _init_opt_key([(w1, 1), (w2, 2)], k, N, rule, hp)
+            # round 1: the parameter seed — every worker pushes the SAME
+            # initial params; the server adopts them verbatim
+            for k in (KEY_A, KEY_B):
+                _push(w1, k, 1, 1, x0[k], seq=1)
+                _push(w2, k, 2, 1, x0[k], seq=1)
+                assert recv_message(w1).op == Op.PUSH
+                assert recv_message(w2).op == Op.PUSH
+                np.testing.assert_array_equal(_pull(w1, k, 1, seq=2), x0[k])
+            # gradient rounds
+            for r in range(2, 2 + rounds):
+                grads = {
+                    (k, wid): rng.standard_normal(N).astype(np.float32)
+                    for k in (KEY_A, KEY_B) for wid in (1, 2)
+                }
+                if fused:
+                    for sock, wid in ((w1, 1), (w2, 2)):
+                        frame = encode_fused_push([
+                            (k, CMD_F32, r, grads[(k, wid)].tobytes())
+                            for k in (KEY_A, KEY_B)
+                        ])
+                        send_message(sock, Message(
+                            Op.FUSED, key=KEY_A, seq=10 * r + wid,
+                            flags=wid, cmd=2, payload=frame))
+                    got = {}
+                    for sock in (w1, w2):
+                        msg = recv_message(sock)
+                        assert msg.op == Op.FUSED
+                        for k, _ver, payload in decode_fused_reply(
+                                msg.payload):
+                            got[k] = np.frombuffer(payload,
+                                                   dtype=np.float32)
+                else:
+                    for k in (KEY_A, KEY_B):
+                        _push(w1, k, 1, r, grads[(k, 1)], seq=10 * r)
+                        _push(w2, k, 2, r, grads[(k, 2)], seq=10 * r)
+                        assert recv_message(w1).op == Op.PUSH
+                        assert recv_message(w2).op == Op.PUSH
+                    got = {k: _pull(w1, k, r, seq=10 * r + 5)
+                           for k in (KEY_A, KEY_B)}
+                for k in (KEY_A, KEY_B):
+                    gs = grads[(k, 1)].copy()
+                    gs += grads[(k, 2)]  # COPY_FIRST then SUM_RECV order
+                    want = refs[k].step(gs, 2)
+                    np.testing.assert_array_equal(got[k], want)
+                    digest.update(got[k].tobytes())
+            assert srv._keys[KEY_A].opt_step == 1 + rounds
+        finally:
+            close_socket(w1)
+            close_socket(w2)
+            srv.stop()
+        return digest.hexdigest()
+
+    @pytest.mark.parametrize("rule,hp", [
+        ("sgd", {"lr": 0.05}),
+        ("momentum", {"lr": 0.05, "momentum": 0.9}),
+    ])
+    def test_worker_vs_server_bitwise_fused_and_unfused(self, rule, hp):
+        d_unfused = self._run_lane(rule, hp, fused=False)
+        d_fused = self._run_lane(rule, hp, fused=True)
+        # fusion changes where bytes ride, never what they say
+        assert d_unfused == d_fused
+
+    def test_adam_matches_independent_reference(self):
+        self._run_lane("adam", {"lr": 0.002}, fused=False)
+
+    def test_adam_frozen_digest(self):
+        """Fixed-seed Adam trajectory pinned to a literal — the update
+        math, bias-correction schedule, and seed semantics are all
+        load-bearing for checkpoint/trajectory compatibility."""
+        d = self._run_lane("adam", {}, fused=False, rounds=6, seed=1234)
+        assert d == ADAM_FROZEN_DIGEST, d
+
+
+ADAM_FROZEN_DIGEST = (
+    "ddfcbd90910d65d3fa4ba19531e2a0a137717a02c3144d2f68b93b16862fe1b2"
+)
+
+
+# --- exactly-once under replay ---------------------------------------------
+
+
+class TestExactlyOnce:
+    def test_replayed_push_never_double_applies(self):
+        srv = _wire_server(num_workers=2)
+        rng = np.random.default_rng(7)
+        x0 = rng.standard_normal(N).astype(np.float32)
+        ref = _WorkerSideRef("momentum", {"lr": 0.1}, x0)
+        w1 = connect(srv.host, srv.port)
+        w2 = connect(srv.host, srv.port)
+        w1.settimeout(15)
+        w2.settimeout(15)
+        try:
+            _init_opt_key([(w1, 1), (w2, 2)], KEY_A, N, "momentum",
+                          {"lr": 0.1})
+            _push(w1, KEY_A, 1, 1, x0, seq=1)
+            _push(w2, KEY_A, 2, 1, x0, seq=1)
+            assert recv_message(w1).op == Op.PUSH
+            assert recv_message(w2).op == Op.PUSH
+            g1 = rng.standard_normal(N).astype(np.float32)
+            g2 = rng.standard_normal(N).astype(np.float32)
+            _push(w1, KEY_A, 1, 2, g1, seq=2)
+            _push(w2, KEY_A, 2, 2, g2, seq=2)
+            assert recv_message(w1).op == Op.PUSH
+            assert recv_message(w2).op == Op.PUSH
+            gs = g1.copy()
+            gs += g2
+            want = ref.step(gs, 2).copy()
+            np.testing.assert_array_equal(_pull(w1, KEY_A, 2, seq=3), want)
+            before = counters().snapshot().get("push_dedup", 0)
+            step_before = srv._keys[KEY_A].opt_step
+            # the journal retransmit: the SAME round-2 push again — the
+            # ledger dedupes BEFORE barrier counting, so the rule cannot
+            # fire a second time for the round
+            _push(w1, KEY_A, 1, 2, g1, seq=4)
+            assert recv_message(w1).op == Op.PUSH
+            assert counters().snapshot().get("push_dedup", 0) == before + 1
+            assert srv._keys[KEY_A].opt_step == step_before
+            np.testing.assert_array_equal(_pull(w1, KEY_A, 2, seq=5), want)
+            # ...and the trajectory continues undamaged
+            g3 = rng.standard_normal(N).astype(np.float32)
+            _push(w1, KEY_A, 1, 3, g3, seq=6)
+            _push(w2, KEY_A, 2, 3, g3, seq=6)
+            assert recv_message(w1).op == Op.PUSH
+            assert recv_message(w2).op == Op.PUSH
+            gs3 = g3.copy()
+            gs3 += g3
+            np.testing.assert_array_equal(
+                _pull(w1, KEY_A, 3, seq=7), ref.step(gs3, 2))
+        finally:
+            close_socket(w1)
+            close_socket(w2)
+            srv.stop()
+
+
+# --- async profile ---------------------------------------------------------
+
+
+class TestAsyncServerOpt:
+    def test_per_push_updates_and_seed_ledger_survives_reinit(self):
+        srv = _wire_server(num_workers=1)
+        rng = np.random.default_rng(11)
+        x0 = rng.standard_normal(N).astype(np.float32)
+        ref = _WorkerSideRef("sgd", {"lr": 0.05}, x0)
+        w = connect(srv.host, srv.port)
+        w.settimeout(15)
+        try:
+            _init_opt_key([(w, 1)], KEY_A, N, "sgd", {"lr": 0.05},
+                          async_profile=True, staleness=-1)
+            # first push = the worker's parameter seed, adopted verbatim
+            _push(w, KEY_A, 1, 1, x0, seq=1)
+            assert recv_message(w).op == Op.PUSH
+            np.testing.assert_array_equal(_pull(w, KEY_A, 1, seq=2), x0)
+            for r in range(2, 5):
+                g = rng.standard_normal(N).astype(np.float32)
+                _push(w, KEY_A, 1, r, g, seq=10 * r)
+                assert recv_message(w).op == Op.PUSH
+                np.testing.assert_array_equal(
+                    _pull(w, KEY_A, r, seq=10 * r + 1), ref.step(g, 1))
+            # a rejoiner re-runs the init barrier with the SAME config:
+            # slots, step count AND the per-worker seed ledger survive —
+            # its next push is a gradient, not a fresh seed
+            _init_opt_key([(w, 1)], KEY_A, N, "sgd", {"lr": 0.05},
+                          async_profile=True, staleness=-1, token=78)
+            g = rng.standard_normal(N).astype(np.float32)
+            _push(w, KEY_A, 1, 5, g, seq=50)
+            assert recv_message(w).op == Op.PUSH
+            np.testing.assert_array_equal(
+                _pull(w, KEY_A, 5, seq=51), ref.step(g, 1))
+        finally:
+            close_socket(w)
+            srv.stop()
+
+
+# --- declaration hygiene ----------------------------------------------------
+
+
+class TestDeclaration:
+    def test_unknown_rule_fails_at_declare_time(self):
+        # the rule registry is local: a typo'd name errors at
+        # bps.declare_tensor, before anything travels to a server
+        import byteps_tpu as bps
+
+        with pytest.raises(ValueError, match="adagrad"):
+            bps.declare_tensor("sopt.typo", byteps_server_opt="adagrad")
+        # the off-spellings and known rules still pass validation
+        bps.declare_tensor("sopt.off_ok", byteps_server_opt="off")
+        bps.declare_tensor("sopt.known_ok", byteps_server_opt="adam")
+
+    def test_unknown_rule_is_clean_status_reject(self):
+        srv = _wire_server(num_workers=1)
+        w = connect(srv.host, srv.port)
+        w.settimeout(15)
+        try:
+            before = counters().snapshot().get("server_opt_reject", 0)
+            payload = _opt_init_payload(N, "adagrad")
+            send_message(w, Message(Op.INIT, key=KEY_A, seq=1, flags=1,
+                                    version=77, payload=payload))
+            r = recv_message(w)
+            assert r.op == Op.INIT and r.status != 0
+            assert counters().snapshot().get(
+                "server_opt_reject", 0) == before + 1
+            # the stream stayed framed: a plain PING still round-trips
+            send_message(w, Message(Op.PING, seq=2))
+            assert recv_message(w).op == Op.PING
+        finally:
+            close_socket(w)
+            srv.stop()
+
+    def test_reinit_without_profile_returns_key_to_sum(self):
+        srv = _wire_server(num_workers=1)
+        w = connect(srv.host, srv.port)
+        w.settimeout(15)
+        try:
+            _init_opt_key([(w, 1)], KEY_A, N, "sgd", {"lr": 0.5})
+            assert srv._keys[KEY_A].opt_rule is not None
+            # plain 12-byte re-init: the key returns to SUM semantics
+            payload = struct.pack("!QI", N, F32)
+            send_message(w, Message(Op.INIT, key=KEY_A, seq=9, flags=1,
+                                    version=78, payload=payload))
+            assert recv_message(w).op == Op.INIT
+            ks = srv._keys[KEY_A]
+            assert ks.opt_rule is None and ks.opt_step == 0
+            g = np.full(N, 2.0, dtype=np.float32)
+            _push(w, KEY_A, 1, 1, g, seq=10)
+            assert recv_message(w).op == Op.PUSH
+            np.testing.assert_array_equal(_pull(w, KEY_A, 1, seq=11), g)
+        finally:
+            close_socket(w)
+            srv.stop()
+
+    def test_native_engine_rejects_with_counter(self):
+        from conftest import have_native_parity_server
+
+        if not have_native_parity_server():
+            pytest.skip("native lib not built")
+        from byteps_tpu.native import get_lib, native_server_counters
+
+        lib = get_lib()
+        port = lib.bps_native_server_start(0, 1, 0)
+        assert port > 0
+        try:
+            s = connect("127.0.0.1", port)
+            send_message(s, Message(Op.INIT, key=KEY_A, seq=1, flags=1,
+                                    version=7,
+                                    payload=_opt_init_payload(8, "sgd")))
+            r = recv_message(s)
+            assert r.op == Op.INIT and r.status != 0
+            # the stream stayed framed
+            send_message(s, Message(Op.PING, seq=2))
+            assert recv_message(s).op == Op.PING
+            ctrs = native_server_counters(port)
+            assert ctrs.get("native_server_opt_reject", 0) >= 1
+            close_socket(s)
+        finally:
+            lib.bps_native_server_stop(port)
+
+
+# --- engine level -----------------------------------------------------------
+
+
+def _reset_runtime():
+    from byteps_tpu.common import config as _config
+    from byteps_tpu.common import registry as _registry
+    from byteps_tpu.core import state as _state
+
+    _state.shutdown_state()
+    _registry.reset_registry()
+    _config.clear_config()
+
+
+def _cluster(monkeypatch, threshold=0):
+    monkeypatch.setenv("BYTEPS_FUSION_THRESHOLD", str(threshold))
+    monkeypatch.setenv("BYTEPS_FUSION_CYCLE_MS", "2")
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    monkeypatch.delenv("BYTEPS_SERVER_NATIVE", raising=False)
+    srv = PSServer(Config.from_env())
+    threading.Thread(target=srv.start, daemon=True).start()
+    return sched, srv
+
+
+class TestEngineLane:
+    def test_declare_kwargs_pull_params_bitwise(self, monkeypatch):
+        """Full cluster: byteps_server_opt declare kwargs — the engine
+        ships the profile at INIT, forces average=False (the pull IS the
+        parameters), and the pulled trajectory is bitwise the
+        independent worker-side reference."""
+        sched, srv = _cluster(monkeypatch)
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            rng = np.random.default_rng(5)
+            x0 = rng.standard_normal(256).astype(np.float32)
+            ref = _WorkerSideRef("momentum", {"lr": 0.01}, x0)
+            bps.declare_tensor(
+                "sopt.w", byteps_server_opt="momentum",
+                byteps_server_opt_hp={"lr": 0.01},
+            )
+            # round 1: the seed — push params, pull them back verbatim
+            got = np.asarray(bps.synchronize(
+                bps.push_pull_async(x0, name="sopt.w")))
+            np.testing.assert_array_equal(got, x0)
+            for _ in range(4):
+                g = rng.standard_normal(256).astype(np.float32)
+                got = np.asarray(bps.synchronize(
+                    bps.push_pull_async(g, name="sopt.w")))
+                np.testing.assert_array_equal(got, ref.step(g, 1))
+            snap = counters().snapshot()
+            assert snap.get("server_opt_updates", 0) >= 4
+        finally:
+            bps.shutdown()
+            _reset_runtime()
+            srv.stop()
+            sched.stop()
+
+    def test_env_knob_applies_to_all_tensors(self, monkeypatch):
+        """BYTEPS_SERVER_OPT / _HP declare the profile job-wide; a
+        per-tensor byteps_server_opt="off" opts a tensor back out."""
+        monkeypatch.setenv("BYTEPS_SERVER_OPT", "sgd")
+        monkeypatch.setenv("BYTEPS_SERVER_OPT_HP", '{"lr": 0.25}')
+        sched, srv = _cluster(monkeypatch)
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            x0 = np.ones(32, dtype=np.float32)
+            ref = _WorkerSideRef("sgd", {"lr": 0.25}, x0)
+            got = np.asarray(bps.synchronize(
+                bps.push_pull_async(x0, name="sopt.env")))
+            np.testing.assert_array_equal(got, x0)
+            g = np.full(32, 2.0, dtype=np.float32)
+            got = np.asarray(bps.synchronize(
+                bps.push_pull_async(g, name="sopt.env")))
+            np.testing.assert_array_equal(got, ref.step(g, 1))
+            # opted-out tensor keeps plain SUM semantics (1 worker:
+            # average divides by 1 — the sum comes back unchanged)
+            bps.declare_tensor("sopt.plain", byteps_server_opt="off")
+            got = np.asarray(bps.synchronize(
+                bps.push_pull_async(g, name="sopt.plain")))
+            np.testing.assert_array_equal(got, g)
+        finally:
+            bps.shutdown()
+            _reset_runtime()
+            srv.stop()
+            sched.stop()
+
+    def test_distributed_optimizer_server_side(self, monkeypatch):
+        """DistributedOptimizer(server_side=True): server_step seeds the
+        params on first call, then maps grads → updated params through
+        the server's rule — no optax chain, no worker-side slots."""
+        jax = pytest.importorskip("jax")
+        sched, srv = _cluster(monkeypatch)
+        import byteps_tpu as bps
+        from byteps_tpu.optim import DistributedOptimizer
+
+        try:
+            bps.init()
+            rng = np.random.default_rng(3)
+            params = {
+                "w": jax.numpy.asarray(
+                    rng.standard_normal(64).astype(np.float32)),
+                "b": jax.numpy.asarray(
+                    rng.standard_normal(8).astype(np.float32)),
+            }
+            refs = {
+                k: _WorkerSideRef("sgd", {"lr": 0.1}, np.asarray(v))
+                for k, v in params.items()
+            }
+            opt = DistributedOptimizer(
+                server_side=True, server_rule="sgd",
+                server_hp={"lr": 0.1})
+            assert opt._tx is None  # no worker-side optax chain at all
+            for _ in range(3):
+                grads = {
+                    k: jax.numpy.asarray(
+                        rng.standard_normal(v.shape[0]).astype(np.float32))
+                    for k, v in params.items()
+                }
+                params = opt.server_step(params, grads)
+                for k in refs:
+                    np.testing.assert_array_equal(
+                        np.asarray(params[k]),
+                        refs[k].step(np.asarray(grads[k]), 1))
+        finally:
+            bps.shutdown()
+            _reset_runtime()
+            srv.stop()
+            sched.stop()
+
+    def test_rowsparse_rejected(self, monkeypatch):
+        sched, srv = _cluster(monkeypatch)
+        import byteps_tpu as bps
+
+        try:
+            bps.init()
+            from byteps_tpu import api as _api
+
+            _api.declare_tensor("sopt.rs", byteps_server_opt="sgd")
+            with pytest.raises(ValueError, match="row-sparse"):
+                _api.push_pull_rowsparse_async(
+                    np.array([0, 1], dtype=np.int64),
+                    np.zeros((2, 8), dtype=np.float32),
+                    name="sopt.rs", total_rows=4)
+        finally:
+            bps.shutdown()
+            _reset_runtime()
+            srv.stop()
+            sched.stop()

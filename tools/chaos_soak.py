@@ -574,6 +574,196 @@ def run_multi_tenant_soak(
     }
 
 
+def run_server_opt_soak(
+    steps: int = 40,
+    seed: int = 7,
+    servers: int = 2,
+    drop: float = 0.05,
+    delay: float = 0.05,
+    dim: int = 1536,
+    reshard: bool = True,
+) -> dict:
+    """Server-side optimizer plane under seeded chaos (docs/
+    architecture.md "Server-side optimizer"): momentum- and adam-updated
+    keys train through drops/delays — and, with ``reshard``, through a
+    live scale-up + scale-down that migrates optimizer slots and step
+    counts mid-trajectory — while a local mirror of each key's rule
+    asserts the pulled PARAMETERS are bitwise every single step.
+
+    Exactly-once under replay is asserted two ways at exit: every
+    surviving key's ``opt_step`` is exactly 1 (seed) + ``steps``
+    gradient rounds, and the fleet-wide ``server_opt_updates`` total is
+    exactly ``steps * n_shards`` — a replayed push that re-fired a rule
+    anywhere would break both (and the bitwise params first)."""
+    if reshard and servers < 2:
+        raise ValueError("--server-opt reshard needs --servers >= 2")
+    os.environ.update(
+        {
+            "BYTEPS_VAN": "chaos:tcp",
+            "BYTEPS_CHAOS_SEED": str(seed),
+            "BYTEPS_CHAOS_DROP": str(drop),
+            "BYTEPS_CHAOS_DELAY": str(delay),
+            "BYTEPS_CHAOS_DELAY_MS": "10",
+            "BYTEPS_CHAOS_DISCONNECT": "0",
+            "BYTEPS_CHAOS_TRUNCATE": "0",
+            "BYTEPS_CHAOS_CORRUPT": "0",
+            "BYTEPS_CHAOS_PAYLOAD_CORRUPT": "0",
+            "BYTEPS_RPC_DEADLINE_S": "0.3",
+            "BYTEPS_INIT_DEADLINE_S": "0.5",
+            "BYTEPS_RPC_RETRIES": "8",
+            "BYTEPS_RPC_BACKOFF_S": "0.05",
+            "BYTEPS_CONNECT_RETRY_S": "0.2",
+            "BYTEPS_DEGRADED_STEP_RETRIES": "8",
+            "BYTEPS_HEARTBEAT_INTERVAL": "0.5",
+            "BYTEPS_FORCE_DISTRIBUTED": "1",
+            "BYTEPS_ELASTIC_RESHARD": "1" if reshard else "0",
+            "DMLC_NUM_WORKER": "1",
+            "DMLC_NUM_SERVER": str(servers),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+        }
+    )
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.core.telemetry import counters
+    from byteps_tpu.server.server import PSServer
+    from byteps_tpu.server.update_rules import make_rule
+
+    counters().reset()
+    sched = Scheduler(num_workers=1, num_servers=servers, host="127.0.0.1")
+    sched.start()
+    os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+    fleet = [PSServer(Config.from_env()) for _ in range(servers)]
+    for srv in fleet:
+        threading.Thread(target=srv.start, daemon=True).start()
+
+    import time as _time
+
+    import byteps_tpu as bps
+
+    # several named shards so the ring re-homes a real subset on every
+    # resize; half momentum (one slot) and half adam (two slots + the
+    # bias-correction step count) so the migration tail carries every
+    # slot shape this plane ships
+    shards = [
+        ("momentum", {"lr": 0.02}), ("momentum", {"lr": 0.02}),
+        ("momentum", {"lr": 0.02}), ("adam", {"lr": 0.01}),
+        ("adam", {"lr": 0.01}), ("adam", {"lr": 0.01}),
+    ]
+    n_shards = len(shards)
+    sdim = max(4, dim // n_shards)
+    rng = np.random.default_rng(seed)
+    ws = [rng.standard_normal(sdim).astype(np.float32) for _ in shards]
+    loss0 = float(sum(w @ w for w in ws))
+    # the local mirror: the SAME rule classes, applied to a copy — any
+    # divergence between it and the pulled params is a wire/replay bug
+    refs = [make_rule(rule, hp, sdim, np.float32) for rule, hp in shards]
+    ref_t = 0
+    up_at, down_at = max(1, steps // 3), max(2, (2 * steps) // 3)
+    extra = None
+    drained_ok = True
+    try:
+        bps.init()
+        client = None
+        if reshard:
+            from byteps_tpu.core.state import get_state
+
+            client = get_state().engine.client
+        for i, (rule, hp) in enumerate(shards):
+            bps.declare_tensor(f"sopt_soak.w{i}", byteps_server_opt=rule,
+                               byteps_server_opt_hp=hp)
+        # seed round: push the initial params, get them back VERBATIM
+        for i, w in enumerate(ws):
+            got = np.asarray(bps.push_pull(w, name=f"sopt_soak.w{i}"))
+            np.testing.assert_array_equal(got, w)
+        for step in range(steps):
+            ref_t += 1
+            for i in range(n_shards):
+                grad = 2.0 * ws[i]  # d/dw ||w||²
+                got = np.asarray(
+                    bps.push_pull(grad, name=f"sopt_soak.w{i}")
+                )
+                # mirror the server: rule.apply mutates our copy with
+                # the identical float32 op order — the pull must match
+                # bitwise, every step, through every fault and migration
+                refs[i].apply(ws[i], grad.copy(), 1, ref_t)
+                np.testing.assert_array_equal(got, ws[i])
+            if reshard and step == up_at:
+                os.environ["DMLC_NUM_SERVER"] = str(servers + 1)
+                rt = threading.Thread(
+                    target=client.request_resize,
+                    kwargs={"num_servers": servers + 1}, daemon=True,
+                )
+                rt.start()
+                deadline = _time.monotonic() + 10
+                while _time.monotonic() < deadline:
+                    with sched._lock:
+                        if sched.num_servers == servers + 1:
+                            break
+                    _time.sleep(0.05)
+                extra = PSServer(Config.from_env())
+                threading.Thread(target=extra.start, daemon=True).start()
+                rt.join(timeout=30)
+                if rt.is_alive():
+                    raise RuntimeError("scale-up resize never completed")
+            if reshard and step == down_at and extra is not None:
+                client.request_resize(num_servers=servers)
+        if reshard and extra is not None:
+            deadline = _time.monotonic() + 15
+            while (not extra._stop.is_set()
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.1)
+            drained_ok = extra._stop.is_set()
+        loss1 = float(sum(w @ w for w in ws))
+        snap = bps.get_robustness_counters()
+        updates = counters().snapshot().get("server_opt_updates", 0)
+        # exactly-once at the state level: every surviving key carries
+        # exactly seed + `steps` applied rounds, and its slots match the
+        # local mirror bitwise (migration moved them, replay never
+        # re-fired them)
+        live = []
+        for srv in fleet + ([extra] if extra is not None else []):
+            for key, ks in srv._keys.items():
+                if ks.opt_rule is not None and ks.migrated_to is None:
+                    live.append((key, ks))
+        assert len(live) == n_shards, (
+            f"{len(live)} live server-opt keys, expected {n_shards}"
+        )
+        for key, ks in live:
+            assert ks.opt_step == steps + 1, (
+                f"key {key:#x}: opt_step {ks.opt_step} != {steps + 1} "
+                "(a replayed push re-fired the rule, or a round was lost)"
+            )
+    finally:
+        bps.shutdown()
+        for srv in fleet:
+            srv.stop()
+        if extra is not None:
+            extra.stop()
+        sched.stop()
+
+    assert loss1 < loss0, f"loss did not decrease: {loss0} -> {loss1}"
+    assert updates == steps * n_shards, (
+        f"server_opt_updates {updates} != {steps * n_shards} "
+        "(exactly-once violated: a rule fired twice or never)"
+    )
+    if drop or delay:
+        injected = sum(v for k, v in snap.items() if k.startswith("chaos_"))
+        assert injected > 0, f"no faults injected: {snap}"
+    if reshard:
+        assert snap.get("migration_keys_moved", 0) > 0, (
+            f"reshard schedule moved no keys: {snap}"
+        )
+        assert drained_ok, "drained server never stopped itself"
+    return {
+        "steps": steps,
+        "loss0": loss0,
+        "loss1": loss1,
+        "counters": snap,
+        "server_opt_updates": updates,
+    }
+
+
 def run_corrupt_ab(args) -> int:
     """The two-leg corruption proof (docs/robustness.md "Wire
     integrity"), each leg a fresh subprocess (the soak mutates
@@ -678,6 +868,19 @@ def main() -> int:
                          "while the tuner sweeps (and possibly rebalances "
                          "hot keys) under the same seeded faults — "
                          "composes with --reshard")
+    ap.add_argument("--server-opt", action="store_true",
+                    help="server-side optimizer soak (docs/architecture.md "
+                         "\"Server-side optimizer\"): momentum + adam keys "
+                         "updated ON the servers through seeded drops/"
+                         "delays — and through a live reshard when "
+                         "--reshard (default on for this mode) — while a "
+                         "local rule mirror asserts the pulled params are "
+                         "bitwise every step and the exit asserts exactly-"
+                         "once rule firing (opt_step, server_opt_updates); "
+                         "Python engine only")
+    ap.add_argument("--no-reshard", action="store_true",
+                    help="with --server-opt: skip the mid-run scale-up/"
+                         "scale-down (slots then never migrate)")
     ap.add_argument("--multi-tenant", action="store_true",
                     help="two concurrent jobs (sync + async, "
                          "job-namespaced keys) through chaos faults on "
@@ -696,8 +899,22 @@ def main() -> int:
     result: dict = {}
     err: list = []
 
+    if args.server_opt and args.engine == "native":
+        ap.error("--server-opt needs the Python engine (the native server "
+                 "rejects the optimizer profile, see docs/robustness.md)")
+
     def body() -> None:
         try:
+            if args.server_opt:
+                result.update(
+                    run_server_opt_soak(
+                        steps=args.steps, seed=args.seed,
+                        servers=args.servers, drop=args.drop,
+                        delay=args.delay,
+                        reshard=not args.no_reshard,
+                    )
+                )
+                return
             if args.multi_tenant:
                 result.update(
                     run_multi_tenant_soak(

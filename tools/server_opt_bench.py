@@ -1,0 +1,242 @@
+"""Server-side optimizer A/B: where does the optimizer state live?
+
+The server-side optimizer plane (docs/architecture.md "Server-side
+optimizer") moves the update rule to each key's owning server — workers
+push gradients and pull updated parameters, so the per-worker Adam
+moments (2x the model size, replicated on EVERY worker) become one
+per-key copy on the PS fleet.  This bench measures exactly that trade
+on a loopback fleet:
+
+- **worker**: plain summation keys; the worker pulls averaged gradients
+  and runs a local numpy Adam over its own slot arrays — the
+  worker-resident optimizer-state bytes are the sum of those arrays.
+- **server**: the same tensors declared ``byteps_server_opt="adam"``;
+  the worker holds ZERO optimizer state and the pull returns the
+  already-updated parameters.
+
+Same tensor population, same step count, same wire; the phases differ
+only in who runs the rule.  Output: ``SERVEROPT_BENCH_r01.json`` with
+per-phase step times, worker optimizer-state bytes, and wire bytes —
+the headline is worker state dropping to 0 with step time within noise
+(the update itself is O(n) numpy either side of the wire).
+
+    python tools/server_opt_bench.py --out SERVEROPT_BENCH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Adam hyperparameters — shared by both phases so the math is identical
+HP = {"lr": 0.001}
+
+_WORKER_BODY = r"""
+import json, os, sys, time
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import byteps_tpu as bps
+from byteps_tpu.core.telemetry import counters
+
+mode = os.environ["SOPT_BENCH_MODE"]          # "worker" | "server"
+steps = int(os.environ["SOPT_BENCH_STEPS"])
+dim = int(os.environ["SOPT_BENCH_DIM"])
+nt = int(os.environ["SOPT_BENCH_TENSORS"])
+hp = json.loads(os.environ["SOPT_BENCH_HP"])
+
+bps.init()
+rng = np.random.default_rng(7)
+params = [rng.standard_normal(dim).astype(np.float32) for _ in range(nt)]
+names = ["sopt.t%d" % i for i in range(nt)]
+
+opt_bytes = 0
+if mode == "server":
+    for nm in names:
+        bps.declare_tensor(nm, byteps_server_opt="adam",
+                           byteps_server_opt_hp=hp)
+    # seed round: every worker pushes its initial params, the servers
+    # adopt them verbatim — also covers init barriers + allocation
+    hs = [bps.push_pull_async(p, name=nm) for p, nm in zip(params, names)]
+    params = [np.asarray(bps.synchronize(h)) for h in hs]
+else:
+    # worker-resident Adam: one m and one v slot per tensor — the bytes
+    # this bench exists to count
+    m = [np.zeros(dim, np.float32) for _ in range(nt)]
+    v = [np.zeros(dim, np.float32) for _ in range(nt)]
+    opt_bytes = sum(a.nbytes for a in m) + sum(a.nbytes for a in v)
+    # warm-up round covers the same init barriers + allocation
+    hs = [bps.push_pull_async(p, name=nm) for p, nm in zip(params, names)]
+    for h in hs:
+        bps.synchronize(h)
+
+base = counters().snapshot()
+one, b1, b2 = np.float32(1), np.float32(0.9), np.float32(0.999)
+eps, lr = np.float32(1e-8), np.float32(hp["lr"])
+times, t_step = [], 0
+for s in range(steps):
+    grads = [rng.standard_normal(dim).astype(np.float32) for _ in range(nt)]
+    t0 = time.monotonic()
+    hs = [bps.push_pull_async(g, name=nm) for g, nm in zip(grads, names)]
+    outs = [np.asarray(bps.synchronize(h)) for h in hs]
+    if mode == "worker":
+        # outs are the averaged gradients: run Adam here, on local slots
+        t_step += 1
+        t = np.float32(t_step)
+        for i, g in enumerate(outs):
+            m[i] *= b1; m[i] += (one - b1) * g
+            v[i] *= b2; v[i] += (one - b2) * (g * g)
+            m_hat = m[i] / (one - b1 ** t)
+            v_hat = v[i] / (one - b2 ** t)
+            params[i] -= lr * (m_hat / (np.sqrt(v_hat) + eps))
+    else:
+        # outs ARE the updated parameters — nothing left to compute
+        params = outs
+    times.append(time.monotonic() - t0)
+snap = counters().snapshot()
+print("SOPT_RESULT " + json.dumps({
+    "mode": mode, "times": times, "opt_state_bytes": opt_bytes,
+    "push_bytes": snap.get("wire_tx_bytes", 0) - base.get("wire_tx_bytes", 0),
+    "pull_bytes": snap.get("wire_rx_bytes", 0) - base.get("wire_rx_bytes", 0),
+}))
+sys.stdout.flush()
+bps.shutdown()
+"""
+
+
+def _percentile(vals, q):
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    i = min(len(vals) - 1, int(q * (len(vals) - 1)))
+    return vals[i]
+
+
+def run_phase(mode: str, steps: int, dim: int, tensors: int,
+              servers: int = 2) -> dict:
+    """One fresh fleet (scheduler + Python-engine servers) + one
+    subprocess worker running the phase body; returns its stats."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "BYTEPS_VAN": "tcp",
+        "BYTEPS_HEARTBEAT_INTERVAL": "1",
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": str(servers),
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "SOPT_BENCH_MODE": mode,
+        "SOPT_BENCH_STEPS": str(steps),
+        "SOPT_BENCH_DIM": str(dim),
+        "SOPT_BENCH_TENSORS": str(tensors),
+        "SOPT_BENCH_HP": json.dumps(HP),
+    }
+    env.pop("BYTEPS_SERVER_OPT", None)  # per-tensor kwargs only
+    os.environ.update({k: env[k] for k in (
+        "BYTEPS_VAN", "DMLC_NUM_WORKER", "DMLC_NUM_SERVER",
+        "DMLC_PS_ROOT_URI",
+    )})
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.comm.rendezvous import Scheduler
+    from byteps_tpu.server.server import PSServer
+
+    sched = Scheduler(num_workers=1, num_servers=servers, host="127.0.0.1")
+    sched.start()
+    os.environ["DMLC_PS_ROOT_PORT"] = str(sched.port)
+    env["DMLC_PS_ROOT_PORT"] = str(sched.port)
+    fleet = [PSServer(Config.from_env()) for _ in range(servers)]
+    for srv in fleet:
+        threading.Thread(target=srv.start, daemon=True).start()
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _WORKER_BODY], env=env,
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+        )
+        result = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("SOPT_RESULT "):
+                result = json.loads(line[len("SOPT_RESULT "):])
+        if proc.returncode != 0 or result is None:
+            raise RuntimeError(
+                f"phase {mode} worker failed (rc={proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}"
+            )
+    finally:
+        for srv in fleet:
+            srv.stop()
+        sched.stop()
+
+    times = result["times"]
+    stats = {
+        "steps": len(times),
+        "worker_opt_state_bytes": result["opt_state_bytes"],
+        "p50_ms": round(_percentile(times, 0.50) * 1e3, 2),
+        "p99_ms": round(_percentile(times, 0.99) * 1e3, 2),
+        "mean_ms": round(statistics.fmean(times) * 1e3, 2),
+        "push_bytes_per_step": result["push_bytes"] // max(1, len(times)),
+        "pull_bytes_per_step": result["pull_bytes"] // max(1, len(times)),
+    }
+    print(f"  phase {mode:6s}: opt_state={stats['worker_opt_state_bytes']}B "
+          f"mean={stats['mean_ms']}ms p99={stats['p99_ms']}ms "
+          f"pull/step={stats['pull_bytes_per_step']}B")
+    return stats
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--dim", type=int, default=1 << 16,
+                    help="floats per tensor")
+    ap.add_argument("--tensors", type=int, default=8)
+    ap.add_argument("--servers", type=int, default=2)
+    ap.add_argument("--out", default="SERVEROPT_BENCH_r01.json")
+    args = ap.parse_args()
+
+    model_bytes = args.dim * 4 * args.tensors
+    print(f"server_opt_bench: {args.tensors} x {args.dim} f32 "
+          f"({model_bytes // 1024} KiB model), {args.servers} servers, "
+          f"adam {HP}")
+    worker = run_phase("worker", args.steps, args.dim, args.tensors,
+                       args.servers)
+    server = run_phase("server", args.steps, args.dim, args.tensors,
+                       args.servers)
+
+    result = {
+        "config": {
+            "tensors": args.tensors, "dim": args.dim,
+            "model_bytes": model_bytes, "servers": args.servers,
+            "steps": args.steps, "rule": "adam", "hp": HP,
+        },
+        "phases": {"worker": worker, "server": server},
+        "headline": {
+            # the ZeRO-for-PS claim: per-worker optimizer state → 0
+            "worker_opt_state_bytes": worker["worker_opt_state_bytes"],
+            "server_opt_state_bytes": server["worker_opt_state_bytes"],
+            "step_time_ratio_server_over_worker": round(
+                server["mean_ms"] / max(0.01, worker["mean_ms"]), 3
+            ),
+            "pull_bytes_ratio_server_over_worker": round(
+                server["pull_bytes_per_step"]
+                / max(1, worker["pull_bytes_per_step"]), 3
+            ),
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result["headline"], indent=2))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

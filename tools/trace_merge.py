@@ -31,7 +31,14 @@ and attributes where the time of one training step went — engine-queue
 wait vs wire vs sum vs publish vs reply, split per engine (``python`` /
 ``native``; native server children are tagged ``engine: "native"`` by
 the drain) — the baseline artifact the multi-core key-striping work is
-judged against (TRACE_ATTRIB_r06.json).
+judged against (TRACE_ATTRIB_r06.json).  Reducer-lane spans (the drain
+puts each stripe on its own ``stripe<N>`` Perfetto track) additionally
+get a per-stripe **occupancy** split — stripe identity comes from the
+span's ``stripe`` arg or, failing that, its ``stripe<N>`` tid — and the
+occupancy is fed straight into the SAME ``hot_stripe`` trigger rule the
+on-node flight recorder runs (core/flightrec.py), so a skewed key hash
+found in an offline trace and one caught live by the flight recorder
+are judged by one rule, not two drifting reimplementations.
 
 Demo recipe (2 workers / 1 server, fused + chaos): docs/observability.md.
 """
@@ -41,8 +48,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 def find_trace_files(paths: List[str]) -> List[str]:
@@ -163,6 +171,58 @@ def merge(files: List[str]) -> dict:
 _RPC_STAGES = {"PUSH", "PULL", "FUSE", "RESYNC", "INIT"}
 _SERVER_STAGES = ("recv", "sum", "publish", "reply", "resync")
 
+#: reducer-lane track names the span drain emits (server.py
+#: ``_drain_spans_once``): one Perfetto thread per stripe
+_STRIPE_TID = re.compile(r"^stripe(\d+)$")
+
+
+def _span_stripe(args: dict, tid) -> Optional[int]:
+    """Which reducer stripe executed a server child span: the explicit
+    ``stripe`` arg when the drain stamped one, else derived from the
+    ``stripe<N>`` track (tid) the drain files every reducer-lane span
+    under.  None = a serve/control-thread span (``key<K>`` tracks)."""
+    s = (args or {}).get("stripe")
+    if s is not None:
+        try:
+            return int(s)
+        except (TypeError, ValueError):
+            return None
+    m = _STRIPE_TID.match(str(tid or ""))
+    return int(m.group(1)) if m else None
+
+
+def _eval_hot_stripe(busy_us: Dict[str, float],
+                     busy_n: Dict[str, int]) -> Optional[dict]:
+    """Feed the per-stripe occupancy into the hot-stripe trigger rule
+    the on-node flight recorder runs, verbatim: build the same record
+    shape (``{"stripes": {stripe: {"n", "s"}}}``) and call
+    ``flightrec._rule_hot_stripe`` with the same
+    ``BYTEPS_FLIGHT_SLOW_FACTOR`` threshold.  Returns the rule's
+    evidence dict (a confirmed hot stripe) or None — also None when the
+    byteps package isn't importable (this tool stays runnable on a box
+    that only has the trace files)."""
+    try:
+        from byteps_tpu.core.flightrec import _rule_hot_stripe
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        try:
+            from byteps_tpu.core.flightrec import _rule_hot_stripe
+        except ImportError:
+            return None
+    try:
+        factor = float(os.environ.get("BYTEPS_FLIGHT_SLOW_FACTOR") or 3.0)
+    except ValueError:
+        factor = 3.0
+    shim = type("_Rec", (), {"slow_factor": factor})()
+    record = {
+        "stripes": {
+            s: {"n": busy_n.get(s, 0), "s": us / 1e6}
+            for s, us in busy_us.items()
+        }
+    }
+    return _rule_hot_stripe(shim, record)
+
 
 def critical_path(merged: dict) -> dict:
     #: parent span id → {"extent": [min_ts, max_end] of worker RPC-stage
@@ -185,8 +245,10 @@ def critical_path(merged: dict) -> dict:
                 "dur": float(ev.get("dur", 0.0)),
                 "engine": args.get("engine", "python"),
                 # reducer lane (native key-striped engine): which stripe
-                # thread executed this stage, -1/absent = control thread
-                "stripe": args.get("stripe"),
+                # thread executed this stage — explicit arg or the
+                # stripe<N> track the drain filed it under; None = a
+                # serve/control thread
+                "stripe": _span_stripe(args, ev.get("tid")),
             })
             continue
         if span:
@@ -210,20 +272,34 @@ def critical_path(merged: dict) -> dict:
             "wire_us": 0.0,
             "wire_rpcs": 0,
             "stripe_sum_us": {},
+            "stripe_busy_us": {},
+            "stripe_busy_n": {},
         })
         agg["rpcs"] += 1
         srv0, srv1 = None, None
         for k in kids:
             if k["name"] in agg["stages_us"]:
                 agg["stages_us"][k["name"]] += k["dur"]
-                # per-reducer occupancy (native striped engine): sum time
-                # split by the stripe lane that executed it, so a bad key
-                # hash shows up as one runaway reducer in the attribution
+                # per-reducer sum time (native striped engine): split by
+                # the stripe lane that executed it, so a bad key hash
+                # shows up as one runaway reducer in the attribution
                 if k["name"] == "sum" and k.get("stripe") is not None:
                     per = agg["stripe_sum_us"]
                     per[str(k["stripe"])] = (
                         per.get(str(k["stripe"]), 0.0) + k["dur"]
                     )
+            # lane OCCUPANCY: every stage a stripe thread executed, not
+            # just sum — a reducer drowning in publish fan-out is just as
+            # hot as one drowning in summation, and this is the feed the
+            # hot-stripe trigger rule judges
+            if k.get("stripe") is not None:
+                lane = str(k["stripe"])
+                agg["stripe_busy_us"][lane] = (
+                    agg["stripe_busy_us"].get(lane, 0.0) + k["dur"]
+                )
+                agg["stripe_busy_n"][lane] = (
+                    agg["stripe_busy_n"].get(lane, 0) + 1
+                )
             t0, t1 = k["ts"], k["ts"] + k["dur"]
             srv0 = t0 if srv0 is None else min(srv0, t0)
             srv1 = t1 if srv1 is None else max(srv1, t1)
@@ -256,17 +332,29 @@ def critical_path(merged: dict) -> dict:
             "share": agg["wire_us"] / total if total else 0.0,
         }
         out[engine] = {"rpcs": agg["rpcs"], "stages": stages}
-        if agg["stripe_sum_us"]:
+        lanes = sorted(
+            set(agg["stripe_sum_us"]) | set(agg["stripe_busy_us"]),
+            key=int,
+        )
+        if lanes:
             sum_total = sum(agg["stripe_sum_us"].values())
-            out[engine]["reducers"] = {
-                stripe: {
-                    "sum_total_s": us / 1e6,
-                    "share_of_sum": us / sum_total if sum_total else 0.0,
+            busy_total = sum(agg["stripe_busy_us"].values())
+            out[engine]["reducers"] = {}
+            for stripe in lanes:
+                sum_us = agg["stripe_sum_us"].get(stripe, 0.0)
+                busy = agg["stripe_busy_us"].get(stripe, 0.0)
+                out[engine]["reducers"][stripe] = {
+                    "sum_total_s": sum_us / 1e6,
+                    "share_of_sum": sum_us / sum_total if sum_total else 0.0,
+                    "busy_total_s": busy / 1e6,
+                    # this lane's share of all reducer busy time — the
+                    # tid-occupancy view a hot stripe dominates
+                    "occupancy": busy / busy_total if busy_total else 0.0,
                 }
-                for stripe, us in sorted(
-                    agg["stripe_sum_us"].items(), key=lambda kv: int(kv[0])
-                )
-            }
+            hot = _eval_hot_stripe(agg["stripe_busy_us"],
+                                   agg["stripe_busy_n"])
+            if hot is not None:
+                out[engine]["hot_stripe"] = hot
     return {
         "traces": len(traces),
         "linked_rpcs": sum(e["rpcs"] for e in out.values()),
@@ -292,7 +380,18 @@ def _print_attribution(attrib: dict) -> None:
         for stripe, d in agg.get("reducers", {}).items():
             print(
                 f"    reducer {stripe:<3s} {d['sum_total_s'] * 1e3:9.3f} ms "
-                f"sum   {d['share_of_sum'] * 100:5.1f}% of sum"
+                f"sum   {d['share_of_sum'] * 100:5.1f}% of sum  "
+                f"{d['occupancy'] * 100:5.1f}% occupancy"
+            )
+        hot = agg.get("hot_stripe")
+        if hot:
+            print(
+                f"    HOT STRIPE: reducer {hot['stripe']} holds "
+                f"{hot['share'] * 100:.0f}% of lane time "
+                f"({hot['sum_seconds'] * 1e3:.3f} ms vs sibling median "
+                f"{hot['sibling_median'] * 1e3:.3f} ms) — the flight "
+                "recorder's hot_stripe rule fires on this trace; see "
+                "docs/perf.md (BYTEPS_SERVER_STRIPES / key hash)"
             )
 
 
