@@ -11,6 +11,12 @@ wrappers:
 - restore_and_broadcast — restore on the root worker then broadcast to all
   workers over the PS plane, the ``broadcast_parameters`` pattern
 - broadcast_optimizer_state — pickles non-array state via broadcast_object
+- write_shard / read_shard — byte-shard files in the wire lossless
+  container (docs/gradient-compression.md "Lossless frame compression")
+  with a CRC32C trailer: the same versioned codec that frames
+  MIGRATE_STATE/RESYNC_STATE bodies shrinks on-disk state blobs, and a
+  truncated or bit-flipped shard fails CLOSED on read (LosslessError /
+  ValueError), never silently restores wrong bytes
 """
 
 from __future__ import annotations
@@ -39,6 +45,50 @@ def restore(path: str, template: Optional[Any] = None) -> Any:
     if template is not None:
         return _checkpointer().restore(os.path.abspath(path), item=template)
     return _checkpointer().restore(os.path.abspath(path))
+
+
+def write_shard(path: str, data: bytes) -> int:
+    """Write one byte shard through the wire lossless container plus a
+    CRC32C trailer (4 bytes, big-endian, over the container).  Returns
+    the bytes written — callers can log the on-disk ratio.  Atomic via
+    rename so a crash mid-write never leaves a torn shard behind."""
+    from byteps_tpu.comm.transport import crc32c
+    from byteps_tpu.compression.lossless import compress_frame
+
+    import struct
+
+    blob = compress_frame(bytes(data))
+    blob += struct.pack("!I", crc32c(blob))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(blob)
+
+
+def read_shard(path: str) -> bytes:
+    """Read a :func:`write_shard` file, fail-closed: a short file, a
+    CRC mismatch, or a corrupt container raises (ValueError subclass)
+    instead of returning damaged state."""
+    from byteps_tpu.comm.transport import crc32c
+    from byteps_tpu.compression.lossless import (
+        LosslessError,
+        decompress_frame,
+    )
+
+    import struct
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    if len(blob) < 4:
+        raise LosslessError("shard file shorter than its CRC trailer")
+    body, trailer = blob[:-4], blob[-4:]
+    (want,) = struct.unpack("!I", trailer)
+    if crc32c(body) != want:
+        raise LosslessError("shard CRC32C mismatch")
+    return decompress_frame(body)
 
 
 def restore_and_broadcast(

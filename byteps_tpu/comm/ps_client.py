@@ -362,20 +362,23 @@ class _NativeServerConn:
     def _dispatch(self, op, seq, length, zc, off, key, cmd, version,
                   status, flags, arena, direct: Optional[bytes] = None) -> None:
         if op == -3:
-            # checksum-mismatch notification from the native recv lanes
+            # corrupt-frame notification from the native recv lanes
             # (docs/robustness.md "Wire integrity"): the corrupt reply
             # was dropped IN C++ before the demux and the pending entry
             # stays registered (deadline/retry re-fetches) — this record
             # only carries the count to the telemetry plane.  The
-            # corrupt frame's op rides in ``cmd``.
+            # corrupt frame's op rides in ``cmd``; ``status`` says which
+            # validator rejected it (0 = CRC32C, 1 = lossless decode).
             try:
                 opname = Op(cmd).name if cmd else "?"
             except ValueError:
                 opname = str(cmd)
-            counters().bump("wire_checksum_fail", labels={
-                "side": "client", "op": opname,
-                "server": self.server_label,
-            })
+            counters().bump(
+                "wire_lossless_fail" if status == 1 else "wire_checksum_fail",
+                labels={
+                    "side": "client", "op": opname,
+                    "server": self.server_label,
+                })
             self._ck_fails += 1
             if self._ck_limit and self._ck_fails == self._ck_limit:
                 # the C++ lane breaks at exactly this count: record the
@@ -2173,23 +2176,29 @@ class PSClient:
 
     def _recv_loop(self, sc: _ServerConn, sock) -> None:
         from byteps_tpu.comm.transport import (
+            LosslessError,
             checksum_conn_limit,
             frame_checksum,
             recv_header_ex,
             recv_into,
         )
+        from byteps_tpu.compression.lossless import decompress_frame
 
         ck_limit = checksum_conn_limit()
         try:
             while not self._stop.is_set():
                 try:
                     (op, status, flags, seq, key, cmd, version, length,
-                     trace, crc) = recv_header_ex(sock)
+                     trace, crc, lossless) = recv_header_ex(sock)
                     # the callback is popped only AFTER the payload is
                     # fully received: dying mid-payload must leave it for
                     # mark_dead's cb(None) drain, never lose it
                     sink = sc.peek_sink(seq)
-                    zero_copied = sink is not None and length == len(sink)
+                    # a lossless frame's `length` is the container size,
+                    # never the caller's raw-sized sink — decode lands in
+                    # an owned payload (no zero-copy for compressed frames)
+                    zero_copied = (not lossless and sink is not None
+                                   and length == len(sink))
                     if zero_copied:
                         # zero-copy: the aggregated payload lands directly
                         # in the caller's result buffer — no intermediate
@@ -2221,6 +2230,25 @@ class PSClient:
                             counters().bump("wire_checksum_conn_drop")
                             return
                         continue
+                    if lossless:
+                        # decompress AFTER integrity passes; a corrupt
+                        # container is dropped exactly like a CRC
+                        # mismatch — the callback stays registered, the
+                        # deadline/retry machinery re-fetches, and
+                        # repeated failures poison the connection
+                        try:
+                            payload = decompress_frame(payload, op=op)
+                        except LosslessError:
+                            fails = sc.note_checksum_fail()
+                            counters().bump("wire_lossless_fail", labels={
+                                "side": "client",
+                                "op": getattr(op, "name", str(op)),
+                                "server": getattr(sc, "server_label", "?"),
+                            })
+                            if ck_limit and fails >= ck_limit:
+                                counters().bump("wire_checksum_conn_drop")
+                                return
+                            continue
                     if zero_copied:
                         self.zero_copy_pulls += 1
                 except (ConnectionError, OSError):
@@ -2476,6 +2504,7 @@ class PSClient:
         on_error: Optional[Callable[[], None]] = None,
         abort_check: Optional[Callable[[], bool]] = None,
         trace: Optional[tuple] = None,
+        lossless: Optional[bool] = None,
     ) -> None:
         """Async push; ``cb`` fires on server ack (ZPush,
         core_loops.cc:538-582); ``on_error`` fires once retries are
@@ -2488,13 +2517,20 @@ class PSClient:
         summation stays exactly-once under retry.  ``trace`` is the
         (trace_id, span_id) context propagated on the wire — built ONCE
         into the closure, so every retry attempt re-sends the SAME span
-        (the server's dedupe annotation then lands on the right one)."""
+        (the server's dedupe annotation then lands on the right one).
+
+        ``lossless=True`` asks the transport for the lossless frame
+        transform on this push (the tuner's per-key lossless arm for
+        keys whose lossy codec lost) — the frame ships compressed only
+        when the container actually wins; Python wire only (the native
+        client's send path doesn't stamp the flag)."""
         cmd = get_command_type(request_type, dtype_id)
         flags = self._worker_flag()
         self._async_rpc(
             lambda seq: Message(
                 Op.PUSH, key=key, seq=seq, payload=payload, cmd=cmd,
                 version=version, flags=flags, trace=trace,
+                lossless=lossless,
             ),
             key,
             deliver=lambda msg: cb(),

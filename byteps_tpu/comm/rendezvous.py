@@ -351,6 +351,16 @@ class Scheduler:
             codec = ld.get("codec")
             if v > 0 and codec and ld.get("role", "worker") == "worker":
                 votes.setdefault(codec, set()).add(ld.get("rank", "?"))
+        # third consensus arm: entropy-probe verdicts (one per worker
+        # per codec) — same rank-dedup shape as the codec_off votes
+        lz_votes: Dict[str, set] = {}
+        for lkey, v in (
+            labeled.get("compression_auto_lossless") or {}
+        ).items():
+            ld = dict(lkey)
+            codec = ld.get("codec")
+            if v > 0 and codec and ld.get("role", "worker") == "worker":
+                lz_votes.setdefault(codec, set()).add(ld.get("rank", "?"))
         # the fleet fusion threshold the workers actually run (gauge
         # per {role, rank}; max is the fleet value — launch configs
         # agree in practice, and the tuner's own state wins once set).
@@ -380,6 +390,9 @@ class Scheduler:
                 "dwell": dwell,
             },
             "codec_votes": {c: len(rs) for c, rs in votes.items()},
+            "codec_lossless_votes": {
+                c: len(rs) for c, rs in lz_votes.items()
+            },
         }
 
     def _tuner_sweep_once(self) -> None:

@@ -70,6 +70,15 @@ _CHECKSUM_FMT = "!I"
 CHECKSUM_SIZE = struct.calcsize(_CHECKSUM_FMT)
 assert CHECKSUM_SIZE == 4
 
+#: status-byte bit: the payload is a lossless container
+#: (compression/lossless.py frame format) — the header ``length`` and
+#: the CRC32C cover the COMPRESSED bytes, so integrity is verified
+#: before the decompressor runs.  Versioning by construction: no
+#: pre-lossless decoder ever sets or strips this bit, so an old
+#: receiver sees a nonzero status and refuses the frame cleanly
+#: instead of mis-parsing the body (wire.h kLosslessFlag).
+LOSSLESS_FLAG = 0x20
+
 
 class ChecksumError(ValueError):
     """A frame's CRC32C did not match its bytes — payload corruption the
@@ -88,6 +97,12 @@ class ChecksumError(ValueError):
         self.op = op
         self.expected = expected
         self.got = got
+
+
+# the lossless twin of ChecksumError, re-exported so receivers catch the
+# two corrupt-frame classes side by side (server _serve_conn_loop,
+# client _recv_loop, tools/wire_fuzz.py)
+from byteps_tpu.compression.lossless import LosslessError  # noqa: E402
 
 
 class Op(enum.IntEnum):
@@ -156,6 +171,23 @@ def wire_checksum_enabled() -> bool:
     Verification is NOT gated on this: any received frame carrying
     ``CHECKSUM_FLAG`` is checked."""
     return os.environ.get("BYTEPS_WIRE_CHECKSUM", "").lower() not in _TRUTHY_OFF
+
+
+#: ops whose payloads auto-compress with the lossless frame codec when
+#: BYTEPS_WIRE_LOSSLESS=1 — the bit-exactness-critical control plane
+#: only (RESYNC_STATE snapshots, MIGRATE_STATE store+ledger+opt-slot
+#: shipments): exactly the megabyte-class frames lossy codecs can't
+#: touch.  Gradient-plane frames keep their own per-key codecs.
+#: Mirrored by wire.h lossless_op — change both together.
+_LOSSLESS_OPS = frozenset({24, 25})
+
+
+def wire_lossless_enabled() -> bool:
+    """Compress outgoing control-plane frames with the lossless codec
+    (``BYTEPS_WIRE_LOSSLESS``, default off)?  Same per-call env read as
+    :func:`wire_checksum_enabled`.  Decode is NOT gated on this: any
+    received frame carrying ``LOSSLESS_FLAG`` is decompressed."""
+    return os.environ.get("BYTEPS_WIRE_LOSSLESS", "").lower() not in _TRUTHY_OFF
 
 
 def checksum_conn_limit() -> int:
@@ -243,7 +275,7 @@ def frame_checksum(trace: Optional[Tuple[int, int]], payload) -> int:
 class Message:
     __slots__ = (
         "op", "status", "flags", "seq", "key", "cmd", "version", "payload",
-        "trace", "checksum",
+        "trace", "checksum", "lossless", "_lossless_applied",
     )
 
     def __init__(
@@ -258,6 +290,7 @@ class Message:
         flags: int = 0,
         trace: Optional[Tuple[int, int]] = None,
         checksum: Optional[bool] = None,
+        lossless: Optional[bool] = None,
     ) -> None:
         self.op = op
         self.status = status
@@ -274,6 +307,16 @@ class Message:
         #: BYTEPS_WIRE_CHECKSUM for data-plane ops; True/False force it
         #: (golden fixtures / fuzzing)
         self.checksum = checksum
+        #: compress the payload with the lossless frame codec?  None
+        #: (default) = follow BYTEPS_WIRE_LOSSLESS for _LOSSLESS_OPS;
+        #: True = attempt on any op (the tuner's per-key lossless arm);
+        #: False = never.  The frame carries LOSSLESS_FLAG only when the
+        #: container actually came out smaller.
+        self.lossless = lossless
+        #: tri-state transform latch: None = not finalized, True/False =
+        #: payload was / wasn't swapped for its compressed container —
+        #: the transform runs exactly once even across send retries
+        self._lossless_applied = None
 
     def _stamp_checksum(self) -> bool:
         ck = self.checksum
@@ -281,7 +324,34 @@ class Message:
             return int(self.op) in _CHECKSUM_OPS and wire_checksum_enabled()
         return bool(ck)
 
+    def _stamp_lossless(self) -> bool:
+        """Finalize the lossless transform (idempotent): when the policy
+        says compress AND the container wins, swap ``payload`` for the
+        container and return True.  Must run before the header is packed
+        — ``length`` and the CRC32C cover the bytes that actually ship,
+        so integrity is verified before any receiver decompresses."""
+        done = self._lossless_applied
+        if done is not None:
+            return done
+        lz = self.lossless
+        if lz is None:
+            lz = int(self.op) in _LOSSLESS_OPS and wire_lossless_enabled()
+        applied = False
+        if lz:
+            from byteps_tpu.compression.lossless import (
+                MIN_BYTES, compress_frame,
+            )
+
+            if len(self.payload) >= MIN_BYTES:
+                comp = compress_frame(self.payload)
+                if len(comp) < len(self.payload):
+                    self.payload = comp
+                    applied = True
+        self._lossless_applied = applied
+        return applied
+
     def encode_header(self) -> bytes:
+        lz = self._stamp_lossless()  # may swap payload — before pack/CRC
         ck = self._stamp_checksum()
         hdr = struct.pack(
             HEADER_FMT,
@@ -289,7 +359,8 @@ class Message:
             int(self.op),
             self.status
             | (TRACE_FLAG if self.trace is not None else 0)
-            | (CHECKSUM_FLAG if ck else 0),
+            | (CHECKSUM_FLAG if ck else 0)
+            | (LOSSLESS_FLAG if lz else 0),
             self.flags,
             self.seq,
             self.key,
@@ -331,14 +402,15 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def recv_header_ex(sock: socket.socket) -> tuple:
-    """Read + parse one header, trace- and checksum-aware; returns
-    (op, status, flags, seq, key, cmd, version, length, trace, crc)
-    where ``trace`` is (trace_id, span_id) or None and ``crc`` is the
-    frame's CHECKSUM_FLAG CRC32C or None.  Both flag bits are consumed
-    here — ``status`` comes back clean, so frames from stamping and
-    non-stamping peers are indistinguishable downstream.  The caller
-    that receives the payload owns verification (:func:`verify_checksum`
-    / :func:`recv_message`)."""
+    """Read + parse one header, trace-, checksum- and lossless-aware;
+    returns (op, status, flags, seq, key, cmd, version, length, trace,
+    crc, lossless) where ``trace`` is (trace_id, span_id) or None,
+    ``crc`` is the frame's CHECKSUM_FLAG CRC32C or None, and
+    ``lossless`` says the payload is a compressed container.  All flag
+    bits are consumed here — ``status`` comes back clean, so frames
+    from stamping and non-stamping peers are indistinguishable
+    downstream.  The caller that receives the payload owns verification
+    and decompression (:func:`verify_checksum` / :func:`recv_message`)."""
     hdr = _recv_exact(sock, HEADER_SIZE)
     magic, op, status, flags, seq, key, cmd, version, length = struct.unpack(
         HEADER_FMT, hdr
@@ -353,7 +425,11 @@ def recv_header_ex(sock: socket.socket) -> tuple:
     if status & CHECKSUM_FLAG:
         (crc,) = struct.unpack(_CHECKSUM_FMT, _recv_exact(sock, CHECKSUM_SIZE))
         status &= ~CHECKSUM_FLAG
-    return Op(op), status, flags, seq, key, cmd, version, length, trace, crc
+    lossless = bool(status & LOSSLESS_FLAG)
+    if lossless:
+        status &= ~LOSSLESS_FLAG
+    return (Op(op), status, flags, seq, key, cmd, version, length, trace,
+            crc, lossless)
 
 
 def recv_header(sock: socket.socket) -> tuple:
@@ -380,13 +456,21 @@ def verify_checksum(crc: Optional[int], trace: Optional[Tuple[int, int]],
 
 def recv_message(sock: socket.socket) -> Message:
     """Receive one frame; verifies the CHECKSUM_FLAG CRC32C when the
-    sender stamped one (raising :class:`ChecksumError` AFTER the frame
-    is consumed — drop semantics, the stream stays framed)."""
-    op, status, flags, seq, key, cmd, version, length, trace, crc = (
+    sender stamped one, then decompresses a LOSSLESS_FLAG container —
+    in that order, so the CRC is checked over the exact bytes that
+    shipped and a corrupt container never reaches the decompressor
+    unflagged.  Both failures (:class:`ChecksumError` /
+    :class:`LosslessError`) raise AFTER the frame is consumed — drop
+    semantics, the stream stays framed."""
+    op, status, flags, seq, key, cmd, version, length, trace, crc, lossless = (
         recv_header_ex(sock)
     )
     payload = _recv_exact(sock, length) if length else b""
     verify_checksum(crc, trace, payload, op=op)
+    if lossless:
+        from byteps_tpu.compression.lossless import decompress_frame
+
+        payload = decompress_frame(payload, op=op)
     return Message(
         op, key=key, payload=payload, seq=seq, cmd=cmd, version=version,
         status=status, flags=flags, trace=trace,
@@ -394,21 +478,24 @@ def recv_message(sock: socket.socket) -> Message:
 
 
 def _send(sock: socket.socket, msg: Message) -> None:
+    # header first: encode_header may finalize the lossless transform,
+    # swapping msg.payload for its compressed container
+    hdr = msg.encode_header()
     payload = msg.payload
     if not payload:
-        sock.sendall(msg.encode_header())
+        sock.sendall(hdr)
         return
     sendmsg = getattr(sock, "sendmsg", None)
     if sendmsg is None:
         # van object without scatter-gather: header-then-payload, still no
         # concat copy of the payload
-        sock.sendall(msg.encode_header())
+        sock.sendall(hdr)
         sock.sendall(payload)
         return
     # scatter-gather send: header + payload leave in ONE syscall with ZERO
     # payload memcpys (the kernel gathers straight from the caller's
     # buffer) — ps-lite's zero-copy ZPush property (core_loops.cc:538-582)
-    bufs = [memoryview(msg.encode_header()), memoryview(payload)]
+    bufs = [memoryview(hdr), memoryview(payload)]
     while bufs:
         sent = sendmsg(bufs)
         while bufs and sent >= len(bufs[0]):

@@ -85,7 +85,10 @@ class TopKCompressor(Compressor):
             out = np.empty(8 * k, dtype=np.uint8)
             ln = lib.bps_topk_compress(_ptr(grad), n, k, _ptr(out))
             return out[:ln].tobytes()
-        idx = np.argpartition(-np.abs(grad), k - 1)[:k]
+        # stable sort on magnitude: equal |values| at the k-th boundary
+        # select in ascending-index order, matching the native codec's
+        # comparator and the device packer (lax.top_k favors low index)
+        idx = np.argsort(-np.abs(grad), kind="stable")[:k]
         idx.sort()
         rec = np.empty(k, dtype=[("i", "<i4"), ("v", "<f4")])
         rec["i"] = idx

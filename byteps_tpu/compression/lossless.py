@@ -1,0 +1,323 @@
+"""Lossless wire-frame compression (the ZipCCL-style byte layer).
+
+Lossy gradient codecs (onebit/topk/dithering) are off the table for the
+bit-exactness-critical control-plane payloads — MIGRATE_STATE bodies,
+RESYNC_STATE snapshots, checkpoint shards, server-side optimizer-slot
+blocks — yet those are exactly the frames that ship megabytes of highly
+compressible float/JSON bytes during a reshard.  This module is the
+byte-oriented LZ layer for that traffic:
+
+- a self-describing **container** (magic + version + method + raw length)
+  so any decoder can validate before touching the body, and a ``store``
+  method so compression never inflates a frame;
+- a deterministic greedy **LZ codec** (LZ4-block-style token stream:
+  literal/match nibbles with 255-continuation, 2-byte little-endian
+  offsets, MINMATCH 4) implemented twice — pure Python here and bit-
+  identical C in ``native/wire.h`` — the same two-engine strategy the
+  gradient codecs use, so the Python worker, the C++ server, and the
+  golden fixtures can never drift;
+- **fail-closed decode**: any truncation, bad offset, length mismatch, or
+  unknown method raises :class:`LosslessError`; a corrupted frame is
+  dropped and retried, never installed.
+
+On the wire the transform is carried by the 0x20 status bit
+(``transport.LOSSLESS_FLAG`` / ``wire.h kLosslessFlag``) — a bit no
+pre-lossless decoder ever sets or strips, so old receivers see a nonzero
+status and refuse the frame cleanly instead of mis-parsing the body.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+#: container = MAGIC(4) VERSION(1) METHOD(1) RAW_LEN(4, big-endian)
+MAGIC = b"\xb5LZ0"
+VERSION = 1
+METHOD_STORE = 0   # body is the raw bytes verbatim
+METHOD_LZ = 1      # body is an LZ token stream (format below)
+HEADER_SIZE = 10
+
+#: payloads below this never win after the 10-byte container — skip the
+#: compressor entirely (mirrored by wire.h kLosslessMinBytes)
+MIN_BYTES = 64
+
+_MINMATCH = 4
+_HASH_BITS = 13            # 8192-slot table, single-probe
+_HASH_MULT = 2654435761    # Knuth multiplicative hash (fits u32)
+_MAX_OFFSET = 65535
+
+
+class LosslessError(ValueError):
+    """A lossless frame failed to decode (truncated / corrupt / unknown
+    method).  Like :class:`~byteps_tpu.comm.transport.ChecksumError` it is
+    raised only after the frame is fully consumed off the stream, so the
+    receiver drops the frame and keeps reading — fail closed, never a
+    silent wrong-bytes install."""
+
+    def __init__(self, reason: str, op=None) -> None:
+        super().__init__(f"lossless decode failed: {reason}"
+                         + (f" (op={op})" if op is not None else ""))
+        self.reason = reason
+        self.op = op
+
+
+# --- native fast path ------------------------------------------------------
+#: ctypes handles to wire.h's C implementation (None = unresolved,
+#: False = lib unavailable — pure Python takes over), same lazy-resolve
+#: shape as transport._resolve_crc_native
+_native = None
+
+
+def _resolve_native():
+    global _native
+    try:
+        from byteps_tpu.native import get_lib
+
+        lib = get_lib()
+        if (lib is not None and hasattr(lib, "bps_wire_lossless_compress")
+                and hasattr(lib, "bps_wire_lossless_decompress")):
+            _native = (lib.bps_wire_lossless_compress,
+                       lib.bps_wire_lossless_decompress)
+        else:
+            _native = False
+    except Exception:  # noqa: BLE001 — any import/build issue → fallback
+        _native = False
+    return _native
+
+
+def _hash4(v: int) -> int:
+    return ((v * _HASH_MULT) & 0xFFFFFFFF) >> (32 - _HASH_BITS)
+
+
+def lz_compress(src: bytes) -> bytes:
+    """Greedy single-probe LZ over ``src`` → token stream (no container).
+
+    Deterministic by construction (one hash slot, strictly-forward scan,
+    ties impossible) and byte-identical to wire.h ``lossless_lz_compress``
+    — change both together; tests/test_lossless.py pins the parity.
+    """
+    n = len(src)
+    out = bytearray()
+    if n < _MINMATCH:
+        _emit_seq(out, src, 0, n, 0, 0)
+        return bytes(out)
+    table = [-1] * (1 << _HASH_BITS)
+    # no match may begin in the last 12 bytes nor extend into the last 5
+    # (the LZ4 end-condition that keeps the decoder's copy loops simple)
+    mflimit = n - 12
+    matchlimit = n - 5
+    anchor = 0
+    pos = 0
+    while pos <= mflimit:
+        h = _hash4(int.from_bytes(src[pos:pos + 4], "little"))
+        cand = table[h]
+        table[h] = pos
+        if (cand >= 0 and pos - cand <= _MAX_OFFSET
+                and src[cand:cand + 4] == src[pos:pos + 4]):
+            mlen = _MINMATCH
+            while (pos + mlen < matchlimit
+                   and src[cand + mlen] == src[pos + mlen]):
+                mlen += 1
+            _emit_seq(out, src, anchor, pos - anchor, pos - cand, mlen)
+            anchor = pos + mlen
+            pos = anchor
+        else:
+            pos += 1
+    _emit_seq(out, src, anchor, n - anchor, 0, 0)
+    return bytes(out)
+
+
+def _emit_seq(out: bytearray, src: bytes, lit_start: int, lit_len: int,
+              offset: int, mlen: int) -> None:
+    """One sequence: token, extended literal length, literals, and —
+    unless this is the final literals-only sequence (``offset`` 0) —
+    a 2-byte LE offset plus extended match length."""
+    ml = mlen - _MINMATCH if offset else 0
+    token = (min(lit_len, 15) << 4) | min(ml, 15)
+    out.append(token)
+    if lit_len >= 15:
+        rem = lit_len - 15
+        while rem >= 255:
+            out.append(255)
+            rem -= 255
+        out.append(rem)
+    out += src[lit_start:lit_start + lit_len]
+    if offset:
+        out += offset.to_bytes(2, "little")
+        if ml >= 15:
+            rem = ml - 15
+            while rem >= 255:
+                out.append(255)
+                rem -= 255
+            out.append(rem)
+
+
+def lz_decompress(block, raw_len: int) -> bytes:
+    """Inverse of :func:`lz_compress`; validates every read/copy against
+    both the input and the declared ``raw_len`` and raises
+    :class:`LosslessError` on any violation."""
+    src = bytes(block)
+    n = len(src)
+    out = bytearray()
+    pos = 0
+    while True:
+        if pos >= n:
+            raise LosslessError("truncated token stream")
+        token = src[pos]
+        pos += 1
+        lit_len = token >> 4
+        if lit_len == 15:
+            while True:
+                if pos >= n:
+                    raise LosslessError("truncated literal length")
+                b = src[pos]
+                pos += 1
+                lit_len += b
+                if b != 255:
+                    break
+        if pos + lit_len > n:
+            raise LosslessError("literal run past end of input")
+        out += src[pos:pos + lit_len]
+        pos += lit_len
+        if len(out) > raw_len:
+            raise LosslessError("output exceeds declared raw length")
+        if pos == n:  # final literals-only sequence
+            break
+        if pos + 2 > n:
+            raise LosslessError("truncated match offset")
+        offset = int.from_bytes(src[pos:pos + 2], "little")
+        pos += 2
+        if offset == 0 or offset > len(out):
+            raise LosslessError("match offset outside window")
+        mlen = (token & 15)
+        if mlen == 15:
+            while True:
+                if pos >= n:
+                    raise LosslessError("truncated match length")
+                b = src[pos]
+                pos += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += _MINMATCH
+        if len(out) + mlen > raw_len:
+            raise LosslessError("match run exceeds declared raw length")
+        start = len(out) - offset
+        for i in range(mlen):  # overlapping copies must go byte-forward
+            out.append(out[start + i])
+    if len(out) != raw_len:
+        raise LosslessError(
+            f"raw length mismatch (declared {raw_len}, got {len(out)})")
+    return bytes(out)
+
+
+def compress_frame(data) -> bytes:
+    """``data`` → self-describing container.  Always succeeds: when the
+    LZ stream would not be smaller (or the input is tiny) the body is
+    stored verbatim, so a container is never more than HEADER_SIZE bytes
+    larger than its input."""
+    raw = bytes(data)
+    blob = _native_compress(raw)
+    if blob is not None:
+        return blob
+    head = MAGIC + bytes((VERSION,))
+    if len(raw) >= MIN_BYTES:
+        comp = lz_compress(raw)
+        if len(comp) < len(raw):
+            return (head + bytes((METHOD_LZ,))
+                    + len(raw).to_bytes(4, "big") + comp)
+    return head + bytes((METHOD_STORE,)) + len(raw).to_bytes(4, "big") + raw
+
+
+def decompress_frame(blob, op=None) -> bytes:
+    """Inverse of :func:`compress_frame`; raises :class:`LosslessError`
+    (carrying ``op`` for the receiver's counter label) on any corruption."""
+    buf = bytes(blob)
+    if len(buf) < HEADER_SIZE:
+        raise LosslessError("container shorter than header", op=op)
+    if buf[:4] != MAGIC:
+        raise LosslessError("bad container magic", op=op)
+    if buf[4] != VERSION:
+        raise LosslessError(f"unknown container version {buf[4]}", op=op)
+    method = buf[5]
+    raw_len = int.from_bytes(buf[6:10], "big")
+    body = buf[HEADER_SIZE:]
+    if method == METHOD_STORE:
+        if len(body) != raw_len:
+            raise LosslessError("stored body length mismatch", op=op)
+        return body
+    if method != METHOD_LZ:
+        raise LosslessError(f"unknown method {method}", op=op)
+    try:
+        dec = _native_decompress(buf, raw_len)
+        if dec is not None:
+            return dec
+        return lz_decompress(body, raw_len)
+    except LosslessError as e:
+        raise LosslessError(e.reason, op=op) from None
+
+
+def _native_compress(raw: bytes) -> Optional[bytes]:
+    """Full container via wire.h ``lossless_compress_frame`` — bit-
+    identical to the pure-Python path (store-vs-LZ decision included);
+    None when the lib isn't built."""
+    native = _native if _native is not None else _resolve_native()
+    if not native:
+        return None
+    import ctypes
+
+    cap = HEADER_SIZE + len(raw) + len(raw) // 255 + 16
+    out = ctypes.create_string_buffer(cap)
+    n = native[0](raw, len(raw), out, cap)
+    if n <= 0:
+        return None
+    return out.raw[:n]
+
+
+def _native_decompress(blob: bytes, raw_len: int) -> Optional[bytes]:
+    """Full-container decode via wire.h ``lossless_decompress_frame``;
+    None when the lib isn't built, LosslessError when the C validator
+    rejects the stream."""
+    native = _native if _native is not None else _resolve_native()
+    if not native:
+        return None
+    import ctypes
+
+    out = ctypes.create_string_buffer(max(raw_len, 1))
+    n = native[1](blob, len(blob), out, raw_len)
+    if n != raw_len:
+        raise LosslessError("native decoder rejected stream")
+    return out.raw[:raw_len]
+
+
+def byte_entropy(data, limit: int = 65536) -> float:
+    """Shannon entropy of ``data`` in bits/byte over at most ``limit``
+    leading bytes — the codec-selection signal (≈8.0 for incompressible
+    float mantissas, well under the ``BYTEPS_LOSSLESS_ENTROPY`` cutoff
+    for JSON/state bytes that the LZ arm recovers)."""
+    buf = bytes(data[:limit]) if limit else bytes(data)
+    if not buf:
+        return 0.0
+    counts = [0] * 256
+    for b in buf:
+        counts[b] += 1
+    n = len(buf)
+    ent = 0.0
+    for c in counts:
+        if c:
+            p = c / n
+            ent -= p * math.log2(p)
+    return ent
+
+
+def lossless_entropy_cutoff() -> float:
+    """Entropy (bits/byte) above which the auto-tuner's lossless arm
+    declines a key (``BYTEPS_LOSSLESS_ENTROPY``, default 6.0): payload
+    bytes that look random compress to nothing, so the raw arm wins."""
+    v = os.environ.get("BYTEPS_LOSSLESS_ENTROPY", "")
+    try:
+        return float(v) if v else 6.0
+    except ValueError:
+        return 6.0

@@ -146,7 +146,10 @@ class TuningState:
     accidentally (only an explicit rollback action can, by bumping the
     epoch again)."""
 
-    __slots__ = ("epoch", "fusion_threshold", "codec_off", "overrides")
+    __slots__ = (
+        "epoch", "fusion_threshold", "codec_off", "codec_lossless",
+        "overrides",
+    )
 
     def __init__(self) -> None:
         self.epoch = 0
@@ -155,6 +158,10 @@ class TuningState:
         self.fusion_threshold: Optional[int] = None
         #: codec type names the fleet agreed to stop compressing with
         self.codec_off: List[str] = []
+        #: codec type names whose raw-pushing keys the fleet agreed to
+        #: ship inside the wire lossless container (the consensus
+        #: policy's third arm; docs/gradient-compression.md)
+        self.codec_lossless: List[str] = []
         #: key → server rank placement overrides (the weighted ring
         #: override); shipped as ``ring_overrides`` so ownership stays
         #: atomic with the map epoch
@@ -166,6 +173,8 @@ class TuningState:
             t["fusion_threshold"] = int(self.fusion_threshold)
         if self.codec_off:
             t["codec_off"] = sorted(self.codec_off)
+        if self.codec_lossless:
+            t["codec_lossless"] = sorted(self.codec_lossless)
         return t
 
     def apply_patch(self, patch: dict) -> bool:
@@ -182,6 +191,12 @@ class TuningState:
         for name in patch.get("codec_off_remove", ()):
             if name in self.codec_off:
                 self.codec_off.remove(name)
+        for name in patch.get("codec_lossless_add", ()):
+            if name not in self.codec_lossless:
+                self.codec_lossless.append(name)
+        for name in patch.get("codec_lossless_remove", ()):
+            if name in self.codec_lossless:
+                self.codec_lossless.remove(name)
         for key, rank in (patch.get("overrides_set") or {}).items():
             k = int(key)
             if self.overrides.get(k) != int(rank):
@@ -335,6 +350,9 @@ class AutoTuner:
             self.state.codec_off = [
                 str(c) for c in (report.get("codec_off") or ())
             ]
+            self.state.codec_lossless = [
+                str(c) for c in (report.get("codec_lossless") or ())
+            ]
             overrides: Dict[int, int] = {}
             for k, r in (report.get("ring_overrides") or {}).items():
                 try:
@@ -420,7 +438,8 @@ class AutoTuner:
 
     def _forced_action(self, rule: str, view: dict) -> Optional[dict]:
         """``BYTEPS_AUTOTUNE_FORCE="fusion_threshold=65536"`` (or
-        ``codec_off=<name>``, ``move=<key>:<rank>``): apply one operator-
+        ``codec_off=<name>``, ``codec_lossless=<name>``,
+        ``move=<key>:<rank>``): apply one operator-
         scripted action on the first eligible sweep — the canary/rollback
         drill path (docs/autotune.md "Rollback flow"), also what
         ``chaos_soak --autotune`` uses to rehearse a rollback
@@ -456,6 +475,14 @@ class AutoTuner:
                     "rule": rule,
                     "set": {"codec_off_add": [v.strip()]},
                     "undo": {"codec_off_remove": [v.strip()]},
+                    "evidence": {"forced": self.cfg.force},
+                }
+            if k == "codec_lossless" and rule == "codec_consensus":
+                self._forced = True
+                return {
+                    "rule": rule,
+                    "set": {"codec_lossless_add": [v.strip()]},
+                    "undo": {"codec_lossless_remove": [v.strip()]},
                     "evidence": {"forced": self.cfg.force},
                 }
             if k == "move" and rule == "hot_key_rebalance" and self.reshard:
@@ -639,8 +666,15 @@ class AutoTuner:
         decision so the stragglers stop paying for a codec the majority
         measured as a loss.  One codec per sweep (the budget applies
         anyway); needs ≥2 workers — one worker's verdict is already
-        fleet-wide."""
-        votes = view.get("codec_votes") or {}
+        fleet-wide.
+
+        Third arm: workers whose entropy probe found a raw-pushing
+        codec's bytes losslessly compressible vote
+        ``compression_auto_lossless{codec}`` — the same quorum share
+        turns the wire lossless container on fleet-wide for that
+        codec's raw keys (``codec_lossless`` in the book; only codecs
+        ALREADY fleet-raw or locally verdicted raw can accumulate these
+        votes, so the two arms never race on one codec)."""
         try:
             nw = int(view.get("num_workers") or 0)
         except (TypeError, ValueError):
@@ -648,6 +682,7 @@ class AutoTuner:
         if nw < 2:
             return None
         need = max(1, math.ceil(self.cfg.quorum * nw))
+        votes = view.get("codec_votes") or {}
         for name in sorted(votes):
             if name in ("?", "") or name in self.state.codec_off:
                 continue
@@ -660,6 +695,21 @@ class AutoTuner:
                     "evidence": {
                         "codec": name, "votes": n, "quorum": need,
                         "num_workers": nw,
+                    },
+                }
+        lz_votes = view.get("codec_lossless_votes") or {}
+        for name in sorted(lz_votes):
+            if name in ("?", "") or name in self.state.codec_lossless:
+                continue
+            n = int(lz_votes[name])
+            if n >= need:
+                return {
+                    "rule": "codec_consensus",
+                    "set": {"codec_lossless_add": [name]},
+                    "undo": {"codec_lossless_remove": [name]},
+                    "evidence": {
+                        "codec": name, "arm": "lossless",
+                        "votes": n, "quorum": need, "num_workers": nw,
                     },
                 }
         return None

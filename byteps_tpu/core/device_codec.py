@@ -38,6 +38,12 @@ class _DeviceOneBit:
         self.size = size
         self.scaling = scaling
 
+    def wire_nbytes(self) -> int:
+        """Exact wire payload size (f32 scale + packed sign words) — the
+        fusion-threshold gauge, same contract as the host codec's
+        ``Compressor.wire_nbytes``."""
+        return 4 + 4 * ((self.size + 31) // 32)
+
     def compress(self, dev_flat) -> bytes:
         from byteps_tpu.ops.onebit_device import (
             onebit_compress_device,
@@ -61,6 +67,10 @@ class _DeviceTopK:
     def __init__(self, size: int, k: int) -> None:
         self.size = size
         self.k = max(1, min(int(k), size))
+
+    def wire_nbytes(self) -> int:
+        """Exact wire payload size (k × (i32 index, f32 value) pairs)."""
+        return 8 * self.k
 
     def compress(self, dev_flat) -> bytes:
         from byteps_tpu.ops.codecs_device import (
@@ -90,6 +100,10 @@ class _DeviceDithering:
         self.l2 = l2
         self._seed = seed or 0x5EED
         self._round = 0
+
+    def wire_nbytes(self) -> int:
+        """Exact wire payload size (f32 norm + one i8 level per element)."""
+        return 4 + self.size
 
     def compress(self, dev_flat) -> bytes:
         import jax

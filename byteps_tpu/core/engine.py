@@ -300,6 +300,18 @@ class PipelineEngine:
         QueueType.COPYD2H, QueueType.COMPRESS, QueueType.FUSE,
         QueueType.PULL, QueueType.DECOMPRESS, QueueType.COPYH2D,
     ]
+    #: device codec × fusion (docs/gradient-compression.md "Device
+    #: path"): the device packer emits the exact wire encoding ON
+    #: DEVICE, so COPYD2H already lands `task.compressed` — COMPRESS is
+    #: a pass-through, the fuser adds the device buffer's bytes as a
+    #: COMPRESSED_PUSH_PULL member, and the fused reply slot feeds the
+    #: device decoder on DECOMPRESS.  Same stage sequence as the host
+    #: compressed+fused path; the difference is WHERE the packing ran —
+    #: only compressed bytes ever cross the D2H boundary.
+    STAGES_DEVICE_COMPRESSED_FUSED = [
+        QueueType.COPYD2H, QueueType.COMPRESS, QueueType.FUSE,
+        QueueType.PULL, QueueType.DECOMPRESS, QueueType.COPYH2D,
+    ]
 
     #: monotonically increasing engine-instance id: the tensor registry
     #: (and each ctx's ``initialized`` flag) outlives shutdown()/init()
@@ -425,6 +437,15 @@ class PipelineEngine:
         self._launch_fusion_threshold = cfg.fusion_threshold
         self._codec_names: Dict[int, str] = {}
         self._fleet_codec_off: Dict[str, set] = {}
+        # third tuner arm (docs/gradient-compression.md "Lossless frame
+        # compression"): keys whose lossy codec lost the auto verdict
+        # push raw — the entropy probe in _push_once checks whether the
+        # raw bytes are compressible losslessly and, if so, stamps the
+        # key's later pushes with the wire-level lossless container.
+        # Python wire only (the native client's send path never frames).
+        self._lossless_keys: set = set()
+        self._lossless_probed: set = set()
+        self._fleet_codec_lossless: Dict[str, set] = {}
         self._tuning_lock = threading.Lock()
         # the fleet fusion-threshold gauge feeds the tuner's walk (the
         # scheduler reads the aggregate's max as the fleet value)
@@ -563,13 +584,15 @@ class PipelineEngine:
         )
         # small-tensor fusion routing, per partition: uncompressed
         # partitions gauge their RAW size against the threshold;
-        # compressed partitions gauge their WIRE size (codec wire_nbytes
-        # — the bytes that actually ride the frame), so a 256KB tensor
-        # whose onebit payload is 8KB fuses like any small tensor
-        # (docs/gradient-compression.md "Compressed wire path").  Device-
-        # codec jobs never fuse: their decoded partitions assemble on
-        # device and the fused reply delivery writes a host result
-        # buffer those jobs deliberately never allocate.
+        # compressed partitions — host OR device codec — gauge their
+        # WIRE size (codec wire_nbytes — the bytes that actually ride
+        # the frame), so a 256KB tensor whose onebit payload is 8KB
+        # fuses like any small tensor (docs/gradient-compression.md
+        # "Compressed wire path" / "Device path").  A fused device
+        # member rides the frame exactly like a host-compressed one
+        # (COMPRESSED_PUSH_PULL cmd), and its reply slot feeds the
+        # device decoder — the fused path never touches the host result
+        # buffer device jobs deliberately don't allocate.
         fuse_limit = self.cfg.fusion_threshold
         itemsize = np_dtype.itemsize
         if self._traced():
@@ -583,8 +606,12 @@ class PipelineEngine:
                 and part.key not in self._compression_auto_off
             )
             if job.device_parts is not None:
-                small = False
-                qlist = self.STAGES_COMPRESSED
+                wire_est = self._device_codecs[part.key].wire_nbytes()
+                small = bool(fuse_limit) and wire_est <= fuse_limit
+                qlist = (
+                    self.STAGES_DEVICE_COMPRESSED_FUSED if small
+                    else self.STAGES_COMPRESSED
+                )
             elif p_compressed:
                 wire_est = self._compressors[part.key].wire_nbytes()
                 small = bool(fuse_limit) and wire_est <= fuse_limit
@@ -1338,15 +1365,24 @@ class PipelineEngine:
         staging, core_loops.cc:498-536): the Pallas/jnp packer runs on the
         DEVICE slice first, and what crosses the device→host boundary here
         is the compressed payload — 32× less for onebit."""
+        from byteps_tpu.core.telemetry import counters
+
         job: _Job = task.context
         if job.device_parts is not None:
             dc = self._device_codecs[task.key]
             sl = job.flat[task.offset : task.offset + task.length]
             task.compressed = dc.compress(sl)  # D2H of the packed payload
+            # the headline device-path number: bytes that actually
+            # crossed the device→host boundary — compressed, vs the
+            # host path's raw staging below (docs/observability.md;
+            # tools/compression_bench.py D2H column)
+            counters().bump("d2h_bytes", len(task.compressed))
             self._proceed(task)
             return
         sl = job.flat[task.offset : task.offset + task.length]
         task.cpubuff = sl if isinstance(sl, np.ndarray) else np.asarray(sl)
+        if job.is_jax:
+            counters().bump("d2h_bytes", task.cpubuff.nbytes)
         self._proceed(task)
 
     def _unstage_small(self, task: TensorTableEntry) -> None:
@@ -1440,6 +1476,46 @@ class PipelineEngine:
                     "autotune: fleet codec decision on %r rolled back "
                     "(%d keys compress again)", name, len(keys),
                 )
+            # third arm (docs/gradient-compression.md "Lossless frame
+            # compression"): adopt the fleet's codec_lossless names —
+            # this engine's raw-pushing keys under a named codec start
+            # shipping the wire lossless container.  Gated on the SAME
+            # master switch as the probe: a worker with
+            # BYTEPS_WIRE_LOSSLESS off ignores the names entirely so a
+            # mixed-knob fleet never emits frames its peers can't want.
+            from byteps_tpu.comm.transport import wire_lossless_enabled
+
+            lz = {str(n) for n in (t.get("codec_lossless") or ())}
+            if not wire_lossless_enabled():
+                lz = set()
+            for name in sorted(lz - set(self._fleet_codec_lossless)):
+                keys = {
+                    k for k, n in self._codec_names.items()
+                    if n == name
+                    and k in self._compression_auto_off
+                    and k not in self._lossless_keys
+                }
+                self._fleet_codec_lossless[name] = keys
+                self._lossless_keys.update(keys)
+                if keys:
+                    counters().bump(
+                        "tune_codec_lossless", len(keys),
+                        labels={"codec": name},
+                    )
+                bpslog.warning(
+                    "autotune: fleet lossless arm on %r "
+                    "(%d local raw keys ship the lossless frame)",
+                    name, len(keys),
+                )
+            for name in sorted(set(self._fleet_codec_lossless) - lz):
+                # rollback mirrors codec_off: exactly the fleet-marked
+                # keys drop the transform; probe-verdicted keys keep it
+                keys = self._fleet_codec_lossless.pop(name)
+                self._lossless_keys.difference_update(keys)
+                bpslog.warning(
+                    "autotune: fleet lossless arm on %r rolled back "
+                    "(%d keys push plain raw again)", name, len(keys),
+                )
 
     def _auto_static_verdict(self, key: int, codec) -> None:
         """Registration-time verdict of the adaptive-compression policy
@@ -1470,6 +1546,56 @@ class PipelineEngine:
             "wire ratio %.3f >= %.3f (BYTEPS_COMPRESSION_AUTO; codec wire "
             "size is deterministic, no probe rounds needed); rounds push "
             "raw", key, ratio, self.cfg.compression_auto_ratio,
+        )
+
+    def _lossless_probe(self, key: int, payload) -> None:
+        """Third arm of the adaptive-compression policy (docs/gradient-
+        compression.md "Lossless frame compression"): a key whose lossy
+        codec lost the auto verdict pushes raw — probe ONE raw payload's
+        byte entropy and, when it reads compressible (at or below
+        BYTEPS_LOSSLESS_ENTROPY bits/byte), trial-run the wire lossless
+        container.  A real win (>= 10% smaller) turns the transform on
+        for this key's later pushes and casts the codec-labeled
+        ``compression_auto_lossless`` vote the scheduler's
+        codec_lossless quorum counts (docs/autotune.md).  One probe per
+        key per engine; requires BYTEPS_WIRE_LOSSLESS so a fleet that
+        keeps the wire feature off never sees a flagged frame."""
+        self._lossless_probed.add(key)
+        from byteps_tpu.comm.transport import wire_lossless_enabled
+
+        if not wire_lossless_enabled():
+            return
+        from byteps_tpu.compression.lossless import (
+            MIN_BYTES,
+            byte_entropy,
+            compress_frame,
+            lossless_entropy_cutoff,
+        )
+
+        raw = bytes(payload[:65536])
+        if len(raw) < MIN_BYTES:
+            return
+        ent = byte_entropy(raw)
+        if ent > lossless_entropy_cutoff():
+            return
+        comp = compress_frame(raw)
+        if len(comp) * 10 > len(raw) * 9:
+            return  # entropy looked low but the LZ pass found no win
+        with self._tuning_lock:
+            self._lossless_keys.add(key)
+        from byteps_tpu.core.telemetry import counters
+
+        counters().bump(
+            "compression_auto_lossless",
+            labels={"codec": self._codec_names.get(key, "?")},
+        )
+        from byteps_tpu.common import logging as bpslog
+
+        bpslog.warning(
+            "lossless arm enabled for key %d: raw push entropy %.2f "
+            "bits/byte, trial container %.2fx (BYTEPS_COMPRESSION_AUTO "
+            "third arm); later pushes ship the wire lossless frame",
+            key, ent, len(raw) / max(1, len(comp)),
         )
 
     def _note_compression(self, key: int, raw_nbytes: int,
@@ -1733,6 +1859,20 @@ class PipelineEngine:
                 else buf.tobytes()
             )
             rtype = RequestType.DEFAULT_PUSH_PULL
+            if (
+                self.cfg.compression_auto
+                and task.key in self._compression_auto_off
+                and task.key not in self._lossless_probed
+            ):
+                self._lossless_probe(task.key, payload)
+        # third tuner arm: a raw-pushing key the entropy probe (or a
+        # fleet codec_lossless decision) marked ships inside the wire
+        # lossless container.  Compressed/rowsparse payloads never
+        # qualify — the lossy codec already owns their bytes.
+        lossless = (
+            rtype == RequestType.DEFAULT_PUSH_PULL
+            and task.key in self._lossless_keys
+        ) or None
         if self.telemetry is not None:
             self.telemetry.record(len(payload))
         from byteps_tpu.core.telemetry import counters
@@ -1749,7 +1889,7 @@ class PipelineEngine:
         self.client.push(
             task.key, payload, job.dtype_id, task.version,
             cb=lambda: self._proceed(task),
-            request_type=rtype,
+            request_type=rtype, lossless=lossless,
             on_error=lambda: self._fail_task(
                 task, QueueType.PUSH, "server connection lost", degraded=True
             ),

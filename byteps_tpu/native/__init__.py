@@ -211,6 +211,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             c.c_void_p, c.c_uint64,
         ]
         lib.bps_wire_client_frame_ck.restype = c.c_int64
+    # lossless wire-frame codec (compression/lossless.py's ctypes fast
+    # path + the C/Python parity anchor) — may be absent in a stale .so;
+    # the pure-Python codec takes over
+    if hasattr(lib, "bps_wire_lossless_compress"):
+        lib.bps_wire_lossless_compress.argtypes = [
+            c.c_char_p, c.c_uint64, c.c_void_p, c.c_uint64,
+        ]
+        lib.bps_wire_lossless_compress.restype = c.c_int64
+        lib.bps_wire_lossless_decompress.argtypes = [
+            c.c_char_p, c.c_uint64, c.c_void_p, c.c_uint64,
+        ]
+        lib.bps_wire_lossless_decompress.restype = c.c_int64
     # native worker client data plane (ps_client.cc) — may be absent in a
     # stale .so; the pure-Python client covers every van without it
     if hasattr(lib, "bpsc_create"):
@@ -260,10 +272,9 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None  # corrupt/partial .so → pure-Python fallbacks
-    if not hasattr(lib, "bps_wire_crc32c") and autobuild:
+    if not hasattr(lib, "bps_wire_lossless_compress") and autobuild:
         # stale library from before the newest entry points (currently
-        # the end-to-end wire-integrity plane: shared CRC32C + the
-        # checksummed golden shims): rebuild, then
+        # the lossless wire-frame codec plane): rebuild, then
         # load via a temp COPY — dlopen dedups by path/inode, so
         # reloading the original path can hand back the old mapping
         _try_build()
@@ -277,7 +288,7 @@ def _load() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy(_LIB_PATH, tmp.name)
             fresh = ctypes.CDLL(tmp.name)
-            if hasattr(fresh, "bps_wire_crc32c"):
+            if hasattr(fresh, "bps_wire_lossless_compress"):
                 lib = fresh
         except OSError:
             pass
@@ -312,6 +323,7 @@ NATIVE_COUNTER_NAMES = (
     "native_checksum_fail",
     "native_checksum_conn_drop",
     "native_server_opt_reject",
+    "native_lossless_fail",
 )
 
 

@@ -89,9 +89,13 @@ int64_t bps_topk_compress(const float* in, int64_t n, int64_t k,
   if (k > n) k = n;
   std::vector<int32_t> idx(n);
   std::iota(idx.begin(), idx.end(), 0);
+  // tie-break on index (ascending) so equal |magnitudes| at the k-th
+  // boundary select deterministically — and identically to the device
+  // packer (jax.lax.top_k favors lower indices) and the numpy fallback
   std::nth_element(idx.begin(), idx.begin() + k, idx.end(),
                    [&](int32_t a, int32_t b) {
-                     return std::fabs(in[a]) > std::fabs(in[b]);
+                     float fa = std::fabs(in[a]), fb = std::fabs(in[b]);
+                     return fa > fb || (fa == fb && a < b);
                    });
   // deterministic order: sort the selected k by index
   std::sort(idx.begin(), idx.begin() + k);

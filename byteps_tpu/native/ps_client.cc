@@ -327,6 +327,14 @@ struct NativeClient {
         h.status &= static_cast<uint8_t>(~bps_wire::kChecksumFlag);
         have_ck = true;
       }
+      // Optional lossless container (transport.py LOSSLESS_FLAG): the
+      // payload on the wire is compressed — `length` and the CRC cover
+      // the compressed bytes; decode happens after integrity passes.
+      bool have_lz = false;
+      if (h.status & bps_wire::kLosslessFlag) {
+        h.status &= static_cast<uint8_t>(~bps_wire::kLosslessFlag);
+        have_lz = true;
+      }
       Completion m{};
       m.op = h.op;
       m.status = h.status;
@@ -350,7 +358,9 @@ struct NativeClient {
       }
       const uint8_t* body = nullptr;
       if (m.len) {
-        if (sink && sink_len == m.len) {
+        // a lossless frame's `length` is the container size, never the
+        // caller's raw-sized sink — always land it in an owned payload
+        if (!have_lz && sink && sink_len == m.len) {
           // zero-copy: the response lands directly in the caller's
           // registered buffer (ZPull-into-SArray parity); the queued
           // record carries no bytes.  The sink stays valid until the
@@ -388,6 +398,41 @@ struct NativeClient {
           if (ck_conn_limit && fails >= ck_conn_limit)
             break;  // repeated corruption: poison the conn → revival
           continue;
+        }
+      }
+      if (have_lz) {
+        // decompress AFTER integrity passes; a corrupt container drops
+        // exactly like a CRC mismatch (pending entry stays registered,
+        // deadline/retry re-fetches) — the op=-3 notification carries
+        // status=1 so Python counts it as wire_lossless_fail
+        long raw = bps_wire::lossless_raw_len(body, (size_t)m.len);
+        std::vector<uint8_t> dec;
+        long got = -1;
+        if (raw >= 0) {
+          dec.resize(raw > 0 ? (size_t)raw : 1);
+          got = bps_wire::lossless_decompress_frame(body, (size_t)m.len,
+                                                    dec.data(), (size_t)raw);
+        }
+        if (got < 0 || got != raw) {
+          uint32_t fails = ck_fails.fetch_add(1, std::memory_order_relaxed) + 1;
+          Completion note{};
+          note.op = -3;
+          note.status = 1;
+          note.seq = m.seq;
+          note.cmd = m.op >= 0 ? (uint32_t)m.op : 0;
+          push_completion(std::move(note));
+          if (ck_conn_limit && fails >= ck_conn_limit) break;
+          continue;
+        }
+        dec.resize((size_t)raw);
+        m.payload.swap(dec);
+        m.len = (uint64_t)raw;
+        if (sink && sink_len == m.len) {
+          // the caller registered a raw-sized sink (pull): deliver the
+          // decoded bytes there so the zero-copy drain contract holds
+          std::memcpy(sink, m.payload.data(), (size_t)m.len);
+          m.zc = 1;
+          m.payload.clear();
         }
       }
       // un-register only AFTER the payload is fully received: dying
@@ -626,6 +671,23 @@ int64_t bps_wire_client_frame_ck(int32_t op, uint32_t seq, uint64_t key,
 // integrity tests pin the pure-Python fallback against.
 uint32_t bps_wire_crc32c(const void* data, uint64_t n, uint32_t crc) {
   return bps_wire::crc32c(data, (size_t)n, crc);
+}
+
+// Lossless frame codec through the LIVE wire.h implementation — the
+// ctypes fast path compression/lossless.py rides, and the parity anchor
+// tests/test_lossless.py pins the pure-Python codec against (both sides
+// must emit identical containers for identical inputs).  Returns the
+// container / raw size, -1 on decode failure, 0 when `cap` is too small.
+int64_t bps_wire_lossless_compress(const uint8_t* src, uint64_t n,
+                                   uint8_t* dst, uint64_t cap) {
+  return (int64_t)bps_wire::lossless_compress_frame(src, (size_t)n, dst,
+                                                    (size_t)cap);
+}
+
+int64_t bps_wire_lossless_decompress(const uint8_t* src, uint64_t n,
+                                     uint8_t* dst, uint64_t dst_cap) {
+  return (int64_t)bps_wire::lossless_decompress_frame(src, (size_t)n, dst,
+                                                      (size_t)dst_cap);
 }
 
 int64_t bpsc_drain(int64_t h, void* recs_out, int64_t max_recs,

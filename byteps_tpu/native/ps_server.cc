@@ -131,8 +131,11 @@ enum NativeCounter {
   kCtrChecksumConnDrop,  // connections dropped after
                          // BYTEPS_CHECKSUM_CONN_LIMIT mismatches
   kCtrServerOptReject,   // server-opt-profile INITs refused (the update
-                         // plane is Python-engine-only; appended LAST so
-                         // an older .so keeps its index mapping)
+                         // plane is Python-engine-only; appended so an
+                         // older .so keeps its index mapping)
+  kCtrLosslessFail,      // frames dropped on a lossless-container decode
+                         // failure (fail-closed; appended LAST so an
+                         // older .so keeps its index mapping)
   kCtrCount,
 };
 
@@ -147,7 +150,7 @@ const char* const kCounterNames[kCtrCount] = {
     "native_resync_query",    "native_zombie_reject", "native_span_drop",
     "native_wrong_owner",     "native_job_reject",    "native_async_reject",
     "native_checksum_fail",   "native_checksum_conn_drop",
-    "native_server_opt_reject",
+    "native_server_opt_reject", "native_lossless_fail",
 };
 
 // ---------------------------------------------------------------------------
@@ -1463,6 +1466,7 @@ class NativeServer {
     // per connection before dropping it (shared wire.h parsers —
     // transport.py truthiness)
     checksum_on_ = bps_wire::checksum_env_on();
+    lossless_on_ = bps_wire::lossless_env_on();
     ck_conn_limit_ = bps_wire::checksum_env_conn_limit();
     // BYTEPS_SERVER_STRIPES: reducer-thread count the key space shards
     // across.  Default min(4, cores): below 4 cores more stripes only
@@ -1548,6 +1552,23 @@ class NativeServer {
   void send_msg(const ConnPtr& conn, uint8_t op, uint32_t seq, uint64_t key,
                 uint32_t version, const uint8_t* payload, uint64_t len,
                 uint8_t status = 0) {
+    // lossless frame transform (transport.py Message._stamp_lossless
+    // parity): control-plane payloads compress BEFORE the head is
+    // built, so `length` and the CRC32C cover the bytes that ship; the
+    // flag rides only when the container actually won
+    std::vector<uint8_t> lz;
+    if (lossless_on_ && bps_wire::lossless_op(op) &&
+        len >= bps_wire::kLosslessMinBytes) {
+      lz.resize(bps_wire::kLosslessHeader + (size_t)len + (size_t)len / 255 +
+                16);
+      size_t c = bps_wire::lossless_compress_frame(payload, (size_t)len,
+                                                   lz.data(), lz.size());
+      if (c > 0 && c < (size_t)len) {
+        payload = lz.data();
+        len = c;
+        status |= bps_wire::kLosslessFlag;
+      }
+    }
     // shared wire.h head builder: header + (with BYTEPS_WIRE_CHECKSUM)
     // the 4-byte CRC32C over the payload — the SAME encode path the
     // native client and the golden shims use, computed once per frame
@@ -1729,6 +1750,14 @@ class NativeServer {
         h.status &= static_cast<uint8_t>(~bps_wire::kChecksumFlag);
         have_ck = true;
       }
+      // Optional lossless container (transport.py LOSSLESS_FLAG): the
+      // payload is compressed on the wire — decoded below, AFTER the
+      // CRC verifies the bytes that actually shipped.
+      bool have_lz = false;
+      if (h.status & bps_wire::kLosslessFlag) {
+        h.status &= static_cast<uint8_t>(~bps_wire::kLosslessFlag);
+        have_lz = true;
+      }
 
       uint32_t seq = ntohl(h.seq);
       uint64_t key = be64toh(h.key);
@@ -1754,6 +1783,33 @@ class NativeServer {
           }
           continue;
         }
+      }
+      if (have_lz) {
+        // decompress AFTER integrity passes; a corrupt container drops
+        // exactly like a CRC mismatch — no reply, no state touched,
+        // fail closed (never a silent wrong-bytes install), with the
+        // same repeated-corruption connection escalation
+        long raw = bps_wire::lossless_raw_len(payload.data(), payload.size());
+        std::vector<uint8_t> dec;
+        long got = -1;
+        if (raw >= 0) {
+          dec.resize(raw > 0 ? (size_t)raw : 1);
+          got = bps_wire::lossless_decompress_frame(
+              payload.data(), payload.size(), dec.data(), (size_t)raw);
+        }
+        if (got < 0 || got != raw) {
+          NDBG("serve: lossless decode failed (op %d)", (int)h.op);
+          ctr_[kCtrLosslessFail].fetch_add(1, std::memory_order_relaxed);
+          if (ck_conn_limit_ && ++ck_fails >= ck_conn_limit_) {
+            ctr_[kCtrChecksumConnDrop].fetch_add(1,
+                                                 std::memory_order_relaxed);
+            break;
+          }
+          continue;
+        }
+        dec.resize((size_t)raw);
+        payload.swap(dec);
+        len = (uint64_t)raw;
       }
       // Multi-tenant fence (docs/async.md): keys carry their job id in
       // the top 16 bits, and this engine has no per-job round sizing,
@@ -2668,6 +2724,9 @@ class NativeServer {
   // start_engine
   bool checksum_on_ = false;
   uint32_t ck_conn_limit_ = 8;
+  // lossless control-plane frame compression (BYTEPS_WIRE_LOSSLESS,
+  // read once in start_engine; decode is never gated on it)
+  bool lossless_on_ = false;
   std::vector<std::unique_ptr<Stripe>> stripes_;
   // EF residual lr (workers broadcast optimizer lr; default 1.0)
   std::atomic<float> ef_lr_{1.0f};

@@ -29,6 +29,14 @@ constexpr uint8_t kTraceFlag = 0x80;
 //: (transport.py CHECKSUM_FLAG; docs/robustness.md "Wire integrity")
 constexpr uint8_t kChecksumFlag = 0x40;
 
+//: status-byte bit: the payload is a lossless container
+//: (compression/lossless.py frame format) — header `length` and the
+//: CRC32C cover the COMPRESSED bytes; the receiver decompresses after
+//: integrity passes.  A bit no pre-lossless decoder sets or strips:
+//: old receivers see nonzero status and refuse the frame cleanly
+//: (transport.py LOSSLESS_FLAG)
+constexpr uint8_t kLosslessFlag = 0x20;
+
 // transport.py Op enum (data-plane subset the native code speaks)
 enum Opcode : uint8_t {
   kInit = 10,
@@ -191,6 +199,214 @@ inline uint32_t checksum_env_conn_limit() {
   long n = strtol(v, &end, 10);
   if (end == v || n < 0) return 8;
   return (uint32_t)n;
+}
+
+// --- lossless frame compression (kLosslessFlag) ----------------------------
+//
+// Byte-oriented LZ for the bit-exactness-critical control-plane payloads
+// (MIGRATE_STATE / RESYNC_STATE bodies, optimizer-slot blocks) — the
+// traffic lossy codecs can't touch.  Container and token stream are
+// byte-identical to compression/lossless.py (change both together;
+// tests/test_lossless.py pins the parity via the bps_wire_lossless_*
+// shims): 10-byte container [4-byte magic B5 'L' 'Z' '0', version 1,
+// method (0 store / 1 LZ), u32 BE raw length], then an LZ4-block-style
+// greedy token stream — literal/match nibbles with 255-continuation,
+// 2-byte little-endian offsets, MINMATCH 4, single-probe 8192-slot
+// Knuth hash, final sequence literals-only.  Deterministic by
+// construction, so both engines emit the same bytes for the same input.
+
+constexpr uint8_t kLosslessMagic[4] = {0xB5, 'L', 'Z', '0'};
+constexpr uint8_t kLosslessVersion = 1;
+constexpr uint8_t kLosslessStore = 0;
+constexpr uint8_t kLosslessLZ = 1;
+constexpr size_t kLosslessHeader = 10;
+//: payloads below this never win after the container — skip the
+//: compressor (compression/lossless.py MIN_BYTES)
+constexpr size_t kLosslessMinBytes = 64;
+
+inline size_t lossless_bound(size_t n) { return n + n / 255 + 16; }
+
+// Greedy single-probe LZ block (no container); returns compressed size,
+// or 0 when `dst` (of `cap` bytes) cannot hold the stream — callers pass
+// lossless_bound(n) and then store when the result is not smaller.
+inline size_t lossless_lz_compress(const uint8_t* src, size_t n,
+                                   uint8_t* dst, size_t cap) {
+  size_t out = 0;
+  auto emit_seq = [&](size_t lit_start, size_t lit_len, size_t offset,
+                      size_t mlen) -> bool {
+    size_t ml = offset ? mlen - 4 : 0;
+    size_t need = 1 + lit_len + (lit_len >= 15 ? (lit_len - 15) / 255 + 1 : 0)
+                  + (offset ? 2 + (ml >= 15 ? (ml - 15) / 255 + 1 : 0) : 0);
+    if (out + need > cap) return false;
+    dst[out++] = (uint8_t)(((lit_len < 15 ? lit_len : 15) << 4)
+                           | (ml < 15 ? ml : 15));
+    if (lit_len >= 15) {
+      size_t rem = lit_len - 15;
+      while (rem >= 255) { dst[out++] = 255; rem -= 255; }
+      dst[out++] = (uint8_t)rem;
+    }
+    std::memcpy(dst + out, src + lit_start, lit_len);
+    out += lit_len;
+    if (offset) {
+      dst[out++] = (uint8_t)(offset & 0xFF);
+      dst[out++] = (uint8_t)(offset >> 8);
+      if (ml >= 15) {
+        size_t rem = ml - 15;
+        while (rem >= 255) { dst[out++] = 255; rem -= 255; }
+        dst[out++] = (uint8_t)rem;
+      }
+    }
+    return true;
+  };
+  if (n < 4) return emit_seq(0, n, 0, 0) ? out : 0;
+  int32_t table[1 << 13];
+  std::memset(table, 0xFF, sizeof(table));
+  ptrdiff_t mflimit = (ptrdiff_t)n - 12;  // no match begins past here...
+  size_t matchlimit = n - 5;              // ...nor extends past here
+  size_t anchor = 0, pos = 0;
+  while ((ptrdiff_t)pos <= mflimit) {
+    uint32_t v;
+    std::memcpy(&v, src + pos, 4);
+#if __BYTE_ORDER == __BIG_ENDIAN
+    v = __builtin_bswap32(v);
+#endif
+    uint32_t h = (uint32_t)(v * 2654435761u) >> 19;
+    int32_t cand = table[h];
+    table[h] = (int32_t)pos;
+    if (cand >= 0 && pos - (size_t)cand <= 65535 &&
+        std::memcmp(src + cand, src + pos, 4) == 0) {
+      size_t mlen = 4;
+      while (pos + mlen < matchlimit && src[cand + mlen] == src[pos + mlen])
+        ++mlen;
+      if (!emit_seq(anchor, pos - anchor, pos - (size_t)cand, mlen)) return 0;
+      anchor = pos + mlen;
+      pos = anchor;
+    } else {
+      ++pos;
+    }
+  }
+  return emit_seq(anchor, n - anchor, 0, 0) ? out : 0;
+}
+
+// Inverse of lossless_lz_compress; every read and copy is validated
+// against the input and the declared raw length.  Returns raw_len on
+// success, -1 on any violation — fail closed, the caller drops the frame.
+inline long lossless_lz_decompress(const uint8_t* src, size_t n,
+                                   uint8_t* dst, size_t raw_len) {
+  size_t pos = 0, out = 0;
+  for (;;) {
+    if (pos >= n) return -1;  // truncated token stream
+    uint8_t token = src[pos++];
+    size_t lit_len = token >> 4;
+    if (lit_len == 15) {
+      uint8_t b;
+      do {
+        if (pos >= n) return -1;
+        b = src[pos++];
+        lit_len += b;
+      } while (b == 255);
+    }
+    if (pos + lit_len > n || out + lit_len > raw_len) return -1;
+    std::memcpy(dst + out, src + pos, lit_len);
+    pos += lit_len;
+    out += lit_len;
+    if (pos == n) break;  // final literals-only sequence
+    if (pos + 2 > n) return -1;
+    size_t offset = (size_t)src[pos] | ((size_t)src[pos + 1] << 8);
+    pos += 2;
+    if (offset == 0 || offset > out) return -1;
+    size_t mlen = token & 15;
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (pos >= n) return -1;
+        b = src[pos++];
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += 4;
+    if (out + mlen > raw_len) return -1;
+    const uint8_t* from = dst + out - offset;
+    for (size_t i = 0; i < mlen; ++i) dst[out + i] = from[i];  // overlap-safe
+    out += mlen;
+  }
+  return out == raw_len ? (long)raw_len : -1;
+}
+
+// data → self-describing container in `dst` (cap must be at least
+// kLosslessHeader + lossless_bound(n)); always succeeds via the store
+// method when LZ does not win.  Returns the container size.
+inline size_t lossless_compress_frame(const uint8_t* src, size_t n,
+                                      uint8_t* dst, size_t cap) {
+  if (cap < kLosslessHeader + n) return 0;
+  std::memcpy(dst, kLosslessMagic, 4);
+  dst[4] = kLosslessVersion;
+  uint32_t be = htonl((uint32_t)n);
+  std::memcpy(dst + 6, &be, 4);
+  if (n >= kLosslessMinBytes && cap > kLosslessHeader) {
+    size_t c = lossless_lz_compress(src, n, dst + kLosslessHeader,
+                                    cap - kLosslessHeader);
+    if (c > 0 && c < n) {
+      dst[5] = kLosslessLZ;
+      return kLosslessHeader + c;
+    }
+  }
+  dst[5] = kLosslessStore;
+  std::memcpy(dst + kLosslessHeader, src, n);
+  return kLosslessHeader + n;
+}
+
+// Container → raw bytes; returns the raw length, or -1 on any corruption
+// (bad magic/version/method, truncation, length mismatch).  `dst` must
+// hold lossless_raw_len(...) bytes.
+inline long lossless_raw_len(const uint8_t* src, size_t n) {
+  if (n < kLosslessHeader) return -1;
+  if (std::memcmp(src, kLosslessMagic, 4) != 0) return -1;
+  if (src[4] != kLosslessVersion) return -1;
+  uint32_t be;
+  std::memcpy(&be, src + 6, 4);
+  return (long)ntohl(be);
+}
+
+inline long lossless_decompress_frame(const uint8_t* src, size_t n,
+                                      uint8_t* dst, size_t dst_cap) {
+  long raw = lossless_raw_len(src, n);
+  if (raw < 0 || (size_t)raw > dst_cap) return -1;
+  uint8_t method = src[5];
+  const uint8_t* body = src + kLosslessHeader;
+  size_t body_len = n - kLosslessHeader;
+  if (method == kLosslessStore) {
+    if (body_len != (size_t)raw) return -1;
+    std::memcpy(dst, body, body_len);
+    return raw;
+  }
+  if (method != kLosslessLZ) return -1;
+  return lossless_lz_decompress(body, body_len, dst, (size_t)raw);
+}
+
+// Stamp outgoing frames with lossless compression?  Mirrors transport.py
+// wire_lossless_enabled() (BYTEPS_WIRE_LOSSLESS, default off, same
+// truthiness as checksum_env_on — change both together).  Decode is NOT
+// gated on this: any received frame carrying kLosslessFlag is decoded.
+inline bool lossless_env_on() {
+  const char* v = getenv("BYTEPS_WIRE_LOSSLESS");
+  if (!v || !*v) return false;
+  return !(strcmp(v, "0") == 0 || strcasecmp(v, "false") == 0 ||
+           strcasecmp(v, "no") == 0 || strcasecmp(v, "off") == 0);
+}
+
+// Ops whose payloads auto-compress when stamping is on — the
+// bit-exactness-critical control plane only, mirroring transport.py
+// _LOSSLESS_OPS (change both together).  Gradient-plane frames keep
+// their own (lossy / per-key tuned) codecs.
+inline bool lossless_op(uint8_t op) {
+  switch (op) {
+    case kResyncState:
+    case kMigrateState:
+      return true;
+    default:
+      return false;
+  }
 }
 
 //: largest pre-payload prefix: header (32) + trace (16) + crc (4)

@@ -11,8 +11,10 @@ compressed payload instead of the full fp32 gradient:
 Wire compatibility:
 
 - ``topk``: byte-identical to the host/C++ codec (``[i32 idx, f32 val]``
-  pairs sorted by index, topk.cc:26 / native/compressor.cc:87-104) —
-  bit-match asserted in tests whenever the k-th magnitude is unique.
+  pairs sorted by index, topk.cc:26 / native/compressor.cc:87-104).
+  All three selectors break magnitude ties toward the LOWER index
+  (``lax.top_k``'s documented order; the host paths mirror it), so the
+  bit-match holds even when the k-th magnitude is duplicated.
 - ``dithering``: the payload is ``[f32 norm][int8 levels]`` and the server
   decodes WITHOUT re-deriving any randomness (unlike randomk, the RNG
   affects only the worker-side stochastic rounding draw — dithering.h:43-78).

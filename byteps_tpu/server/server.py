@@ -1778,7 +1778,11 @@ class PSServer:
                 pass
 
     def _serve_conn_loop(self, conn: socket.socket, send_lock) -> None:
-        from byteps_tpu.comm.transport import ChecksumError, checksum_conn_limit
+        from byteps_tpu.comm.transport import (
+            ChecksumError,
+            LosslessError,
+            checksum_conn_limit,
+        )
 
         ck_limit = checksum_conn_limit()
         ck_fails = 0
@@ -1786,18 +1790,23 @@ class PSServer:
             while not self._stop.is_set():
                 try:
                     msg = recv_message(conn)
-                except ChecksumError as e:
+                except (ChecksumError, LosslessError) as e:
                     # end-to-end wire integrity (docs/robustness.md "Wire
                     # integrity"): a flipped payload bit that survived
-                    # TCP's checksum.  The frame is fully consumed, so
-                    # DROP it without a reply — the worker's deadline/
-                    # retry + the exactly-once ledger heal it bitwise —
-                    # and escalate repeated mismatches to a connection
-                    # drop so the client revives (possibly bad NIC/path).
+                    # TCP's checksum, or a lossless container that failed
+                    # to decode.  The frame is fully consumed, so DROP it
+                    # without a reply — the worker's deadline/retry + the
+                    # exactly-once ledger heal it bitwise, never a silent
+                    # wrong-bytes install — and escalate repeated
+                    # corruption to a connection drop so the client
+                    # revives (possibly bad NIC/path).
                     from byteps_tpu.core.telemetry import counters
 
                     ck_fails += 1
-                    counters().bump("wire_checksum_fail", labels={
+                    name = ("wire_lossless_fail"
+                            if isinstance(e, LosslessError)
+                            else "wire_checksum_fail")
+                    counters().bump(name, labels={
                         "side": "server",
                         "op": getattr(e.op, "name", str(e.op)),
                     })

@@ -261,6 +261,62 @@ class TestCodecConsensus:
         assert not t.sweep(self.codec_view({"topk": 2}, 2))["actions"]
 
 
+class TestLosslessArm:
+    """The consensus policy's third arm: entropy-probe votes
+    (``compression_auto_lossless{codec}``) flip the wire lossless
+    container on fleet-wide for a raw-pushing codec's keys."""
+
+    def lz_view(self, lz_votes, nw, votes=None):
+        return {"steps": {}, "fusion": {}, "codec_votes": votes or {},
+                "codec_lossless_votes": lz_votes, "num_workers": nw}
+
+    def test_quorum_flips_fleet(self):
+        t = mk_tuner([0.0])
+        res = t.sweep(self.lz_view({"topk": 2}, 3))
+        assert res["actions"][0]["set"] == {"codec_lossless_add": ["topk"]}
+        assert res["actions"][0]["evidence"]["arm"] == "lossless"
+        assert t.state.codec_lossless == ["topk"]
+        assert t.tuning_dict()["codec_lossless"] == ["topk"]
+
+    def test_below_quorum_waits(self):
+        t = mk_tuner([0.0])
+        assert not t.sweep(self.lz_view({"topk": 1}, 4))["actions"]
+
+    def test_codec_off_votes_win_the_sweep_budget(self):
+        # both arms have quorum: the lossy-off arm is evaluated first
+        # (a codec going raw is the precondition for lossless votes)
+        t = mk_tuner([0.0])
+        res = t.sweep(self.lz_view({"onebit": 2}, 2, votes={"topk": 2}))
+        assert res["actions"][0]["set"] == {"codec_off_add": ["topk"]}
+
+    def test_already_lossless_not_reflipped(self):
+        t = mk_tuner([0.0], cooldown_s=0.0)
+        t.sweep(self.lz_view({"topk": 2}, 2))
+        assert not t.sweep(self.lz_view({"topk": 2}, 2))["actions"]
+
+    def test_forced_action_drills_the_rollback_path(self):
+        clock = [0.0]
+        t = mk_tuner(clock, canary_sweeps=1, force="codec_lossless=topk")
+        base = {"steps": {"w0": 0.1}, "fusion": {}, "codec_votes": {},
+                "codec_lossless_votes": {}, "num_workers": 1}
+        res = t.sweep(dict(base))
+        assert res["actions"][0]["set"] == {"codec_lossless_add": ["topk"]}
+        assert t.state.codec_lossless == ["topk"]
+        # seeded regression inside the canary window → rollback removes
+        res = t.sweep({**base, "steps": {"w0": 9.9}})
+        assert res["rollbacks"] and t.state.codec_lossless == []
+        assert "codec_lossless" not in t.tuning_dict()
+
+    def test_rejoin_report_restores_third_arm(self):
+        t = mk_tuner([0.0])
+        assert t.adopt_rejoin_report({
+            "epoch": 5, "codec_off": ["onebit"],
+            "codec_lossless": ["topk"],
+        })
+        assert t.state.codec_lossless == ["topk"]
+        assert t.tuning_dict()["codec_lossless"] == ["topk"]
+
+
 class TestCanaryRollback:
     def test_regression_rolls_back_and_escalates_cooldown(self):
         clock = [0.0]

@@ -548,3 +548,133 @@ class TestCompressedEfTrajectory:
             assert codec.wire_static is True, type(codec)
         ef = VanillaErrorFeedback(OneBitCompressor(64))
         assert ef.wire_static is True
+
+
+def _run_codec_lane(engine: str, stripes: int, threshold: int,
+                    device: bool, monkeypatch) -> tuple:
+    """One full cluster: fixed-seed BARE topk workload (no EF, so the
+    device packers are eligible; topk is the codec whose device packer
+    is bit-identical to the host one on every input — lax.top_k and
+    both host selectors break magnitude ties toward the lower index),
+    fed numpy (host codec) or jax arrays (device codec).  Returns
+    (digest, counter snapshot, journaled fused entries as
+    (cmd, payload-bytes) pairs)."""
+    monkeypatch.setenv("BYTEPS_FUSION_THRESHOLD", str(threshold))
+    monkeypatch.setenv("BYTEPS_FUSION_CYCLE_MS", "2")
+    monkeypatch.setenv("BYTEPS_MIN_COMPRESS_BYTES", "0")
+    set_stripes(monkeypatch, stripes)
+    sched = Scheduler(num_workers=1, num_servers=1, host="127.0.0.1")
+    sched.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(sched.port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    if engine == "native":
+        monkeypatch.setenv("BYTEPS_SERVER_NATIVE", "1")
+    else:
+        monkeypatch.delenv("BYTEPS_SERVER_NATIVE", raising=False)
+    srv = make_ps_server(engine, Config.from_env())
+    threading.Thread(target=srv.start, daemon=True).start()
+
+    import byteps_tpu as bps
+
+    digest = hashlib.sha256()
+    journaled = []
+    try:
+        bps.init()
+        n, names = 1024, [f"dv.{i}" for i in range(4)]
+        for nm in names:
+            bps.declare_tensor(
+                nm, byteps_compressor_type="topk",
+                byteps_compressor_k="64",
+            )
+        rng = np.random.default_rng(123)
+        xs = {nm: rng.standard_normal(n).astype(np.float32)
+              for nm in names}
+
+        def _inp(x):
+            if device:
+                import jax.numpy as jnp
+                return jnp.asarray(x)
+            return x
+
+        hs = {nm: bps.push_pull_async(_inp(x), name=nm, average=False)
+              for nm, x in xs.items()}
+        for h in hs.values():
+            bps.synchronize(h)
+        counters().reset()
+        for r in range(2, 5):
+            hs = {nm: bps.push_pull_async(_inp(xs[nm] * r), name=nm,
+                                          average=False)
+                  for nm in names}
+            for nm in names:
+                digest.update(np.asarray(bps.synchronize(hs[nm])).tobytes())
+        snap = counters().snapshot()
+        from byteps_tpu.comm.journal import get_journal
+
+        j = get_journal()
+        if j is not None:
+            for k in j.keys():
+                for e in j.entries_after(k, 0):
+                    if e.fused:
+                        journaled.append((e.cmd, bytes(e.payload)))
+    finally:
+        bps.shutdown()
+        _reset_runtime()
+        srv.stop()
+        sched.stop()
+    return digest.hexdigest(), snap, journaled
+
+
+class TestDeviceCodecTrajectory:
+    def test_trajectory_bitwise_with_device_codec_axis(self, monkeypatch):
+        """The device-codec axis of the acceptance matrix: a fixed-seed
+        bare-topk run is BITWISE identical across {python, native-s1,
+        native-s4} × {fused, unfused} × {host codec, device codec}.
+        Device lanes must actually have packed on device (d2h_bytes
+        counts exactly the compressed wire bytes, not the fp32 tensor),
+        and fused device lanes must have ridden Op.FUSED frames whose
+        journaled members carry the device-compressed payload —
+        replayable through RESYNC like any host-compressed member."""
+        from conftest import have_native_parity_server
+
+        wire = 8 * 64  # topk wire bytes per tensor: k (i32, f32) pairs
+        lanes = [("python", 0, 16384), ("python", 0, 0)]
+        if have_native_parity_server():
+            lanes += [("native", 1, 16384), ("native", 1, 0),
+                      ("native", 4, 16384)]
+        digests = {}
+        for engine, stripes, threshold in lanes:
+            for device in (False, True):
+                d, snap, journaled = _run_codec_lane(
+                    engine, stripes, threshold, device, monkeypatch)
+                digests[(engine, stripes, threshold, device)] = d
+                if threshold:
+                    assert snap.get("fused_keys", 0) > 0, (engine, snap)
+                else:
+                    assert snap.get("fused_keys", 0) == 0, (engine, snap)
+                if device:
+                    # the tentpole claim: D2H moved ONLY the wire
+                    # encoding — 3 rounds × 4 tensors × the onebit frame
+                    assert snap.get("d2h_bytes", 0) == 3 * 4 * wire, snap
+                else:
+                    # numpy inputs have no device→host DMA to count
+                    assert snap.get("d2h_bytes", 0) == 0, snap
+                if device and threshold:
+                    # journal replay surface: fused device members were
+                    # journaled as COMPRESSED_PUSH_PULL payloads of the
+                    # exact device-packed wire bytes, and the host codec
+                    # decodes them (what a RESYNC replay ships unfused)
+                    assert journaled, "no fused members journaled"
+                    from byteps_tpu.compression.impl import (
+                        TopKCompressor,
+                    )
+
+                    for cmd, payload in journaled:
+                        assert cmd == CMD_COMP
+                        assert len(payload) == wire
+                        dec = TopKCompressor(1024, 64).decompress(
+                            payload, 1024)
+                        assert np.count_nonzero(dec) <= 64
+        assert len(set(digests.values())) == 1, digests

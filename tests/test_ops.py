@@ -189,6 +189,37 @@ class TestDeviceCodecs:
         idx, vals = topk_compress_device(jnp.asarray(grad), k)
         assert topk_payload(idx, vals) == host
 
+    def test_topk_tie_break_bit_matches_across_all_paths(self):
+        """Equal |magnitudes| at the k-th boundary: every selector
+        (native nth_element, numpy fallback, device lax.top_k) breaks
+        ties toward the LOWER index, so the wire bytes are identical
+        even on tie-heavy gradients — no 'unique k-th magnitude'
+        caveat."""
+        import byteps_tpu.compression.impl as impl
+        from byteps_tpu.compression.impl import TopKCompressor
+        from byteps_tpu.ops.codecs_device import (
+            topk_compress_device,
+            topk_payload,
+        )
+
+        rng = np.random.default_rng(7)
+        n, k = 512, 32
+        for _ in range(8):
+            grad = rng.choice(
+                [-2.0, -1.0, -0.5, 0.5, 1.0, 2.0], size=n
+            ).astype(np.float32)
+            codec = TopKCompressor(n, k)
+            host = codec.compress(grad)
+            real = impl.get_lib
+            impl.get_lib = lambda: None  # force the numpy fallback
+            try:
+                fallback = codec.compress(grad)
+            finally:
+                impl.get_lib = real
+            assert fallback == host
+            idx, vals = topk_compress_device(jnp.asarray(grad), k)
+            assert topk_payload(idx, vals) == host
+
     def test_topk_d2h_reduction_and_roundtrip(self):
         from byteps_tpu.compression.impl import TopKCompressor
         from byteps_tpu.ops.codecs_device import (

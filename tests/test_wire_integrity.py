@@ -312,6 +312,12 @@ def test_wire_fuzz_smoke():
     stats = mod.run_fuzz(seed=7, flips=240, truncations=120)
     assert stats["flips"] >= 240 and stats["truncations"] >= 120
     assert stats["baseline_silent"] > 0
+    # the lossless leg ran: truncated/corrupted containers failed
+    # closed and every checksummed flip was a ChecksumError (CRC is
+    # verified over the compressed bytes BEFORE the container decode)
+    assert stats["lossless_truncations"] > 0
+    assert stats["lossless_flips_crc"] > 0
+    assert stats["lossless_structural"] > 0
 
 
 # --------------------------------------------------------------------------

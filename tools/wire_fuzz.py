@@ -29,6 +29,17 @@ construction over every Op codec:
    MORE bytes than the truncated body holds.  (decode_wrong_owner is
    tolerant by contract — header ``version`` is authoritative — and is
    exercised for no-crash only.)
+6. **lossless frames** — MIGRATE_STATE/RESYNC_STATE bodies shipped
+   inside the wire lossless container (BYTEPS_WIRE_LOSSLESS): seeded
+   truncations must reject; every bit flip past the header on a
+   checksummed lossless frame must raise ``ChecksumError``
+   SPECIFICALLY — the CRC32C is computed over the COMPRESSED bytes and
+   verified BEFORE the container is decoded, so in-flight corruption
+   never reaches the LZ layer; with the checksum stripped, corrupting
+   the 10-byte container header (magic/version/raw_len) must raise
+   ``LosslessError`` — the container itself fails closed on structural
+   damage, and silent flips inside LZ literals are exactly the hole
+   the outer CRC closes.
 
 Deterministic per ``--seed``; tier-1 runs a small smoke
 (tests/test_wire_integrity.py::test_wire_fuzz_smoke), CI or a human can
@@ -52,8 +63,11 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from byteps_tpu.comm.transport import (  # noqa: E402
+    CHECKSUM_SIZE,
     ChecksumError,
     HEADER_SIZE,
+    LOSSLESS_FLAG,
+    LosslessError,
     Message,
     Op,
     decode_fused_push,
@@ -170,6 +184,49 @@ def frame_corpus(checksum: bool = True):
     return [(name, m.encode(), len(m.payload)) for name, m in frames]
 
 
+def lossless_corpus(checksum: bool = True):
+    """[(name, frame_bytes, payload_offset)] — MIGRATE_STATE and
+    RESYNC_STATE frames whose bodies ride the wire lossless container
+    (``lossless=True`` forces the transform regardless of
+    BYTEPS_WIRE_LOSSLESS, matching what a flag-stamped peer emits).
+    ``payload_offset`` is where the container's 10-byte header starts
+    inside the frame."""
+    from byteps_tpu.common.types import DataType
+
+    migrate_meta = {
+        "key": 7, "epoch": 3, "dtype": int(DataType.FLOAT32),
+        "store_version": 4, "recv_count": 0,
+        "push_seen": {str(r): 4 for r in range(8)},
+        "init_done": {str(r): 99 for r in range(8)},
+        "compressor_kwargs": {}, "store_nbytes": 256, "accum_nbytes": 0,
+    }
+    resync_body = encode_resync_state({
+        k: {"store_version": 4, "seen": 3, "recv_count": 1, "init": True}
+        for k in range(32)
+    })
+    frames = [
+        ("MIGRATE_STATE+lz", Message(
+            Op.MIGRATE_STATE, key=7, seq=21, version=3,
+            payload=encode_migrate_state(
+                migrate_meta, b"\x01" * 256, b""),
+            checksum=checksum, lossless=True)),
+        ("RESYNC_STATE+lz", Message(
+            Op.RESYNC_STATE, key=0, seq=22, payload=resync_body,
+            checksum=checksum, lossless=True)),
+    ]
+    out = []
+    for name, m in frames:
+        raw_len = len(m.payload)
+        frame = m.encode()
+        off = HEADER_SIZE + (CHECKSUM_SIZE if checksum else 0)
+        # the transform must actually have fired: flag stamped, body
+        # smaller than the raw encoding (these JSON-ish bodies compress)
+        assert frame[2] & LOSSLESS_FLAG, f"{name}: lossless flag missing"
+        assert len(frame) - off < raw_len, f"{name}: container did not win"
+        out.append((name, frame, off))
+    return out
+
+
 #: (decoder, encoded body, tolerant) per body codec — ``tolerant``
 #: decoders define a fallback for garbage (only no-crash is asserted)
 def body_corpus():
@@ -199,7 +256,9 @@ def run_fuzz(seed: int = 7, flips: int = 400, truncations: int = 200,
     Returns stats."""
     rng = random.Random(seed)
     stats = {"frames": 0, "truncations": 0, "flips": 0,
-             "baseline_silent": 0, "body_truncations": 0}
+             "baseline_silent": 0, "body_truncations": 0,
+             "lossless_truncations": 0, "lossless_flips_crc": 0,
+             "lossless_structural": 0}
     corpus = frame_corpus(checksum=True)
     stats["frames"] = len(corpus)
 
@@ -288,6 +347,64 @@ def run_fuzz(seed: int = 7, flips: int = 400, truncations: int = 200,
                     f"SILENT ACCEPT: {name} decoded a {k}-byte prefix "
                     f"but members need {consumed} bytes (seed={seed})"
                 )
+
+    # 5: lossless frames — truncation rejects; on a CHECKSUMMED frame
+    # every post-header flip must be a ChecksumError (CRC32C rides over
+    # the compressed bytes and is verified BEFORE the container decode,
+    # so corruption never reaches the LZ layer)
+    for name, frame, off in lossless_corpus(checksum=True):
+        cuts = (range(len(frame)) if exhaustive else sorted(
+            rng.randrange(len(frame)) for _ in range(24)
+        ))
+        for k in cuts:
+            stats["lossless_truncations"] += 1
+            try:
+                decode_frame(frame[:k])
+            except _REJECTS:
+                continue
+            raise AssertionError(
+                f"SILENT ACCEPT: {name} truncated to {k}/{len(frame)} "
+                f"bytes decoded without error (seed={seed})"
+            )
+        for _ in range(max(1, flips // 8)):
+            stats["lossless_flips_crc"] += 1
+            idx = rng.randrange(HEADER_SIZE, len(frame))
+            mutated = bytearray(frame)
+            mutated[idx] ^= 1 << rng.randrange(8)
+            try:
+                decode_frame(bytes(mutated))
+            except ChecksumError:
+                continue
+            except LosslessError as e:
+                raise AssertionError(
+                    f"CRC ORDER BROKEN: {name} flip at offset {idx} "
+                    f"reached the container decode ({e}) before the "
+                    f"checksum verify (seed={seed})"
+                ) from e
+            raise AssertionError(
+                f"SILENT ACCEPT: {name} flip at offset {idx} decoded "
+                f"without error (seed={seed})"
+            )
+    # the container's own fail-closed floor: with NO checksum, damage
+    # to the 10-byte container header (magic/version/method/raw_len)
+    # still raises LosslessError — never a wrong-length silent decode
+    for name, frame, off in lossless_corpus(checksum=False):
+        for idx in range(off, off + 10):
+            for bit in range(8):
+                stats["lossless_structural"] += 1
+                mutated = bytearray(frame)
+                mutated[idx] ^= 1 << bit
+                try:
+                    decode_frame(bytes(mutated))
+                except LosslessError:
+                    continue
+                except _REJECTS:
+                    continue
+                raise AssertionError(
+                    f"SILENT ACCEPT: {name} (no checksum) container "
+                    f"header bit {bit} at offset {idx} decoded without "
+                    f"error (seed={seed})"
+                )
     return stats
 
 
@@ -312,7 +429,11 @@ def main(argv=None) -> int:
         "WIRE FUZZ OK: %(frames)d codecs, %(truncations)d truncations + "
         "%(flips)d bit-flips all rejected; %(body_truncations)d body "
         "truncations clean; %(baseline_silent)d checksum-off control flips "
-        "passed silently (the hole BYTEPS_WIRE_CHECKSUM closes)" % stats
+        "passed silently (the hole BYTEPS_WIRE_CHECKSUM closes); lossless "
+        "frames: %(lossless_truncations)d truncations rejected, "
+        "%(lossless_flips_crc)d flips all ChecksumError (CRC verifies "
+        "before container decode), %(lossless_structural)d container-header "
+        "corruptions fail closed" % stats
     )
     return 0
 
